@@ -1,0 +1,32 @@
+"""paxi_tpu — a TPU-native framework with the capabilities of acharapko/paxi.
+
+Paxi (the Go reference) is a framework for prototyping, deploying,
+benchmarking and fuzz-testing strongly-consistent replication protocols
+over a replicated KV store.  This package re-designs it TPU-first:
+
+- ``paxi_tpu.core``      — node IDs, config, commands, quorums, KV database
+  (reference: id.go, config.go, msg.go, quorum.go, db.go).
+- ``paxi_tpu.sim``       — the TPU simulation runtime: each protocol is a
+  pure transition function ``step(state, inbox, ctx) -> (state, outbox)``
+  over fixed-shape arrays, ``vmap``-ed over an (instance x replica) batch
+  and driven by a lock-step message exchange with randomized
+  drop/dup/delay/partition schedules (reference: the ``chan`` transport +
+  ``-simulation`` mode in transport.go / bin/server/main.go, generalized).
+- ``paxi_tpu.protocols`` — protocol plugins: paxos, epaxos, wpaxos, abd,
+  chain, kpaxos (reference: same-named Go packages).
+- ``paxi_tpu.host``      — the deployment runtime: asyncio node, TCP/chan
+  transports, HTTP client API, closed-loop benchmark, linearizability
+  checker (reference: node.go, socket.go, transport.go, client.go,
+  benchmark.go, history.go).
+- ``paxi_tpu.parallel``  — device-mesh sharding of the instance batch
+  (shard_map over ICI; XLA collectives for metric reduction).
+- ``paxi_tpu.ops``       — array primitives (quorum popcounts, one-hot
+  scatter helpers, pallas kernels for the hot exchange paths).
+"""
+
+__version__ = "0.1.0"
+
+from paxi_tpu.core.ident import ID
+from paxi_tpu.core.config import Config
+
+__all__ = ["ID", "Config", "__version__"]

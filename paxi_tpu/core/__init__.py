@@ -1,0 +1,13 @@
+"""Core types shared by the host runtime and the TPU sim runtime.
+
+Reference (paxi): id.go, config.go, msg.go, db.go, quorum.go.
+"""
+
+from paxi_tpu.core.ident import ID
+from paxi_tpu.core.config import Config, Bconfig
+from paxi_tpu.core.command import Command, Request, Reply
+from paxi_tpu.core.db import Database
+from paxi_tpu.core.quorum import Quorum
+
+__all__ = ["ID", "Config", "Bconfig", "Command", "Request", "Reply",
+           "Database", "Quorum"]

@@ -1,0 +1,91 @@
+"""Commands and client wire types.
+
+Reference: paxi db.go (Key/Value/Command), msg.go (Request/Reply/Read/
+Transaction, gob-registered in init()).  The host runtime serializes these
+with ``paxi_tpu.host.codec``; the sim runtime packs Command into int32
+lanes (see protocols' ``sim.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+Key = int
+Value = bytes
+
+
+@dataclass
+class Command:
+    """Reference: db.go Command{Key, Value, ClientID, CommandID}."""
+
+    key: Key
+    value: Value = b""
+    client_id: str = ""
+    command_id: int = 0
+
+    def is_read(self) -> bool:
+        """Reference: db.go Command.IsRead() — empty value means read."""
+        return len(self.value) == 0
+
+    def is_write(self) -> bool:
+        return not self.is_read()
+
+
+@dataclass
+class Request:
+    """A client request as seen by a replica.
+
+    Reference: msg.go Request{Command, Properties, Timestamp, NodeID, c}.
+    The reply channel ``c`` is node-local in the reference; here it is an
+    optional callable / asyncio.Future set by the host runtime and never
+    serialized.
+    """
+
+    command: Command
+    properties: dict = field(default_factory=dict)
+    timestamp: float = 0.0
+    node_id: str = ""
+    reply_to: Optional[Any] = None  # asyncio.Future | callable, node-local
+
+    def reply(self, reply: "Reply") -> None:
+        if self.reply_to is None:
+            return
+        if callable(self.reply_to):
+            self.reply_to(reply)
+        else:  # asyncio.Future
+            if not self.reply_to.done():
+                self.reply_to.set_result(reply)
+
+    def wire(self) -> dict:
+        """Serializable form (reply channel stripped, like gob encoding)."""
+        return {
+            "command": {
+                "key": self.command.key,
+                "value": self.command.value,
+                "client_id": self.command.client_id,
+                "command_id": self.command.command_id,
+            },
+            "properties": self.properties,
+            "timestamp": self.timestamp,
+            "node_id": self.node_id,
+        }
+
+    @staticmethod
+    def from_wire(d: dict) -> "Request":
+        c = d["command"]
+        return Request(
+            command=Command(c["key"], c["value"], c["client_id"], c["command_id"]),
+            properties=d.get("properties", {}),
+            timestamp=d.get("timestamp", 0.0),
+            node_id=d.get("node_id", ""),
+        )
+
+
+@dataclass
+class Reply:
+    """Reference: msg.go Reply{Command, Value, Err}."""
+
+    command: Command
+    value: Value = b""
+    err: Optional[str] = None

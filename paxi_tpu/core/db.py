@@ -1,0 +1,58 @@
+"""The replicated state machine: an in-memory KV store.
+
+Reference: paxi db.go — ``Database`` interface with ``Execute(Command)
+Value`` backed by ``map[Key]Value`` + RWMutex, optional multi-version
+history.  Host-runtime replicas execute committed commands against this;
+the sim runtime keeps the KV as a dense ``(replica, key)`` int32 array
+(see protocols' sim kernels).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from paxi_tpu.core.command import Command, Key, Value
+
+
+class Database:
+    """In-memory KV store with optional per-key version history."""
+
+    def __init__(self, multi_version: bool = False):
+        self._data: Dict[Key, Value] = {}
+        self._history: Dict[Key, List[Value]] = {}
+        self._multi_version = multi_version
+        self._lock = threading.RLock()
+        self._version = 0
+
+    def execute(self, cmd: Command) -> Value:
+        """Apply a command; returns the PREVIOUS value (read for gets,
+        old-value for puts) exactly like the reference's Execute."""
+        with self._lock:
+            prev = self._data.get(cmd.key, b"")
+            if cmd.is_write():
+                self._data[cmd.key] = cmd.value
+                self._version += 1
+                if self._multi_version:
+                    self._history.setdefault(cmd.key, []).append(cmd.value)
+            return prev
+
+    def get(self, key: Key) -> Optional[Value]:
+        with self._lock:
+            return self._data.get(key)
+
+    def put(self, key: Key, value: Value) -> None:
+        with self._lock:
+            self._data[key] = value
+            if self._multi_version:
+                self._history.setdefault(key, []).append(value)
+
+    def history(self, key: Key) -> List[Value]:
+        with self._lock:
+            return list(self._history.get(key, []))
+
+    def version(self) -> int:
+        return self._version
+
+    def __len__(self) -> int:
+        return len(self._data)

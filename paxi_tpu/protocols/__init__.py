@@ -1,0 +1,52 @@
+"""Protocol plugin registry.
+
+Reference: bin/server/main.go's ``switch algorithm { case "paxos": ... }``
+dispatch plus each package's ``NewReplica``.  Here a name resolves to a
+``SimProtocol`` (TPU sim runtime) and/or a host ``Replica`` factory
+(deployment runtime); one protocol definition feeds both.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Callable, Dict
+
+from paxi_tpu.sim.types import SimProtocol
+
+_SIM_MODULES = {
+    "paxos": "paxi_tpu.protocols.paxos.sim",
+    "abd": "paxi_tpu.protocols.abd.sim",
+    "chain": "paxi_tpu.protocols.chain.sim",
+    "wpaxos": "paxi_tpu.protocols.wpaxos.sim",
+    "epaxos": "paxi_tpu.protocols.epaxos.sim",
+    "kpaxos": "paxi_tpu.protocols.kpaxos.sim",
+}
+
+_HOST_MODULES = {
+    "paxos": "paxi_tpu.protocols.paxos.host",
+    "abd": "paxi_tpu.protocols.abd.host",
+    "chain": "paxi_tpu.protocols.chain.host",
+    "wpaxos": "paxi_tpu.protocols.wpaxos.host",
+    "epaxos": "paxi_tpu.protocols.epaxos.host",
+    "kpaxos": "paxi_tpu.protocols.kpaxos.host",
+}
+
+
+def sim_protocol(name: str) -> SimProtocol:
+    """Resolve a protocol name to its TPU sim plugin (PROTOCOL symbol)."""
+    if name not in _SIM_MODULES:
+        raise KeyError(f"unknown sim protocol {name!r}; "
+                       f"have {sorted(_SIM_MODULES)}")
+    return importlib.import_module(_SIM_MODULES[name]).PROTOCOL
+
+
+def host_replica(name: str) -> Callable:
+    """Resolve a protocol name to its host Replica factory (new_replica)."""
+    if name not in _HOST_MODULES:
+        raise KeyError(f"unknown host protocol {name!r}; "
+                       f"have {sorted(_HOST_MODULES)}")
+    return importlib.import_module(_HOST_MODULES[name]).new_replica
+
+
+def sim_protocols() -> Dict[str, str]:
+    return dict(_SIM_MODULES)

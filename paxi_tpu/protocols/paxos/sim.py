@@ -1,0 +1,349 @@
+"""Multi-Paxos as a pure TPU transition kernel.
+
+Reference: paxi paxos/paxos.go — single stable leader, phase-1 ballot
+election with log recovery from P1b payloads, per-slot phase-2 acceptance
+under a majority quorum, P3 commit broadcast, in-order execution
+(HandleRequest/HandleP1a/HandleP1b/HandleP2a/HandleP2b/HandleP3) [driver].
+
+TPU re-design (not a translation):
+- Per-replica state is a struct-of-arrays over a fixed slot window; all
+  handlers run every step on every replica as fully *masked* updates
+  (leader/follower divergence is `where`-selected, never branched).
+- Ballots are ``round * ballot_stride + replica_idx`` int32s
+  (paxos ballot.go packs n<<16|id the same way).
+- ``Quorum.ACK`` becomes a boolean ack-matrix OR + popcount
+  (p1_acks (R,R); log_acks (R,S,R)) [driver].
+- P1b log payloads are passed *by reference*: on winning phase-1 the new
+  leader merges the current logs of its ackers (equivalent to each acker
+  having sent its P1b later — acceptor entries only grow in ballot, so
+  this is safe for the safety oracle).
+- P3 carries (slot, cmd) plus a commit frontier ``upto``: a follower may
+  commit any slot < upto whose accepted ballot equals the leader's,
+  because a leader proposes exactly one command per (ballot, slot).
+- Client load: the leader proposes one new command per step (closed-loop
+  stream, benchmark.go's generator collapsed into the kernel); commands
+  encode (ballot, slot) so the agreement oracle can detect any
+  two-leaders-two-values divergence.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+
+NO_CMD = -1    # empty log entry
+NOOP = -2      # hole filled by a recovering leader
+
+
+def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
+    return {
+        "p1a": ("bal",),
+        "p1b": ("bal",),
+        "p2a": ("bal", "slot", "cmd"),
+        "p2b": ("bal", "slot"),
+        "p3": ("bal", "slot", "cmd", "upto"),
+    }
+
+
+def encode_cmd(bal, slot):
+    """Unique-ish command id per (ballot, slot) — lets the agreement
+    oracle catch divergent decisions. Doubles as the KV write payload."""
+    return ((bal & 0x7FFF) << 16) | (slot & 0xFFFF)
+
+
+def cmd_key(cmd, n_keys):
+    """Hash the command id onto the KV key space (golden-ratio multiply;
+    int32 wrap-around is intended)."""
+    h = cmd * jnp.int32(-1640531527)
+    return jnp.abs(h) % n_keys
+
+
+def init_state(cfg: SimConfig, rng: jax.Array):
+    R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
+    del rng
+    return dict(
+        ballot=jnp.zeros((R,), jnp.int32),        # highest ballot seen/promised
+        active=jnp.zeros((R,), bool),             # leader with phase-1 done
+        p1_acks=jnp.zeros((R, R), bool),          # [ldr, src] phase-1 acks
+        log_bal=jnp.zeros((R, S), jnp.int32),     # accepted ballot per slot
+        log_cmd=jnp.full((R, S), NO_CMD, jnp.int32),
+        log_commit=jnp.zeros((R, S), bool),
+        log_acks=jnp.zeros((R, S, R), bool),      # [ldr, slot, src] P2b acks
+        proposed=jnp.zeros((R, S), bool),         # P2a sent under my ballot
+        next_slot=jnp.zeros((R,), jnp.int32),
+        execute=jnp.zeros((R,), jnp.int32),       # first unexecuted slot
+        kv=jnp.zeros((R, K), jnp.int32),
+        # replica 0's timer fires at step 0 => immediate first election
+        timer=jnp.arange(R, dtype=jnp.int32) * cfg.election_timeout,
+        stuck=jnp.zeros((R,), jnp.int32),         # frontier-stall counter
+    )
+
+
+def step(state, inbox, ctx: StepCtx):
+    cfg = ctx.cfg
+    R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
+    MAJ, STRIDE = cfg.majority, cfg.ballot_stride
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    sidx = jnp.arange(S, dtype=jnp.int32)
+
+    ballot = state["ballot"]
+    active = state["active"]
+    p1_acks = state["p1_acks"]
+    log_bal = state["log_bal"]
+    log_cmd = state["log_cmd"]
+    log_commit = state["log_commit"]
+    log_acks = state["log_acks"]
+    proposed = state["proposed"]
+    next_slot = state["next_slot"]
+    execute = state["execute"]
+    kv = state["kv"]
+
+    # ---------------- P1a: promise to the highest proposer --------------
+    m = inbox["p1a"]
+    b_in = jnp.where(m["valid"], m["bal"], 0)            # (src, dst)
+    p1a_bal = jnp.max(b_in, axis=0)                      # per dst
+    p1a_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)
+    promote = p1a_bal > ballot
+    ballot = jnp.maximum(ballot, p1a_bal)
+    active = active & ~promote
+    p1_acks = jnp.where(promote[:, None], False, p1_acks)  # my old round died
+    # P1b out (log payload by reference; see module docstring)
+    p1b_valid = promote[:, None] & (ridx[None, :] == p1a_src[:, None])
+    out_p1b = {"valid": p1b_valid,
+               "bal": jnp.broadcast_to(ballot[:, None], (R, R))}
+
+    own_bal = (ballot > 0) & (ballot % STRIDE == ridx)
+
+    # ---------------- P1b: collect phase-1 acks -------------------------
+    m = inbox["p1b"]
+    ack = m["valid"].T & (m["bal"].T == ballot[:, None]) & own_bal[:, None]
+    p1_acks = p1_acks | ack                               # (ldr, src)
+    p1_win = own_bal & ~active & (jnp.sum(p1_acks, axis=1) >= MAJ)
+
+    # ---------------- phase-1 win: merge ackers' logs -------------------
+    amask = p1_acks                                       # includes self
+    lb = jnp.where(amask[:, :, None], log_bal[None, :, :], -1)  # (ldr,src,S)
+    src_best = jnp.argmax(lb, axis=1)                     # (ldr, S)
+    best_bal = jnp.max(lb, axis=1)
+    merged_cmd = log_cmd[src_best, sidx[None, :]]         # (ldr, S)
+    cmask = amask[:, :, None] & log_commit[None, :, :]
+    merged_commit = jnp.any(cmask, axis=1)                # (ldr, S)
+    csrc = jnp.argmax(cmask, axis=1)
+    committed_cmd = log_cmd[csrc, sidx[None, :]]
+    has_acc = (best_bal > 0) | merged_commit
+    top = jnp.max(jnp.where(has_acc, sidx[None, :] + 1, 0), axis=1)  # (ldr,)
+    new_next = jnp.maximum(next_slot, top)
+    in_win = sidx[None, :] < new_next[:, None]            # slots to own
+    w = p1_win[:, None]
+    # committed slots adopt the committed value; accepted adopt merged;
+    # holes below the frontier become NOOP re-proposals.
+    adopt_cmd = jnp.where(merged_commit, committed_cmd,
+                          jnp.where(best_bal > 0, merged_cmd, NOOP))
+    log_cmd = jnp.where(w & in_win, adopt_cmd, log_cmd)
+    log_bal = jnp.where(w & in_win, ballot[:, None], log_bal)
+    log_commit = jnp.where(w & in_win, merged_commit | log_commit, log_commit)
+    proposed = jnp.where(w, in_win & (merged_commit | log_commit), proposed)
+    self_only = (ridx[None, None, :] == ridx[:, None, None])  # (R,1->S,R)
+    log_acks = jnp.where(w[:, :, None],
+                         in_win[:, :, None] & self_only, log_acks)
+    next_slot = jnp.where(p1_win, new_next, next_slot)
+    active = active | p1_win
+
+    # ---------------- P2a: accept from the highest-ballot leader --------
+    m = inbox["p2a"]
+    b_in = jnp.where(m["valid"], m["bal"], -1)
+    a_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)    # per dst
+    a_bal = jnp.max(b_in, axis=0)
+    a_has = a_bal > 0
+    a_slot = m["slot"][a_src, ridx]
+    a_cmd = m["cmd"][a_src, ridx]
+    acc_ok = a_has & (a_bal >= ballot)
+    demote = acc_ok & (a_bal > ballot)                    # someone else leads
+    ballot = jnp.where(acc_ok, a_bal, ballot)
+    active = active & ~demote
+    p1_acks = jnp.where(demote[:, None], False, p1_acks)
+    oh = acc_ok[:, None] & (sidx[None, :] == a_slot[:, None])
+    writable = oh & (log_bal <= a_bal[:, None]) & ~log_commit
+    log_bal = jnp.where(writable, a_bal[:, None], log_bal)
+    log_cmd = jnp.where(writable, a_cmd[:, None], log_cmd)
+    out_p2b = {
+        "valid": acc_ok[:, None] & (ridx[None, :] == a_src[:, None]),
+        "bal": jnp.broadcast_to(a_bal[:, None], (R, R)),
+        "slot": jnp.broadcast_to(a_slot[:, None], (R, R)),
+    }
+
+    own_bal = (ballot > 0) & (ballot % STRIDE == ridx)
+
+    # ---------------- P2b: leader tallies acks, commits -----------------
+    m = inbox["p2b"]
+    okb = m["valid"].T & (m["bal"].T == ballot[:, None]) & \
+        (active & own_bal)[:, None]                       # (ldr, src)
+    bslot = m["slot"].T                                   # (ldr, src)
+    add = okb[:, :, None] & (sidx[None, None, :] == bslot[:, :, None])
+    log_acks = log_acks | jnp.transpose(add, (0, 2, 1))   # (ldr, slot, src)
+    acks_n = jnp.sum(log_acks, axis=2)                    # (ldr, slot)
+    newly = ((active & own_bal)[:, None] & (acks_n >= MAJ)
+             & ~log_commit & (log_cmd != NO_CMD) & proposed)
+    log_commit = log_commit | newly
+
+    # ---------------- P3: commit notifications --------------------------
+    m = inbox["p3"]
+    b_in = jnp.where(m["valid"], m["bal"], -1)
+    c_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)
+    c_bal = jnp.max(b_in, axis=0)
+    c_has = c_bal > 0
+    c_slot = m["slot"][c_src, ridx]
+    c_cmd = m["cmd"][c_src, ridx]
+    c_upto = m["upto"][c_src, ridx]
+    oh = c_has[:, None] & (sidx[None, :] == c_slot[:, None])
+    log_cmd = jnp.where(oh, c_cmd[:, None], log_cmd)
+    log_bal = jnp.where(oh, jnp.maximum(log_bal, c_bal[:, None]), log_bal)
+    log_commit = log_commit | oh
+    # frontier commit: slots < upto accepted at the leader's exact ballot
+    ohu = (c_has[:, None] & (sidx[None, :] < c_upto[:, None])
+           & (log_bal == c_bal[:, None]) & (log_cmd != NO_CMD))
+    log_commit = log_commit | ohu
+
+    # ---------------- leader proposes (new cmd or re-proposal) ----------
+    is_leader = active & own_bal
+    mask_re = (~log_commit) & (~proposed) & (sidx[None, :] < next_slot[:, None])
+    first_re = jnp.argmin(jnp.where(mask_re, sidx[None, :], S), axis=1)
+    has_re = jnp.any(mask_re, axis=1)
+    can_new = next_slot < S
+    prop_slot = jnp.where(has_re, first_re, next_slot).astype(jnp.int32)
+    is_new = ~has_re & can_new
+    new_cmd = encode_cmd(ballot, prop_slot)
+    re_cmd = log_cmd[ridx, jnp.clip(prop_slot, 0, S - 1)]
+    re_cmd = jnp.where(re_cmd == NO_CMD, NOOP, re_cmd)
+    prop_cmd = jnp.where(is_new, new_cmd, re_cmd)
+    do = is_leader & (has_re | can_new)
+    oh = do[:, None] & (sidx[None, :] == prop_slot[:, None])
+    log_bal = jnp.where(oh, ballot[:, None], log_bal)
+    log_cmd = jnp.where(oh & ~log_commit, prop_cmd[:, None], log_cmd)
+    proposed = proposed | oh
+    log_acks = log_acks | (oh[:, :, None] & self_only)
+    next_slot = next_slot + (is_new & do)
+    out_p2a = {
+        "valid": jnp.broadcast_to(do[:, None], (R, R)),
+        "bal": jnp.broadcast_to(ballot[:, None], (R, R)),
+        "slot": jnp.broadcast_to(prop_slot[:, None], (R, R)),
+        "cmd": jnp.broadcast_to(prop_cmd[:, None], (R, R)),
+    }
+
+    # ---------------- execute committed prefix, apply to KV -------------
+    advanced = jnp.zeros((R,), jnp.int32)
+    running = jnp.ones((R,), bool)
+    for e in range(cfg.exec_window):
+        idx = jnp.clip(execute + e, 0, S - 1)
+        inb = (execute + e) < S
+        com = jnp.take_along_axis(log_commit, idx[:, None], axis=1)[:, 0]
+        running = running & com & inb
+        cmd_e = jnp.take_along_axis(log_cmd, idx[:, None], axis=1)[:, 0]
+        key_e = cmd_key(cmd_e, K)
+        wr = running & (cmd_e >= 0)
+        ohk = wr[:, None] & (jnp.arange(K)[None, :] == key_e[:, None])
+        kv = jnp.where(ohk, cmd_e[:, None], kv)
+        advanced = advanced + running
+    new_execute = execute + advanced
+
+    # ---------------- P3 out: newly committed + frontier retransmit -----
+    low_new = jnp.argmin(jnp.where(newly, sidx[None, :], S), axis=1)
+    any_new = jnp.any(newly, axis=1)
+    gmin = jnp.min(new_execute)  # group-min frontier (sim-side global read)
+    p3_slot = jnp.where(any_new, low_new,
+                        jnp.clip(gmin, 0, S - 1)).astype(jnp.int32)
+    p3_committed = jnp.take_along_axis(
+        log_commit, p3_slot[:, None], axis=1)[:, 0]
+    p3_cmd = jnp.take_along_axis(log_cmd, p3_slot[:, None], axis=1)[:, 0]
+    p3_do = is_leader & p3_committed
+    out_p3 = {
+        "valid": jnp.broadcast_to(p3_do[:, None], (R, R)),
+        "bal": jnp.broadcast_to(ballot[:, None], (R, R)),
+        "slot": jnp.broadcast_to(p3_slot[:, None], (R, R)),
+        "cmd": jnp.broadcast_to(p3_cmd[:, None], (R, R)),
+        "upto": jnp.broadcast_to(new_execute[:, None], (R, R)),
+    }
+
+    # ---------------- stuck-frontier retry (lost P2a/P2b) ---------------
+    stalled = is_leader & (new_execute == execute) & (next_slot > new_execute)
+    stuck = jnp.where(stalled, state["stuck"] + 1, 0)
+    retry = stuck >= cfg.retry_timeout
+    ohr = retry[:, None] & (sidx[None, :] == jnp.clip(new_execute, 0, S - 1)[:, None])
+    proposed = proposed & ~ohr
+    stuck = jnp.where(retry, 0, stuck)
+
+    # ---------------- election timer ------------------------------------
+    heard = promote | acc_ok | (c_has & (c_bal >= ballot))
+    k_jit = jr.fold_in(ctx.rng, 17)
+    jitter = jr.randint(k_jit, (R,), 0, cfg.backoff + 1)
+    timer = jnp.where(heard | active,
+                      cfg.election_timeout + jitter,
+                      state["timer"] - 1)
+    fire = ~active & (timer <= 0)
+    new_bal = (jnp.max(ballot) // STRIDE + 1) * STRIDE + ridx
+    ballot = jnp.where(fire, new_bal, ballot)
+    p1_acks = jnp.where(fire[:, None], ridx[None, :] == ridx[:, None], p1_acks)
+    timer = jnp.where(fire, cfg.election_timeout + jitter, timer)
+    out_p1a = {
+        "valid": jnp.broadcast_to(fire[:, None], (R, R)),
+        "bal": jnp.broadcast_to(ballot[:, None], (R, R)),
+    }
+
+    new_state = dict(
+        ballot=ballot, active=active, p1_acks=p1_acks, log_bal=log_bal,
+        log_cmd=log_cmd, log_commit=log_commit, log_acks=log_acks,
+        proposed=proposed, next_slot=next_slot, execute=new_execute,
+        kv=kv, timer=timer, stuck=stuck,
+    )
+    outbox = {"p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
+              "p2b": out_p2b, "p3": out_p3}
+    return new_state, outbox
+
+
+def metrics(state, cfg: SimConfig):
+    """Committed slots = executed prefix at the most advanced replica
+    (executed implies committed and agreement-checked)."""
+    return {
+        "committed_slots": jnp.max(state["execute"]),
+        "min_execute": jnp.min(state["execute"]),
+        "has_leader": jnp.any(state["active"]).astype(jnp.int32),
+    }
+
+
+def invariants(old, new, cfg: SimConfig) -> jax.Array:
+    """Per-step safety oracle (generalizes history.go's checker):
+    1. Agreement: all committed commands for a slot are equal.
+    2. Stability: a committed (slot, cmd) never changes or un-commits.
+    3. Ballot monotonicity per replica.
+    4. Executed prefix is committed."""
+    BIG = jnp.int32(2**30)
+    c, cmd = new["log_commit"], new["log_cmd"]
+    mx = jnp.max(jnp.where(c, cmd, -BIG), axis=0)
+    mn = jnp.min(jnp.where(c, cmd, BIG), axis=0)
+    n_c = jnp.sum(c, axis=0)
+    v_agree = jnp.sum((n_c >= 1) & (mx != mn))
+
+    was = old["log_commit"]
+    v_stable = jnp.sum(was & (~c | (cmd != old["log_cmd"])))
+
+    v_bal = jnp.sum(new["ballot"] < old["ballot"])
+
+    prefix_len = jnp.sum(jnp.cumprod(c.astype(jnp.int32), axis=1), axis=1)
+    v_exec = jnp.sum(new["execute"] > prefix_len)
+
+    return (v_agree + v_stable + v_bal + v_exec).astype(jnp.int32)
+
+
+PROTOCOL = SimProtocol(
+    name="paxos",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=step,
+    metrics=metrics,
+    invariants=invariants,
+)

@@ -1,0 +1,8 @@
+"""TPU simulation runtime (the `transport=tpu-sim` backend)."""
+
+from paxi_tpu.sim.types import (FAULT_FREE, FuzzConfig, SimConfig,
+                                SimProtocol, StepCtx)
+from paxi_tpu.sim.runner import SimResult, make_run, simulate
+
+__all__ = ["SimConfig", "FuzzConfig", "FAULT_FREE", "SimProtocol",
+           "StepCtx", "SimResult", "make_run", "simulate"]

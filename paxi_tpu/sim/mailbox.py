@@ -1,0 +1,132 @@
+"""Dense lock-step message exchange with a randomized fault schedule.
+
+This is the TPU-native replacement for the reference's in-process ``chan``
+transport + socket fault injection (transport.go scheme "chan",
+socket.go Crash/Drop/Slow/Flaky) [driver].  Per message type there is one
+``(src, dst)`` plane of int32 fields plus a validity mask; in-flight
+messages live in a *timing wheel* ``(delay, src, dst)`` so arbitrary
+per-edge delays (=> reordering across edges), drops, duplicates, crashes
+and partitions are all cheap masked array ops inside the jitted step.
+
+Collision semantics: a newly sent message overwrites an undelivered one in
+the same wheel slot for the same (type, src, dst) edge — i.e. extra loss,
+which the fuzzing oracle tolerates by design.  In fault-free mode
+(max_delay=1) each sender emits at most one message per type per edge per
+step, so no collisions occur.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from paxi_tpu.sim.types import FuzzConfig, Mailboxes
+
+MailSpec = Dict[str, Tuple[str, ...]]
+
+
+def empty_mailboxes(spec: MailSpec, n: int) -> Mailboxes:
+    """One zeroed (src, dst) plane per message type."""
+    out = {}
+    for name, fields in spec.items():
+        box = {"valid": jnp.zeros((n, n), bool)}
+        for f in fields:
+            box[f] = jnp.zeros((n, n), jnp.int32)
+        out[name] = box
+    return out
+
+
+def empty_wheel(spec: MailSpec, n: int, fuzz: FuzzConfig) -> Mailboxes:
+    """Timing wheel: slot d holds messages arriving in d+1 steps."""
+    d = fuzz.wheel
+    out = {}
+    for name, fields in spec.items():
+        box = {"valid": jnp.zeros((d, n, n), bool)}
+        for f in fields:
+            box[f] = jnp.zeros((d, n, n), jnp.int32)
+        out[name] = box
+    return out
+
+
+def wheel_deliver(wheel: Mailboxes) -> Tuple[Mailboxes, Mailboxes]:
+    """Pop slot 0 as this step's inbox; rotate the wheel forward."""
+    inbox, rolled = {}, {}
+    for name, box in wheel.items():
+        inbox[name] = {k: v[0] for k, v in box.items()}
+        rolled[name] = {
+            k: jnp.concatenate([v[1:], jnp.zeros_like(v[:1])], axis=0)
+            for k, v in box.items()
+        }
+    return inbox, rolled
+
+
+def fault_state_init(n: int) -> Dict[str, jax.Array]:
+    """Connectivity + crash masks carried in the scan."""
+    return {
+        "conn": jnp.ones((n, n), bool),   # can (src -> dst) deliver?
+        "crashed": jnp.zeros((n,), bool),  # comms-crashed replicas
+    }
+
+
+def fault_state_refresh(fs, rng, t, fuzz: FuzzConfig, n: int):
+    """Resample partition/crash schedule every ``fuzz.window`` steps.
+
+    Partition: a random bipartition of replicas; messages across the cut
+    are dropped (socket.go Drop generalized).  Crash: a replica's sends
+    and receives are suppressed (socket.go Crash — the node keeps its
+    state, matching the reference where Crash only stops the transport).
+    """
+    if not (fuzz.p_partition > 0 or fuzz.p_crash > 0):
+        return fs
+    k1, k2, k3 = jr.split(rng, 3)
+    side = jr.bernoulli(k1, 0.5, (n,))
+    cut = jr.bernoulli(k2, fuzz.p_partition, ())
+    conn = jnp.where(cut, side[:, None] == side[None, :],
+                     jnp.ones((n, n), bool))
+    crashed = jr.bernoulli(k3, fuzz.p_crash, (n,))
+    fresh = (t % fuzz.window) == 0
+    return {
+        "conn": jnp.where(fresh, conn, fs["conn"]),
+        "crashed": jnp.where(fresh, crashed, fs["crashed"]),
+    }
+
+
+def wheel_insert(wheel: Mailboxes, outbox: Mailboxes, fs, rng,
+                 fuzz: FuzzConfig) -> Mailboxes:
+    """Push this step's outbox into the wheel under the fault schedule."""
+    d = fuzz.wheel
+    new_wheel = {}
+    names = sorted(outbox.keys())
+    keys = jr.split(rng, 3 * len(names))
+    for i, name in enumerate(names):
+        box, wbox = outbox[name], wheel[name]
+        n = box["valid"].shape[0]
+        no_self = ~jnp.eye(n, dtype=bool)
+        valid = (box["valid"] & no_self & fs["conn"]
+                 & ~fs["crashed"][:, None] & ~fs["crashed"][None, :])
+        kd, kdel, kdup = keys[3 * i], keys[3 * i + 1], keys[3 * i + 2]
+        if fuzz.p_drop > 0:
+            valid = valid & ~jr.bernoulli(kd, fuzz.p_drop, (n, n))
+        if d > 1:
+            delay = jr.randint(kdel, (n, n), 1, d + 1)  # arrival in 1..d steps
+        else:
+            delay = jnp.ones((n, n), jnp.int32)
+        dup = (jr.bernoulli(kdup, fuzz.p_dup, (n, n))
+               if fuzz.p_dup > 0 else jnp.zeros((n, n), bool))
+        dup_delay = jnp.minimum(delay + 1, d)
+
+        wvalid = wbox["valid"]
+        wfields = {k: v for k, v in wbox.items() if k != "valid"}
+        for slot in range(d):
+            put = valid & (delay == slot + 1)
+            if fuzz.p_dup > 0:
+                put = put | (valid & dup & (dup_delay == slot + 1))
+            wvalid = wvalid.at[slot].set(wvalid[slot] | put)
+            for f in wfields:
+                wfields[f] = wfields[f].at[slot].set(
+                    jnp.where(put, box[f], wfields[f][slot]))
+        new_wheel[name] = {"valid": wvalid, **wfields}
+    return new_wheel

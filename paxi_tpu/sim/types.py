@@ -1,0 +1,110 @@
+"""Static configs and the protocol-plugin interface for the TPU sim runtime.
+
+The reference's plugin boundary is ``Node.Register(msgType, handler)`` +
+``Replica.Run()`` (node.go) [driver].  The sim runtime's equivalent: a
+protocol provides
+
+- a *mailbox spec* (message types and their int32 fields — the gob-
+  registration analog, codec.go),
+- ``init_state(cfg, rng)`` building a per-group struct-of-arrays pytree,
+- a pure ``step(state, inbox, ctx) -> (state, outbox)`` transition
+  (all handlers fused, fully masked — no data-dependent control flow),
+- per-step ``invariants`` (the safety oracle; generalizes history.go's
+  linearizability check), and ``metrics``.
+
+The runner vmaps ``step`` over the group axis, drives a lock-step
+message exchange with a fuzz schedule, and scans over steps.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Callable, Dict, NamedTuple, Tuple
+
+import jax
+
+Array = jax.Array
+State = Dict[str, Array]
+Mailboxes = Dict[str, Dict[str, Array]]
+
+
+@dataclass(frozen=True)
+class SimConfig:
+    """Static (hashable) per-protocol group geometry; jit static arg.
+
+    Mirrors the knobs of config.go that matter inside the kernel.
+    """
+
+    n_replicas: int = 3
+    n_slots: int = 64          # log window (reference log is unbounded map)
+    n_keys: int = 16           # KV key-space inside the sim
+    n_zones: int = 1           # zone grid rows (WPaxos); R % zones == 0
+    exec_window: int = 4       # max slots executed per replica per step
+    ballot_stride: int = 64    # ballot = round*stride + replica_idx
+    election_timeout: int = 8  # steps without leader activity before P1a
+    backoff: int = 8           # randomized extra timeout (anti-dueling)
+    retry_timeout: int = 6     # steps with a stuck frontier before re-propose
+    # protocol-specific extras (ignored by protocols that don't use them)
+    n_objects: int = 8         # WPaxos: per-key paxos objects per group
+    steal_threshold: int = 3   # WPaxos policy.go threshold analog
+    fast_quorum: bool = True   # EPaxos fast path enabled
+
+    @property
+    def majority(self) -> int:
+        return self.n_replicas // 2 + 1
+
+    @property
+    def fast_size(self) -> int:
+        return -(-3 * self.n_replicas // 4)  # ceil(3N/4)
+
+    def with_(self, **kw) -> "SimConfig":
+        return replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class FuzzConfig:
+    """Randomized fault schedule applied at the message exchange.
+
+    Vectorized generalization of socket.go's fault injection surface
+    (Crash/Drop/Slow/Flaky) [driver: drop/dup/reorder/partition].
+    ``max_delay=1`` and all probabilities 0 => fault-free lock-step.
+    """
+
+    max_delay: int = 1         # messages arrive after 1..max_delay steps
+    p_drop: float = 0.0        # per-message drop probability (Flaky)
+    p_dup: float = 0.0         # per-message duplication probability
+    p_crash: float = 0.0       # per-replica comms-crash prob per window
+    p_partition: float = 0.0   # prob a window has a random bipartition
+    window: int = 16           # steps between fault-schedule resamples
+
+    @property
+    def wheel(self) -> int:
+        return max(self.max_delay, 1)
+
+    @property
+    def faulty(self) -> bool:
+        return (self.p_drop > 0 or self.p_dup > 0 or self.p_crash > 0
+                or self.p_partition > 0 or self.max_delay > 1)
+
+
+FAULT_FREE = FuzzConfig()
+
+
+class StepCtx(NamedTuple):
+    """Per-step context handed to protocol transition functions."""
+
+    rng: Array      # per-group PRNG key for this step
+    t: Array        # step index (traced scalar)
+    cfg: SimConfig  # static geometry
+
+
+@dataclass(frozen=True)
+class SimProtocol:
+    """A protocol plugin for the TPU sim runtime (see module docstring)."""
+
+    name: str
+    mailbox_spec: Callable[[SimConfig], Dict[str, Tuple[str, ...]]]
+    init_state: Callable[[SimConfig, Array], State]
+    step: Callable[[State, Mailboxes, StepCtx], Tuple[State, Mailboxes]]
+    metrics: Callable[[State, SimConfig], Dict[str, Array]]
+    invariants: Callable[[State, State, SimConfig], Array]

@@ -1,0 +1,13 @@
+"""Test env: force CPU with 8 virtual devices BEFORE jax initializes.
+
+Multi-chip sharding is validated on this virtual mesh (real multi-chip
+hardware is not available in CI); bench.py runs on the real TPU.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,98 @@
+"""Unit tests for core types (ID, Config, Database, Quorum, Command).
+
+Mirrors the reference's root *_test.go coverage (quorum logic, ID parsing,
+config load) per SURVEY.md §4.
+"""
+
+import json
+
+from paxi_tpu.core import (ID, Bconfig, Command, Config, Database, Quorum,
+                           Reply, Request)
+from paxi_tpu.core.config import local_config
+from paxi_tpu.core.ident import new_id
+from paxi_tpu.core.quorum import fast_quorum_size, majority_size
+
+
+def test_id_parse_and_order():
+    i = ID("2.3")
+    assert i.zone == 2 and i.node == 3
+    assert new_id(1, 10) == ID("1.10")
+    assert ID("1.2") < ID("1.10") < ID("2.1")  # numeric, not lexical
+    assert ID("7") == ID("1.7")  # bare node number -> zone 1
+
+
+def test_config_json_roundtrip(tmp_path):
+    cfg = local_config(6, zones=2)
+    assert cfg.n == 6 and cfg.zones() == [1, 2] and cfg.npz() == 3
+    p = tmp_path / "config.json"
+    cfg.to_json(str(p))
+    cfg2 = Config.from_json(str(p))
+    assert cfg2.addrs == cfg.addrs
+    assert cfg2.http_addrs == cfg.http_addrs
+    assert cfg2.index(ID("2.3")) == 5
+
+
+def test_config_paxi_schema():
+    # a paxi-style config.json loads unchanged
+    d = {
+        "address": {"1.1": "tcp://127.0.0.1:1735", "1.2": "tcp://127.0.0.1:1736"},
+        "http_address": {"1.1": "http://127.0.0.1:8080", "1.2": "http://127.0.0.1:8081"},
+        "policy": "majority",
+        "threshold": 0.7,
+        "benchmark": {"T": 5, "K": 100, "W": 0.9, "concurrency": 4,
+                      "distribution": "zipfian", "LinearizabilityCheck": True},
+    }
+    cfg = Config.from_dict(json.loads(json.dumps(d)))
+    assert cfg.n == 2 and cfg.policy == "majority"
+    assert cfg.benchmark.K == 100 and cfg.benchmark.W == 0.9
+    assert cfg.benchmark.distribution == "zipfian"
+    assert cfg.benchmark.linearizability_check
+
+
+def test_database_execute():
+    db = Database(multi_version=True)
+    w = Command(key=1, value=b"a", client_id="c1", command_id=1)
+    r = Command(key=1, value=b"", client_id="c1", command_id=2)
+    assert w.is_write() and r.is_read()
+    assert db.execute(w) == b""       # returns previous value
+    assert db.execute(r) == b"a"
+    db.execute(Command(1, b"b"))
+    assert db.history(1) == [b"a", b"b"]
+    assert db.get(1) == b"b"
+
+
+def test_quorum_majority_and_fast():
+    ids = [ID(f"1.{i}") for i in range(1, 6)]
+    q = Quorum(ids)
+    q.ack(ids[0]); q.ack(ids[1])
+    assert not q.majority()
+    q.ack(ids[2])
+    assert q.majority() and not q.all()
+    assert majority_size(5) == 3 and fast_quorum_size(5) == 4
+    q.ack(ids[3])
+    assert q.fast_quorum()
+    q.ack(ids[0])  # duplicate ack is idempotent
+    assert q.size() == 4
+
+
+def test_quorum_zones_grid():
+    ids = [new_id(z, n) for z in (1, 2, 3) for n in (1, 2, 3)]
+    q = Quorum(ids)
+    for n in (1, 2):
+        q.ack(new_id(1, n))
+    assert q.zone_majority(1) and not q.zone_majority(2)
+    for n in (1, 2):
+        q.ack(new_id(2, n))
+    assert q.grid_q1(2)       # zone-majorities in 2 zones
+    assert not q.grid_q1(3)
+
+
+def test_request_wire_strips_reply_channel():
+    got = []
+    req = Request(Command(5, b"x"), node_id="1.1", reply_to=got.append)
+    wire = req.wire()
+    assert "reply_to" not in wire and "c" not in wire
+    back = Request.from_wire(wire)
+    assert back.command.key == 5 and back.reply_to is None
+    req.reply(Reply(req.command, b"ok"))
+    assert got and got[0].value == b"ok"

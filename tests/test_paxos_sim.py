@@ -1,0 +1,97 @@
+"""Multi-Paxos TPU-sim kernel tests: progress, safety, fuzzing.
+
+This is the sim-runtime analog of the reference's de-facto integration
+harness (`-simulation` mode + linearizability check, SURVEY.md §4): run
+full protocol stacks in-process and assert zero safety violations.
+"""
+
+import jax.numpy as jnp
+import jax.random as jr
+import pytest
+
+from paxi_tpu.protocols import sim_protocol
+from paxi_tpu.sim import FuzzConfig, SimConfig, simulate
+
+PAXOS = sim_protocol("paxos")
+
+
+def run(groups=4, steps=60, fuzz=None, seed=0, **cfg_kw):
+    cfg = SimConfig(**{"n_replicas": 3, "n_slots": 64, **cfg_kw})
+    return simulate(PAXOS, cfg, groups, steps,
+                    fuzz=fuzz or FuzzConfig(), seed=seed), cfg
+
+
+def test_fault_free_progress_and_agreement():
+    res, cfg = run(groups=4, steps=60)
+    assert int(res.violations) == 0
+    # steady state commits ~1 slot/group/step after ~4 warmup steps
+    per_group = res.state["execute"].max(axis=1)
+    assert (per_group >= 60 - 10).all(), per_group
+    # all groups elected a leader
+    assert int(res.metrics["has_leader"]) == 4
+    # committed prefix identical across replicas in every group
+    execute = res.state["execute"]
+    log_cmd = res.state["log_cmd"]
+    log_commit = res.state["log_commit"]
+    n_common = int(execute.min())
+    assert n_common > 20
+    for g in range(4):
+        ref_row = log_cmd[g, 0, :n_common]
+        assert bool(log_commit[g, :, :n_common].all())
+        assert bool((log_cmd[g, :, :n_common] == ref_row[None, :]).all())
+
+
+def test_five_replicas():
+    res, _ = run(groups=3, steps=50, n_replicas=5)
+    assert int(res.violations) == 0
+    assert (res.state["execute"].max(axis=1) >= 30).all()
+
+
+def test_followers_catch_up():
+    res, _ = run(groups=2, steps=60)
+    # every replica's frontier advances (P3 upto-commit works), within the
+    # pipeline lag of the leader
+    assert (res.state["execute"] >= 40).all()
+
+
+def test_deterministic():
+    r1, _ = run(groups=3, steps=40, seed=7)
+    r2, _ = run(groups=3, steps=40, seed=7)
+    assert (r1.state["log_cmd"] == r2.state["log_cmd"]).all()
+    assert int(r1.violations) == int(r2.violations) == 0
+
+
+@pytest.mark.parametrize("fuzz", [
+    FuzzConfig(p_drop=0.1),
+    FuzzConfig(max_delay=3),
+    FuzzConfig(p_drop=0.05, p_dup=0.1, max_delay=2),
+    FuzzConfig(p_partition=0.3, window=12),
+    FuzzConfig(p_crash=0.2, window=16),
+    FuzzConfig(p_drop=0.1, p_dup=0.05, max_delay=3, p_partition=0.2,
+               p_crash=0.1, window=10),
+])
+def test_fuzzed_safety(fuzz):
+    """Safety under drop/dup/reorder/partition/crash schedules [driver]."""
+    res, _ = run(groups=16, steps=150, fuzz=fuzz, seed=3)
+    assert int(res.violations) == 0
+    # liveness is best-effort under faults, but *some* group must commit
+    assert int(res.state["execute"].max()) > 0
+
+
+def test_fuzzed_recovery_live():
+    """After faults stop, a clean run would keep committing; here we just
+    check heavy fuzz still commits in a majority of groups."""
+    fuzz = FuzzConfig(p_drop=0.2, max_delay=2)
+    res, _ = run(groups=16, steps=200, fuzz=fuzz, seed=11)
+    assert int(res.violations) == 0
+    committed = (res.state["execute"].max(axis=1) > 5).sum()
+    assert int(committed) >= 12
+
+
+def test_commands_unique_per_slot():
+    res, _ = run(groups=2, steps=40)
+    # no two committed slots share a command id within a replica log
+    for g in range(2):
+        n = int(res.state["execute"][g].min())
+        cmds = res.state["log_cmd"][g, 0, :n]
+        assert len(set(cmds.tolist())) == n
