@@ -1,0 +1,68 @@
+"""North-star benchmark: committed Paxos slots/sec over simulated groups.
+
+Target (BASELINE.json `north_star`): 10M committed slots across 100k
+simulated 5-replica groups, with per-step safety-invariant checks, in
+<60s => >= 166,667 slots/s sustained.  Prints ONE JSON line.
+
+Runs on whatever jax.devices() provides (the real TPU chip under axon;
+CPU fallback works but is slow).  Compile time is excluded by a warmup
+run of the same shape.
+"""
+
+import json
+import os
+import sys
+import time
+
+BASELINE_SLOTS_PER_SEC = 10_000_000 / 60.0
+
+
+def main():
+    import jax
+    from paxi_tpu.utils import ensure_env_platform
+    ensure_env_platform()
+    import jax.random as jr
+    from paxi_tpu.protocols import sim_protocol
+    from paxi_tpu.sim import SimConfig, make_run
+
+    n_groups = int(os.environ.get("BENCH_GROUPS", 100_000))
+    n_replicas = int(os.environ.get("BENCH_REPLICAS", 5))
+    target_slots = int(os.environ.get("BENCH_SLOTS", 10_000_000))
+    # steady state commits 1 slot/group/step after a 4-step warmup
+    n_steps = -(-target_slots // n_groups) + 4
+    n_slots = n_steps + 8  # log window covers the horizon
+
+    proto = sim_protocol("paxos")
+    cfg = SimConfig(n_replicas=n_replicas, n_slots=n_slots)
+    run = make_run(proto, cfg)
+
+    # warmup: compile the exact executable
+    out = run(jr.PRNGKey(1), n_groups, n_steps)
+    jax.block_until_ready(out)
+
+    t0 = time.perf_counter()
+    state, metrics, viols = run(jr.PRNGKey(0), n_groups, n_steps)
+    jax.block_until_ready(viols)
+    dt = time.perf_counter() - t0
+
+    committed = int(metrics["committed_slots"])
+    slots_per_sec = committed / dt
+    result = {
+        "metric": "committed_paxos_slots_per_sec_100k_groups",
+        "value": round(slots_per_sec, 1),
+        "unit": "slots/s",
+        "vs_baseline": round(slots_per_sec / BASELINE_SLOTS_PER_SEC, 3),
+        "committed_slots": committed,
+        "wall_s": round(dt, 3),
+        "invariant_violations": int(viols),
+        "groups": n_groups,
+        "replicas": n_replicas,
+        "steps": n_steps,
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(result))
+    return 0 if int(viols) == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,5 @@
+"""Device-mesh parallelism for the sim runtime."""
+
+from paxi_tpu.parallel.mesh import make_mesh, make_sharded_run
+
+__all__ = ["make_mesh", "make_sharded_run"]

@@ -60,23 +60,30 @@ def _group_step(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
     return (new_state, wheel, fs, rng), viol
 
 
+def make_scan_body(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig):
+    """The vmapped per-step transition shared by make_run, the sharded
+    runner (parallel/mesh.py) and the driver entry point."""
+
+    def body(carry, t):
+        step1 = functools.partial(_group_step, proto, cfg, fuzz)
+        carry, viol = jax.vmap(step1, in_axes=(0, None))(carry, t)
+        return carry, jnp.sum(viol)
+
+    return body
+
+
 def make_run(proto: SimProtocol, cfg: SimConfig,
-             fuzz: FuzzConfig = FAULT_FREE, donate: bool = True):
+             fuzz: FuzzConfig = FAULT_FREE):
     """Build ``run(rng, n_groups, n_steps) -> SimResult`` (jitted).
 
     n_groups / n_steps are static; the whole simulation is one XLA
     computation (scan over steps of a vmapped group transition).
     """
+    body = make_scan_body(proto, cfg, fuzz)
 
     @functools.partial(jax.jit, static_argnums=(1, 2))
     def run(rng, n_groups: int, n_steps: int):
         carry = init_carry(proto, cfg, fuzz, n_groups, rng)
-
-        def body(carry, t):
-            step1 = functools.partial(_group_step, proto, cfg, fuzz)
-            carry, viol = jax.vmap(step1, in_axes=(0, None))(carry, t)
-            return carry, jnp.sum(viol)
-
         carry, viols = jax.lax.scan(body, carry, jnp.arange(n_steps))
         state = carry[0]
         per_group = jax.vmap(lambda s: proto.metrics(s, cfg))(state)

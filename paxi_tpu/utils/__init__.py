@@ -1,0 +1,5 @@
+"""Utilities: platform selection, logging."""
+
+from paxi_tpu.utils.platform import ensure_env_platform
+
+__all__ = ["ensure_env_platform"]

@@ -1,0 +1,16 @@
+"""Platform selection helper.
+
+Some environments install a site customization that imports jax at
+interpreter startup and overrides ``jax_platforms``; entry points call
+:func:`ensure_env_platform` so the caller's ``JAX_PLATFORMS`` env var
+(e.g. ``cpu`` with ``--xla_force_host_platform_device_count``) wins.
+"""
+
+import os
+
+
+def ensure_env_platform() -> None:
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+        jax.config.update("jax_platforms", want)

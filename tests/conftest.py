@@ -1,13 +1,22 @@
-"""Test env: force CPU with 8 virtual devices BEFORE jax initializes.
+"""Test env: force CPU with 8 virtual devices.
 
 Multi-chip sharding is validated on this virtual mesh (real multi-chip
 hardware is not available in CI); bench.py runs on the real TPU.
+
+NOTE: this environment's site customization imports jax at interpreter
+startup (PJRT plugin registration), so JAX_PLATFORMS from os.environ is
+already bound before conftest runs.  ``jax.config.update`` still works
+because no backend has been *initialized* yet; XLA_FLAGS is read lazily
+at CPU-client creation, so the env assignment below is effective too.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,70 @@
+"""Wire codec round-trip tests (the gob codec analog, SURVEY.md §2.1)."""
+
+from dataclasses import dataclass, field
+
+import pickle
+import pytest
+
+from paxi_tpu.host.codec import Codec, decode_from, register_message
+
+
+@register_message
+@dataclass
+class _Ping:
+    n: int
+    blob: bytes = b""
+    tags: list = field(default_factory=list)
+
+
+@register_message
+@dataclass
+class _Wrap:
+    inner: _Ping
+    note: str = ""
+
+
+@pytest.mark.parametrize("kind", ["json", "pickle"])
+def test_roundtrip(kind):
+    c = Codec(kind)
+    msg = _Ping(7, b"\x00\xffbytes", [1, "a"])
+    buf = c.encode(msg)
+    got, rest = decode_from(c, buf)
+    assert got == msg and rest == b""
+
+
+@pytest.mark.parametrize("kind", ["json", "pickle"])
+def test_nested_message(kind):
+    c = Codec(kind)
+    msg = _Wrap(_Ping(1, b"x"), note="n")
+    got, _ = decode_from(c, c.encode(msg))
+    assert got == msg and isinstance(got.inner, _Ping)
+
+
+def test_partial_frames_buffered():
+    c = Codec("json")
+    buf = c.encode(_Ping(1)) + c.encode(_Ping(2))
+    m1, rest = decode_from(c, buf[:10])
+    assert m1 is None and rest == buf[:10]
+    m1, rest = decode_from(c, buf)
+    m2, rest = decode_from(c, rest)
+    assert m1.n == 1 and m2.n == 2 and rest == b""
+
+
+def test_unregistered_type_rejected():
+    @dataclass
+    class Nope:
+        x: int = 0
+
+    with pytest.raises(TypeError, match="not registered"):
+        Codec("json").encode(Nope())
+
+
+def test_pickle_payload_cannot_smuggle_arbitrary_types():
+    """A hostile frame naming a non-registered class must not unpickle."""
+    c = Codec("pickle")
+    evil = pickle.dumps(ValueError("boom"))  # stand-in for a gadget
+    tag = b"_Ping"
+    body = bytes([Codec.PICKLE, len(tag)]) + tag + evil
+    frame = len(body).to_bytes(4, "big") + body
+    with pytest.raises(pickle.UnpicklingError, match="not a registered"):
+        decode_from(c, frame)
