@@ -1,0 +1,258 @@
+"""ABD (Attiya-Bar-Noy-Dolev) atomic register as a pure TPU kernel.
+
+Reference: paxi abd/ — crash-only **linearizable multi-writer register**
+with no consensus: a read queries all replicas, waits for a majority,
+picks the max-timestamp value and *writes it back* to a majority; a write
+queries a majority for the current timestamp and writes ts+1 (writer id
+as tiebreak) to a majority [driver: "crash-only linearizable register"].
+Two ``paxi.Quorum`` rounds per op (abd/abd.go Get/Set phases).
+
+TPU re-design:
+- Every replica is also a closed-loop client issuing alternating
+  read/write ops on hashed keys (benchmark.go's generator collapsed into
+  the kernel, as in the paxos kernel).
+- Per-op state machine is fully masked: ``phase`` in {0 idle, 1 query
+  round, 2 store round}; quorum = popcount over an ack row.
+- Timestamps encode the writer: ``ts = round * stride + writer`` (the
+  (n, id) lexicographic pair of the paper packed into one int32).
+- Values are a deterministic function of ts, so "register holds
+  (ts, val) with val != f(ts)" is a per-step checkable corruption
+  invariant.
+- The linearizability oracle is *built in*: the group tracks the max
+  completed-op timestamp per key; an op snapshots it at start, and
+  completing with a smaller timestamp is an atomicity violation
+  (an op that starts after another completes must not see older state —
+  precisely the atomic-register condition history.go checks offline).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+
+IDLE, QUERY, STORE = 0, 1, 2
+
+
+def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
+    return {
+        "query": ("key", "tag"),
+        "query_r": ("tag", "ts", "val"),
+        "store": ("key", "tag", "ts", "val"),
+        "store_r": ("tag",),
+    }
+
+
+def encode_val(ts):
+    """Deterministic register payload for a write with timestamp ts."""
+    return ts * jnp.int32(7) + jnp.int32(13)
+
+
+def op_key_for(ridx, seq, n_keys):
+    """Per-op key choice (uniform-ish hash of (replica, seq))."""
+    h = (seq * jnp.int32(31) + ridx) * jnp.int32(-1640531527)
+    return jnp.abs(h) % n_keys
+
+
+def init_state(cfg: SimConfig, rng: jax.Array):
+    R, K = cfg.n_replicas, cfg.n_keys
+    del rng
+    return dict(
+        store_ts=jnp.zeros((R, K), jnp.int32),
+        store_val=jnp.zeros((R, K), jnp.int32),
+        phase=jnp.zeros((R,), jnp.int32),
+        op_read=jnp.zeros((R,), bool),
+        op_key=jnp.zeros((R,), jnp.int32),
+        op_tag=jnp.zeros((R,), jnp.int32),
+        op_ts=jnp.zeros((R,), jnp.int32),
+        op_val=jnp.zeros((R,), jnp.int32),
+        op_snap=jnp.zeros((R,), jnp.int32),   # oracle snapshot at op start
+        op_age=jnp.zeros((R,), jnp.int32),    # steps in current phase (retry)
+        acks=jnp.zeros((R, R), bool),
+        best_ts=jnp.zeros((R,), jnp.int32),
+        best_val=jnp.zeros((R,), jnp.int32),
+        seq=jnp.zeros((R,), jnp.int32),       # per-replica op counter
+        reads_done=jnp.zeros((R,), jnp.int32),
+        writes_done=jnp.zeros((R,), jnp.int32),
+        done_max_ts=jnp.zeros((K,), jnp.int32),  # oracle: max completed ts/key
+        atomic_viol=jnp.zeros((), jnp.int32),
+    )
+
+
+def step(state, inbox, ctx: StepCtx):
+    cfg = ctx.cfg
+    R, K = cfg.n_replicas, cfg.n_keys
+    MAJ, STRIDE = cfg.majority, cfg.ballot_stride
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    kidx = jnp.arange(K, dtype=jnp.int32)
+
+    store_ts, store_val = state["store_ts"], state["store_val"]
+    phase = state["phase"]
+    acks = state["acks"]
+    best_ts, best_val = state["best_ts"], state["best_val"]
+
+    # ------------- serve "query": reply with local (ts, val) -------------
+    m = inbox["query"]
+    qv = m["valid"].T                       # (dst_me, src)
+    qkey = jnp.clip(m["key"].T, 0, K - 1)
+    out_query_r = {
+        "valid": qv,
+        "tag": m["tag"].T,
+        "ts": jnp.take_along_axis(store_ts, qkey, axis=1),
+        "val": jnp.take_along_axis(store_val, qkey, axis=1),
+    }
+
+    # ------------- serve "store": apply max-ts write per key, ack --------
+    m = inbox["store"]
+    sv = m["valid"].T                       # (me, src)
+    skey, sts, sval = m["key"].T, m["ts"].T, m["val"].T
+    hit = sv[:, :, None] & (kidx[None, None, :] == skey[:, :, None])  # (me,src,K)
+    cand_ts = jnp.max(jnp.where(hit, sts[:, :, None], -1), axis=1)    # (me,K)
+    cand_src = jnp.argmax(jnp.where(hit, sts[:, :, None], -1), axis=1)
+    cand_val = sval[ridx[:, None], cand_src]
+    newer = cand_ts > store_ts
+    store_ts = jnp.where(newer, cand_ts, store_ts)
+    store_val = jnp.where(newer, cand_val, store_val)
+    out_store_r = {"valid": sv, "tag": m["tag"].T}
+
+    # ------------- collect replies for my in-flight op -------------------
+    m = inbox["query_r"]
+    ok = (m["valid"].T & (m["tag"].T == state["op_tag"][:, None])
+          & (phase == QUERY)[:, None])
+    r_ts = jnp.where(ok, m["ts"].T, -1)
+    in_best = jnp.max(r_ts, axis=1)
+    in_src = jnp.argmax(r_ts, axis=1)
+    in_val = m["val"].T[ridx, in_src]
+    better = in_best > best_ts
+    best_val = jnp.where(better, in_val, best_val)
+    best_ts = jnp.maximum(best_ts, in_best)
+    acks = acks | ok
+
+    m = inbox["store_r"]
+    ok2 = (m["valid"].T & (m["tag"].T == state["op_tag"][:, None])
+           & (phase == STORE)[:, None])
+    acks = acks | ok2
+
+    n_acks = jnp.sum(acks, axis=1)
+
+    # ------------- phase 1 -> 2: choose (ts, val), broadcast store -------
+    q_done = (phase == QUERY) & (n_acks >= MAJ)
+    w_ts = (best_ts // STRIDE + 1) * STRIDE + ridx   # write: bump round
+    op_ts = jnp.where(q_done,
+                      jnp.where(state["op_read"], best_ts, w_ts),
+                      state["op_ts"])
+    op_val = jnp.where(q_done,
+                       jnp.where(state["op_read"], best_val,
+                                 encode_val(w_ts)),
+                       state["op_val"])
+    # write-back / write applies to own store immediately (self-ack)
+    oh = q_done[:, None] & (kidx[None, :] == state["op_key"][:, None])
+    upd = oh & (op_ts[:, None] > store_ts)
+    store_ts = jnp.where(upd, op_ts[:, None], store_ts)
+    store_val = jnp.where(upd, op_val[:, None], store_val)
+    phase = jnp.where(q_done, STORE, phase)
+    acks = jnp.where(q_done[:, None], ridx[None, :] == ridx[:, None], acks)
+    n_acks = jnp.sum(acks, axis=1)
+
+    # ------------- phase 2 done: op completes, oracle check --------------
+    s_done = (phase == STORE) & (n_acks >= MAJ) & ~q_done
+    # atomicity: completing op must not carry ts older than any op that
+    # completed before it started
+    viol = jnp.sum(s_done & (op_ts < state["op_snap"]))
+    atomic_viol = state["atomic_viol"] + viol
+    reads_done = state["reads_done"] + (s_done & state["op_read"])
+    writes_done = state["writes_done"] + (s_done & ~state["op_read"])
+    dhit = s_done[:, None] & (kidx[None, :] == state["op_key"][:, None])
+    done_max_ts = jnp.maximum(
+        state["done_max_ts"],
+        jnp.max(jnp.where(dhit, op_ts[:, None], -1), axis=0))
+    phase = jnp.where(s_done, IDLE, phase)
+
+    # ------------- idle: start next op (alternate write/read) ------------
+    start = phase == IDLE
+    seq = state["seq"] + start
+    new_read = (seq % 2) == 0
+    new_key = op_key_for(ridx, seq, K)
+    new_tag = seq * R + ridx  # globally unique per op
+    op_read = jnp.where(start, new_read, state["op_read"])
+    op_keyv = jnp.where(start, new_key, state["op_key"])
+    op_tag = jnp.where(start, new_tag, state["op_tag"])
+    op_snap = jnp.where(
+        start, state["done_max_ts"][jnp.clip(new_key, 0, K - 1)],
+        state["op_snap"])
+    # local contribution to the query round
+    self_ts = jnp.take_along_axis(store_ts, op_keyv[:, None], axis=1)[:, 0]
+    self_val = jnp.take_along_axis(store_val, op_keyv[:, None], axis=1)[:, 0]
+    best_ts = jnp.where(start, self_ts, best_ts)
+    best_val = jnp.where(start, self_val, best_val)
+    acks = jnp.where(start[:, None], ridx[None, :] == ridx[:, None], acks)
+    phase = jnp.where(start, QUERY, phase)
+    op_ts = jnp.where(start, 0, op_ts)
+    op_val = jnp.where(start, 0, op_val)
+
+    # ------------- emit my round's broadcast (with fuzz retry) -----------
+    op_age = jnp.where(start | q_done | s_done, 0, state["op_age"] + 1)
+    resend = op_age >= cfg.retry_timeout
+    op_age = jnp.where(resend, 0, op_age)
+    send_q = (phase == QUERY) & (start | resend)
+    send_s = (phase == STORE) & (q_done | resend)
+    out_query = {
+        "valid": jnp.broadcast_to(send_q[:, None], (R, R)),
+        "key": jnp.broadcast_to(op_keyv[:, None], (R, R)),
+        "tag": jnp.broadcast_to(op_tag[:, None], (R, R)),
+    }
+    out_store = {
+        "valid": jnp.broadcast_to(send_s[:, None], (R, R)),
+        "key": jnp.broadcast_to(op_keyv[:, None], (R, R)),
+        "tag": jnp.broadcast_to(op_tag[:, None], (R, R)),
+        "ts": jnp.broadcast_to(op_ts[:, None], (R, R)),
+        "val": jnp.broadcast_to(op_val[:, None], (R, R)),
+    }
+
+    new_state = dict(
+        store_ts=store_ts, store_val=store_val, phase=phase,
+        op_read=op_read, op_key=op_keyv, op_tag=op_tag, op_ts=op_ts,
+        op_val=op_val, op_snap=op_snap, op_age=op_age, acks=acks,
+        best_ts=best_ts, best_val=best_val, seq=seq,
+        reads_done=reads_done, writes_done=writes_done,
+        done_max_ts=done_max_ts, atomic_viol=atomic_viol,
+    )
+    outbox = {"query": out_query, "query_r": out_query_r,
+              "store": out_store, "store_r": out_store_r}
+    return new_state, outbox
+
+
+def metrics(state, cfg: SimConfig):
+    return {
+        "ops_done": jnp.sum(state["reads_done"] + state["writes_done"]),
+        "reads_done": jnp.sum(state["reads_done"]),
+        "writes_done": jnp.sum(state["writes_done"]),
+        # committed_slots keeps the runner/bench metric name uniform
+        "committed_slots": jnp.sum(state["reads_done"]
+                                   + state["writes_done"]),
+    }
+
+
+def invariants(old, new, cfg: SimConfig) -> jax.Array:
+    """1. Atomicity (in-kernel oracle delta).  2. Per-replica register
+    timestamps never regress.  3. Register (ts, val) pairs are always
+    consistent with the writer encoding."""
+    v_atomic = new["atomic_viol"] - old["atomic_viol"]
+    v_mono = jnp.sum(new["store_ts"] < old["store_ts"])
+    held = new["store_ts"] > 0
+    v_consist = jnp.sum(held
+                        & (new["store_val"] != encode_val(new["store_ts"])))
+    return (v_atomic + v_mono + v_consist).astype(jnp.int32)
+
+
+PROTOCOL = SimProtocol(
+    name="abd",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=step,
+    metrics=metrics,
+    invariants=invariants,
+)
