@@ -1,0 +1,148 @@
+"""Client library: REST KV client + admin (fault-injection) client.
+
+Reference: paxi client.go — ``Client.Get(Key)`` / ``Put(Key, Value)``
+over HTTP to ``HTTPAddrs[id]``, with retry against other replicas when
+the contacted one fails, and ``AdminClient`` wrapping the fault-
+injection endpoints [high].  Stdlib-only asyncio implementation with one
+keep-alive connection per contacted node.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional, Tuple
+
+from paxi_tpu.core.command import Key, Value
+from paxi_tpu.core.config import Config
+from paxi_tpu.core.ident import ID
+from paxi_tpu.host.http import read_request  # noqa: F401 (API symmetry)
+from paxi_tpu.host.transport import parse_addr
+
+
+class _Conn:
+    def __init__(self, url: str):
+        self.url = url
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+
+    async def ensure(self) -> None:
+        if self.writer is None or self.writer.is_closing():
+            _, host, port = parse_addr(self.url)
+            self.reader, self.writer = await asyncio.open_connection(
+                host, port)
+
+    async def request(self, method: str, path: str,
+                      headers: Dict[str, str], body: bytes
+                      ) -> Tuple[int, Dict[str, str], bytes]:
+        await self.ensure()
+        head = [f"{method} {path} HTTP/1.1",
+                f"Content-Length: {len(body)}"]
+        head += [f"{k}: {v}" for k, v in headers.items()]
+        self.writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + body)
+        await self.writer.drain()
+        status_line = await self.reader.readline()
+        status = int(status_line.split()[1])
+        resp_headers: Dict[str, str] = {}
+        while True:
+            h = await self.reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.decode().partition(":")
+            resp_headers[k.strip().lower()] = v.strip()
+        n = int(resp_headers.get("content-length", "0"))
+        payload = await self.reader.readexactly(n) if n else b""
+        return status, resp_headers, payload
+
+    def close(self) -> None:
+        if self.writer:
+            self.writer.close()
+            self.writer = None
+
+
+class Client:
+    """Async KV client.  ``id`` picks the initially-contacted replica
+    (clients usually talk to their own zone's node, client.go)."""
+
+    def __init__(self, cfg: Config, id: Optional[ID] = None,
+                 client_id: str = "c1"):
+        self.cfg = cfg
+        self.id = ID(id) if id else cfg.ids[0]
+        self.client_id = client_id
+        self.command_id = 0
+        self._conns: Dict[ID, _Conn] = {}
+
+    def _conn(self, id: ID) -> _Conn:
+        if id not in self._conns:
+            self._conns[id] = _Conn(self.cfg.http_addrs[id])
+        return self._conns[id]
+
+    async def _rest(self, id: ID, method: str, key: Key, value: Value
+                    ) -> Value:
+        self.command_id += 1
+        status, headers, payload = await self._conn(id).request(
+            method, f"/{key}",
+            {"Client-Id": self.client_id,
+             "Command-Id": str(self.command_id)},
+            value)
+        if status != 200:
+            raise IOError(headers.get("err", f"http {status}"))
+        return payload
+
+    async def _with_retry(self, method: str, key: Key, value: Value) -> Value:
+        """Try own node first, then every other replica (client.go retry)."""
+        last: Exception = IOError("no nodes configured")
+        for id in [self.id] + [i for i in self.cfg.ids if i != self.id]:
+            if id not in self.cfg.http_addrs:
+                continue
+            try:
+                return await self._rest(id, method, key, value)
+            except (IOError, OSError, asyncio.IncompleteReadError) as e:
+                self._conns.pop(id, None)
+                last = e
+        raise last
+
+    async def get(self, key: Key) -> Value:
+        return await self._with_retry("GET", key, b"")
+
+    async def put(self, key: Key, value: Value) -> None:
+        await self._with_retry("PUT", key, value)
+
+    def close(self) -> None:
+        for c in self._conns.values():
+            c.close()
+        self._conns.clear()
+
+
+class AdminClient:
+    """Reference: client.go AdminClient — drive /admin fault injection."""
+
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self._conns: Dict[ID, _Conn] = {}
+
+    def _conn(self, id: ID) -> _Conn:
+        if id not in self._conns:
+            self._conns[id] = _Conn(self.cfg.http_addrs[ID(id)])
+        return self._conns[id]
+
+    async def _admin(self, id: ID, path: str) -> None:
+        status, headers, _ = await self._conn(ID(id)).request(
+            "POST", path, {}, b"")
+        if status != 200:
+            raise IOError(headers.get("err", f"http {status}"))
+
+    async def crash(self, id: ID, t: float) -> None:
+        await self._admin(id, f"/admin/crash?t={t}")
+
+    async def drop(self, frm: ID, to: ID, t: float) -> None:
+        await self._admin(frm, f"/admin/drop?id={to}&t={t}")
+
+    async def slow(self, frm: ID, to: ID, delay_ms: float, t: float) -> None:
+        await self._admin(frm, f"/admin/slow?id={to}&delay={delay_ms}&t={t}")
+
+    async def flaky(self, frm: ID, to: ID, p: float, t: float) -> None:
+        await self._admin(frm, f"/admin/flaky?id={to}&p={p}&t={t}")
+
+    def close(self) -> None:
+        for c in self._conns.values():
+            c.close()

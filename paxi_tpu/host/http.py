@@ -1,0 +1,146 @@
+"""The client-facing REST API, on asyncio streams (stdlib only).
+
+Reference: paxi node.go/http.go — ``GET /{key}`` reads, ``PUT|POST
+/{key}`` writes (body = value); headers carry ClientID/CommandID; the
+handler synthesizes a ``paxi.Request`` with a reply channel and waits;
+admin endpoints expose the fault-injection surface (``/crash``,
+``/drop``, …) and ``/history`` [high].
+
+Headers:
+- request:  ``Client-Id``, ``Command-Id``, and arbitrary ``Property-*``
+- response: ``Err`` (error string, body empty) on failure
+
+Admin (AdminClient surface):
+- ``POST /admin/crash?t=SECONDS``
+- ``POST /admin/drop?id=ZONE.NODE&t=SECONDS``
+- ``POST /admin/slow?id=..&delay=MS&t=SECONDS``
+- ``POST /admin/flaky?id=..&p=0.5&t=SECONDS``
+- ``GET  /admin/history?key=K`` (multi-version store dump)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import TYPE_CHECKING, Dict, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from paxi_tpu.core.command import Command, Request
+
+if TYPE_CHECKING:
+    from paxi_tpu.host.node import Node
+
+from paxi_tpu.host.transport import parse_addr
+
+
+def _response(status: int, body: bytes = b"",
+              headers: Dict[str, str] = {}) -> bytes:
+    reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+              500: "Internal Server Error"}.get(status, "OK")
+    head = [f"HTTP/1.1 {status} {reason}",
+            f"Content-Length: {len(body)}",
+            "Connection: keep-alive"]
+    head += [f"{k}: {v}" for k, v in headers.items()]
+    return ("\r\n".join(head) + "\r\n\r\n").encode() + body
+
+
+async def read_request(reader: asyncio.StreamReader
+                       ) -> Tuple[str, str, Dict[str, str], bytes]:
+    line = await reader.readline()
+    if not line or line in (b"\r\n", b"\n"):
+        raise ConnectionError("closed")
+    method, path, _ = line.decode().split(" ", 2)
+    headers: Dict[str, str] = {}
+    while True:
+        h = await reader.readline()
+        if h in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = h.decode().partition(":")
+        headers[k.strip().lower()] = v.strip()
+    n = int(headers.get("content-length", "0"))
+    body = await reader.readexactly(n) if n else b""
+    return method, path, headers, body
+
+
+class HTTPServer:
+    def __init__(self, node: "Node"):
+        self.node = node
+        self._server = None
+
+    async def start(self) -> None:
+        _, host, port = parse_addr(self.node.cfg.http_addrs[self.node.id])
+        self._server = await asyncio.start_server(self._serve, host, port)
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                method, path, headers, body = await read_request(reader)
+                resp = await self._route(method, path, headers, body)
+                writer.write(resp)
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError, OSError,
+                ValueError):
+            pass
+        finally:
+            writer.close()
+
+    async def _route(self, method: str, path: str,
+                     headers: Dict[str, str], body: bytes) -> bytes:
+        url = urlparse(path)
+        parts = [p for p in url.path.split("/") if p]
+        if parts and parts[0] == "admin":
+            return self._admin(method, parts[1:], parse_qs(url.query))
+        if len(parts) != 1:
+            return _response(404)
+        try:
+            key = int(parts[0])
+        except ValueError:
+            return _response(400, b"", {"Err": "key must be an int"})
+
+        value = body if method in ("PUT", "POST") else b""
+        cmd = Command(key, value,
+                      client_id=headers.get("client-id", ""),
+                      command_id=int(headers.get("command-id", "0")))
+        props = {k[len("property-"):]: v for k, v in headers.items()
+                 if k.startswith("property-")}
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.node.handle_client_request(Request(
+            command=cmd, properties=props, timestamp=time.time(),
+            node_id=str(self.node.id), reply_to=fut))
+        try:
+            rep = await asyncio.wait_for(fut, timeout=10.0)
+        except asyncio.TimeoutError:
+            return _response(500, b"", {"Err": "request timed out"})
+        if rep.err:
+            return _response(500, b"", {"Err": str(rep.err)})
+        return _response(200, rep.value or b"")
+
+    def _admin(self, method: str, parts, q) -> bytes:
+        """Fault injection + introspection (AdminClient endpoints)."""
+        sock = self.node.socket
+        try:
+            what = parts[0] if parts else ""
+            if what == "crash":
+                sock.crash(float(q["t"][0]))
+            elif what == "drop":
+                sock.drop(q["id"][0], float(q["t"][0]))
+            elif what == "slow":
+                sock.slow(q["id"][0], float(q["delay"][0]), float(q["t"][0]))
+            elif what == "flaky":
+                sock.flaky(q["id"][0], float(q["p"][0]), float(q["t"][0]))
+            elif what == "history":
+                key = int(q["key"][0])
+                hist = [v.decode("latin1")
+                        for v in self.node.db.history(key)]
+                return _response(200, json.dumps(hist).encode())
+            else:
+                return _response(404)
+            return _response(200)
+        except (KeyError, ValueError, IndexError) as e:
+            return _response(400, b"", {"Err": repr(e)})

@@ -1,0 +1,169 @@
+"""The host-runtime node: message loop, handler dispatch, HTTP API.
+
+Reference: paxi node.go — ``Node`` embeds Socket + Database + an HTTP
+server; ``Register(msgType, handler)`` stores handlers keyed by message
+type; ``Run()`` starts the HTTP server and the recv loop, which pulls
+from ``Socket.Recv()`` and dispatches on the concrete message type
+[driver: Register/Run plugin boundary].  ``Forward(id, req)`` relays a
+client request to another node (e.g. the ballot leader) and routes the
+reply back to the origin's HTTP client.
+
+The goroutine-per-node model becomes one asyncio task per node, so any
+number of nodes share one process/event loop — which is exactly the
+reference's ``-simulation`` mode when the config uses chan:// addresses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from paxi_tpu.utils import log
+
+from paxi_tpu.core.command import Command, Reply, Request
+from paxi_tpu.core.config import Config
+from paxi_tpu.core.db import Database
+from paxi_tpu.core.ident import ID
+from paxi_tpu.host.codec import Codec, register_message
+from paxi_tpu.host.http import HTTPServer
+from paxi_tpu.host.socket import Socket
+
+
+@register_message
+@dataclass
+class WireRequest:
+    """A client Request forwarded node-to-node (reply channel stripped,
+    like the reference's gob-encoded Request; msg.go)."""
+
+    key: int
+    value: bytes
+    client_id: str
+    command_id: int
+    properties: dict = field(default_factory=dict)
+    timestamp: float = 0.0
+    node_id: str = ""     # origin node holding the client connection
+    seq: int = 0          # origin-local id routing the reply back
+
+
+@register_message
+@dataclass
+class WireReply:
+    """Reply to a forwarded request, routed back to the origin node."""
+
+    key: int
+    value: bytes
+    client_id: str
+    command_id: int
+    err: str = ""
+    node_id: str = ""
+    seq: int = 0
+
+
+class Node:
+    def __init__(self, id: ID, cfg: Config, codec: Optional[Codec] = None):
+        self.id = ID(id)
+        self.cfg = cfg
+        self.socket = Socket(self.id, cfg, codec)
+        self.db = Database(cfg.multi_version)
+        self.handles: Dict[type, Callable[[Any], None]] = {}
+        self.http: Optional[HTTPServer] = None
+        self._fwd_seq = 0
+        self._fwd_pending: Dict[int, Request] = {}
+        self._tasks: list = []
+        self.register(WireRequest, self._handle_wire_request)
+        self.register(WireReply, self._handle_wire_reply)
+
+    # ---- plugin boundary (node.go Register) ----------------------------
+    def register(self, msg_class: type, handler: Callable[[Any], None]) -> None:
+        self.handles[msg_class] = handler
+
+    # ---- lifecycle (node.go Run) ---------------------------------------
+    async def start(self) -> None:
+        await self.socket.start()
+        if self.id in self.cfg.http_addrs:
+            self.http = HTTPServer(self)
+            await self.http.start()
+        self._tasks.append(asyncio.create_task(self._recv_loop()))
+
+    async def _recv_loop(self) -> None:
+        """THE hot loop (node.go recv): pull, dispatch by message type.
+        A handler exception must not kill the loop — log and keep going."""
+        while True:
+            msg = await self.socket.recv()
+            h = self.handles.get(type(msg))
+            if h is None:
+                continue
+            try:
+                r = h(msg)
+                if asyncio.iscoroutine(r):
+                    await r
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.errorf("%s: handler for %s raised:\n%s", self.id,
+                           type(msg).__name__, traceback.format_exc())
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        if self.http:
+            await self.http.stop()
+        await self.socket.close()
+
+    def run_forever(self) -> None:
+        """Blocking entry (the reference's replica.Run())."""
+        async def _main():
+            await self.start()
+            await asyncio.Event().wait()
+        asyncio.run(_main())
+
+    # ---- client-request plumbing ---------------------------------------
+    def handle_client_request(self, req: Request) -> None:
+        """Entry from the HTTP server: dispatch into the protocol's
+        registered Request handler (node.go http handler -> MessageChan)."""
+        h = self.handles.get(Request)
+        if h is None:
+            req.reply(Reply(req.command, err="no Request handler registered"))
+            return
+        h(req)
+
+    def forward(self, to: ID, req: Request) -> None:
+        """Reference: node.go Forward — relay to ``to`` (e.g. the leader),
+        remember the pending reply slot."""
+        self._fwd_seq += 1
+        seq = self._fwd_seq
+        self._fwd_pending[seq] = req
+        c = req.command
+        self.socket.send(to, WireRequest(
+            key=c.key, value=c.value, client_id=c.client_id,
+            command_id=c.command_id, properties=dict(req.properties),
+            timestamp=req.timestamp or time.time(),
+            node_id=str(self.id), seq=seq))
+
+    def _handle_wire_request(self, m: WireRequest) -> None:
+        """A forwarded request arrives: synthesize a Request whose reply
+        is routed back to the origin node over the wire."""
+        cmd = Command(m.key, m.value, m.client_id, m.command_id)
+
+        def reply_back(rep: Reply, _m=m):
+            self.socket.send(ID(_m.node_id), WireReply(
+                key=cmd.key, value=rep.value,
+                client_id=cmd.client_id, command_id=cmd.command_id,
+                err=rep.err or "", node_id=str(self.id), seq=_m.seq))
+
+        self.handle_client_request(Request(
+            command=cmd, properties=dict(m.properties),
+            timestamp=m.timestamp, node_id=m.node_id, reply_to=reply_back))
+
+    def _handle_wire_reply(self, m: WireReply) -> None:
+        req = self._fwd_pending.pop(m.seq, None)
+        if req is not None:
+            req.reply(Reply(req.command, value=m.value, err=m.err or None))
+
+    # ---- misc ----------------------------------------------------------
+    def retry(self, req: Request) -> None:
+        """Reference: node.go Retry — re-inject a request into dispatch."""
+        self.handle_client_request(req)

@@ -1,0 +1,51 @@
+"""Single-process multi-node simulation mode.
+
+Reference: ``server -simulation`` (bin/server/main.go) launches every ID
+from the config in one process over the ``chan`` transport [driver] —
+the de-facto integration harness.  Here: all replicas share one asyncio
+event loop; the in-process fabric lives in host/transport.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from paxi_tpu.core.config import Config, local_config
+from paxi_tpu.core.ident import ID
+from paxi_tpu.host.transport import reset_chan_fabric
+
+
+def chan_config(n: int, zones: int = 1, tag: str = "sim") -> Config:
+    """An n-replica config on the in-process fabric (+ local HTTP)."""
+    cfg = local_config(n, zones=zones, scheme="tcp")
+    cfg.addrs = {i: f"chan://{tag}/{i}" for i in cfg.addrs}
+    return cfg
+
+
+class Cluster:
+    """All replicas of a config in one event loop (simulation mode)."""
+
+    def __init__(self, algorithm: str, cfg: Optional[Config] = None,
+                 n: int = 3, zones: int = 1, http: bool = True):
+        from paxi_tpu.protocols import host_replica
+        self.cfg = cfg or chan_config(n, zones)
+        if not http:
+            self.cfg.http_addrs = {}
+        self.replicas: Dict[ID, object] = {
+            i: host_replica(algorithm)(i, self.cfg) for i in self.cfg.ids}
+
+    async def start(self) -> None:
+        for r in self.replicas.values():
+            await r.start()
+
+    async def stop(self) -> None:
+        for r in self.replicas.values():
+            await r.stop()
+        reset_chan_fabric()
+
+    def __getitem__(self, id) -> object:
+        return self.replicas[ID(id)]
+
+    @property
+    def ids(self) -> List[ID]:
+        return self.cfg.ids

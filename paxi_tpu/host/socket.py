@@ -1,0 +1,122 @@
+"""Peer messaging with first-class fault injection.
+
+Reference: paxi socket.go — ``Socket`` holds one lazily-dialed Transport
+per peer from ``Config.Addrs``; ``Send(to, m)``, ``Broadcast(m)``,
+``Multicast(zone, m)``, ``Recv()``; plus the fault-injection surface
+consulted on every send: ``Crash(t)``, ``Drop(id, t)``, ``Slow(id,
+delay, t)``, ``Flaky(id, p, t)`` [high].  The TPU sim runtime's fuzz
+schedule (sim/mailbox.py) is the vectorized generalization of exactly
+this surface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Any, Dict, Optional
+
+from paxi_tpu.core.config import Config
+from paxi_tpu.core.ident import ID
+from paxi_tpu.host.codec import Codec
+from paxi_tpu.host.transport import Transport, listen, new_transport
+
+
+class Socket:
+    def __init__(self, id: ID, cfg: Config, codec: Optional[Codec] = None):
+        self.id = ID(id)
+        self.cfg = cfg
+        self.codec = codec or Codec("pickle")
+        self.inbox: asyncio.Queue = asyncio.Queue()
+        self._peers: Dict[ID, Transport] = {}
+        self._server = None
+        # fault-injection state (wall-clock expiry, like the reference's
+        # time.AfterFunc timers)
+        self._crashed_until = 0.0
+        self._drop_until: Dict[ID, float] = {}
+        self._slow: Dict[ID, tuple] = {}   # id -> (delay_s, until)
+        self._flaky: Dict[ID, tuple] = {}  # id -> (p, until)
+        self._rng = random.Random(hash(self.id) & 0xFFFF)
+
+    # ---- lifecycle -----------------------------------------------------
+    async def start(self) -> None:
+        self._server = await listen(
+            self.cfg.addrs[self.id], self._deliver, self.codec)
+
+    def _deliver(self, msg: Any) -> None:
+        if time.monotonic() < self._crashed_until:
+            return  # crashed: receives suppressed too
+        self.inbox.put_nowait(msg)
+
+    async def recv(self) -> Any:
+        return await self.inbox.get()
+
+    async def close(self) -> None:
+        if self._server:
+            self._server.close()
+        for t in self._peers.values():
+            await t.close()
+        self._peers.clear()
+
+    # ---- sending -------------------------------------------------------
+    def send(self, to: ID, msg: Any) -> None:
+        """Reference: socket.go Send — lazily dial, consult fault state,
+        silently drop to crashed/dropped peers."""
+        to = ID(to)
+        now = time.monotonic()
+        if now < self._crashed_until:
+            return
+        if now < self._drop_until.get(to, 0.0):
+            return
+        p, until = self._flaky.get(to, (0.0, 0.0))
+        if now < until and self._rng.random() < p:
+            return
+        t = self._peers.get(to)
+        if t is None:
+            if to not in self.cfg.addrs:
+                return
+            t = new_transport(self.cfg.addrs[to], self.codec,
+                              self.cfg.buffer_size)
+            self._peers[to] = t
+            asyncio.ensure_future(self._dial_then(to, t))
+        delay, until = self._slow.get(to, (0.0, 0.0))
+        if now < until and delay > 0:
+            asyncio.get_event_loop().call_later(delay, t.send, msg)
+        else:
+            t.send(msg)
+
+    async def _dial_then(self, to: ID, t: Transport) -> None:
+        try:
+            await t.dial()
+        except (ConnectionError, OSError):
+            # peer not up yet: forget the dead transport so the next
+            # send() re-dials (messages queued meanwhile are dropped,
+            # like sends to a down TCP peer in the reference)
+            await t.close()
+            if self._peers.get(to) is t:
+                del self._peers[to]
+
+    def broadcast(self, msg: Any) -> None:
+        """Reference: socket.go Broadcast — send to all known peers."""
+        for i in self.cfg.ids:
+            if i != self.id:
+                self.send(i, msg)
+
+    def multicast(self, zone: int, msg: Any) -> None:
+        """Reference: socket.go Multicast — zone-filtered broadcast."""
+        for i in self.cfg.ids:
+            if i != self.id and i.zone == zone:
+                self.send(i, msg)
+
+    # ---- fault injection (socket.go Crash/Drop/Slow/Flaky) -------------
+    def crash(self, t: float) -> None:
+        self._crashed_until = time.monotonic() + t
+
+    def drop(self, to: ID, t: float) -> None:
+        self._drop_until[ID(to)] = time.monotonic() + t
+
+    def slow(self, to: ID, delay_ms: float, t: float) -> None:
+        self._slow[ID(to)] = (delay_ms / 1000.0, time.monotonic() + t)
+
+    def flaky(self, to: ID, p: float, t: float) -> None:
+        self._flaky[ID(to)] = (p, time.monotonic() + t)

@@ -1,0 +1,1 @@
+from paxi_tpu.ops.hashing import fib_key  # noqa: F401

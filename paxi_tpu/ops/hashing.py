@@ -1,0 +1,18 @@
+"""Shared in-kernel integer hashing ops.
+
+The sim kernels hash command/op identifiers onto the KV key space with a
+Fibonacci (golden-ratio) multiply — one definition here so all protocol
+kernels stay in sync (int32 wrap-around is intended; ``jnp.abs`` of
+INT32_MIN wraps back to INT32_MIN but INT32_MIN % n is still a valid
+index after ``jnp.abs`` on two's-complement — kept as-is for speed)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+GOLDEN = jnp.int32(-1640531527)  # 2654435769 as int32 (2^32 / phi)
+
+
+def fib_key(x, n_keys: int):
+    """Hash int32 ``x`` onto ``[0, n_keys)``."""
+    return jnp.abs(x * GOLDEN) % n_keys

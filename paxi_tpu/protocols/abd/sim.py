@@ -32,6 +32,7 @@ from typing import Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from paxi_tpu.ops.hashing import fib_key
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 IDLE, QUERY, STORE = 0, 1, 2
@@ -53,8 +54,7 @@ def encode_val(ts):
 
 def op_key_for(ridx, seq, n_keys):
     """Per-op key choice (uniform-ish hash of (replica, seq))."""
-    h = (seq * jnp.int32(31) + ridx) * jnp.int32(-1640531527)
-    return jnp.abs(h) % n_keys
+    return fib_key(seq * jnp.int32(31) + ridx, n_keys)
 
 
 def init_state(cfg: SimConfig, rng: jax.Array):

@@ -1,0 +1,194 @@
+"""Chain replication as a pure TPU kernel.
+
+Reference: paxi chain/ — a static chain (successor/predecessor from the
+sorted ID list): writes enter the head, propagate down the chain, the
+tail acknowledges, and reads are served at the tail [driver].  The
+throughput-baseline protocol of the suite.
+
+TPU re-design:
+- Replica index IS the chain position (0 = head, R-1 = tail); the dense
+  (src, dst) mailbox is used only on the two chain edges per replica.
+- The head is the closed-loop client: it appends one deterministic write
+  per step (val = f(seq)), so the whole pipeline sustains 1 write/step.
+- Forwarding uses an optimistic go-back-N pointer per replica with
+  **cumulative acks**: ``ack`` carries the sender's applied count and the
+  tail-applied count (the commit frontier) — a stalled successor resets
+  the pointer, so drops/dups/delays from the fuzz schedule are repaired
+  without per-message bookkeeping.
+- Commit = tail-applied, learned upstream via the same acks (the
+  reference's tail-ack propagated to the head).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+
+
+def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
+    return {
+        "prop": ("seq", "key", "val"),
+        "ack": ("applied", "tail_n"),
+    }
+
+
+def encode_val(seq):
+    """Deterministic write payload — lets the oracle detect any
+    out-of-order or corrupted apply."""
+    return seq * jnp.int32(11) + jnp.int32(5)
+
+
+def key_for(seq, n_keys):
+    return fib_key(seq, n_keys)
+
+
+def init_state(cfg: SimConfig, rng: jax.Array):
+    R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
+    del rng
+    return dict(
+        log_key=jnp.zeros((R, S), jnp.int32),
+        log_val=jnp.zeros((R, S), jnp.int32),
+        applied=jnp.zeros((R,), jnp.int32),     # in-order applied prefix
+        committed=jnp.zeros((R,), jnp.int32),   # known tail-applied
+        known_succ=jnp.zeros((R,), jnp.int32),  # optimistic succ progress
+        seen_succ=jnp.zeros((R,), jnp.int32),   # last acked succ applied
+        stall=jnp.zeros((R,), jnp.int32),
+        kv=jnp.zeros((R, K), jnp.int32),
+        reads_done=jnp.zeros((R,), jnp.int32),
+    )
+
+
+def step(state, inbox, ctx: StepCtx):
+    cfg = ctx.cfg
+    R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    is_head = ridx == 0
+    is_tail = ridx == R - 1
+
+    applied = state["applied"]
+    log_key, log_val = state["log_key"], state["log_val"]
+    kv = state["kv"]
+
+    # ------------- receive prop from predecessor -------------------------
+    m = inbox["prop"]
+    pred = jnp.clip(ridx - 1, 0, R - 1)
+    pv = m["valid"][pred, ridx] & ~is_head          # only the chain edge
+    pseq = m["seq"][pred, ridx]
+    pkey = m["key"][pred, ridx]
+    pval = m["val"][pred, ridx]
+    take = pv & (pseq == applied) & (applied < S)   # next expected, in order
+    oh = take[:, None] & (sidx[None, :] == pseq[:, None])
+    log_key = jnp.where(oh, pkey[:, None], log_key)
+    log_val = jnp.where(oh, pval[:, None], log_val)
+    ohk = take[:, None] & (jnp.arange(K)[None, :] == pkey[:, None])
+    kv = jnp.where(ohk, pval[:, None], kv)
+    applied = applied + take
+
+    # ------------- head appends one write per step -----------------------
+    h_seq = applied * is_head
+    h_do = is_head & (applied < S)
+    h_key, h_val = key_for(h_seq, K), encode_val(h_seq)
+    oh = h_do[:, None] & (sidx[None, :] == h_seq[:, None])
+    log_key = jnp.where(oh, h_key[:, None], log_key)
+    log_val = jnp.where(oh, h_val[:, None], log_val)
+    ohk = h_do[:, None] & (jnp.arange(K)[None, :] == h_key[:, None])
+    kv = jnp.where(ohk, h_val[:, None], kv)
+    applied = applied + h_do
+
+    # ------------- receive cumulative ack from successor -----------------
+    m = inbox["ack"]
+    succ = jnp.clip(ridx + 1, 0, R - 1)
+    av = m["valid"][succ, ridx] & ~is_tail
+    a_applied = jnp.where(av, m["applied"][succ, ridx], -1)
+    a_tail = jnp.where(av, m["tail_n"][succ, ridx], 0)
+    progress = a_applied > state["seen_succ"]
+    seen_succ = jnp.maximum(state["seen_succ"], a_applied)
+    committed = jnp.maximum(state["committed"], a_tail)
+    committed = jnp.where(is_tail, applied, committed)
+
+    # go-back-N: successor stalled => rewind the optimistic pointer
+    stall = jnp.where(progress | ~av, 0, state["stall"] + av)
+    rewind = stall >= cfg.retry_timeout
+    known_succ = jnp.where(rewind, seen_succ, state["known_succ"])
+    stall = jnp.where(rewind, 0, stall)
+
+    # ------------- forward next entry to successor -----------------------
+    send = (~is_tail) & (applied > known_succ)
+    s_seq = jnp.clip(known_succ, 0, S - 1)
+    s_key = jnp.take_along_axis(log_key, s_seq[:, None], axis=1)[:, 0]
+    s_val = jnp.take_along_axis(log_val, s_seq[:, None], axis=1)[:, 0]
+    to_succ = ridx[None, :] == succ[:, None]
+    out_prop = {
+        "valid": send[:, None] & to_succ,
+        "seq": jnp.broadcast_to(s_seq[:, None], (R, R)),
+        "key": jnp.broadcast_to(s_key[:, None], (R, R)),
+        "val": jnp.broadcast_to(s_val[:, None], (R, R)),
+    }
+    known_succ = known_succ + send
+
+    # ------------- ack upstream every step (cumulative) ------------------
+    to_pred = ridx[None, :] == pred[:, None]
+    out_ack = {
+        "valid": (~is_head)[:, None] & to_pred,
+        "applied": jnp.broadcast_to(applied[:, None], (R, R)),
+        "tail_n": jnp.broadcast_to(committed[:, None], (R, R)),
+    }
+
+    # ------------- reads are served at the tail --------------------------
+    # a read is a real local lookup of the latest applied write's key;
+    # counted only once the register holds data (reference: reads at
+    # tail are lease-free local reads)
+    r_key = key_for(jnp.maximum(applied - 1, 0), K)
+    r_val = jnp.take_along_axis(kv, r_key[:, None], axis=1)[:, 0]
+    served = is_tail & (applied > 0) & (r_val != 0)
+    reads_done = state["reads_done"] + served
+
+    new_state = dict(
+        log_key=log_key, log_val=log_val, applied=applied,
+        committed=committed, known_succ=known_succ, seen_succ=seen_succ,
+        stall=stall, kv=kv, reads_done=reads_done,
+    )
+    return new_state, {"prop": out_prop, "ack": out_ack}
+
+
+def metrics(state, cfg: SimConfig):
+    return {
+        "committed_slots": state["committed"][0],   # head's commit frontier
+        "tail_applied": state["applied"][cfg.n_replicas - 1],
+        "reads_done": jnp.sum(state["reads_done"]),
+    }
+
+
+def invariants(old, new, cfg: SimConfig) -> jax.Array:
+    """1. Every applied entry matches the head's deterministic write
+    (catches out-of-order / corrupted applies).  2. applied/committed
+    monotone.  3. applied is nonincreasing down the chain.  4. No commit
+    beyond the tail's applied prefix."""
+    S = cfg.n_slots
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    ap = new["applied"]
+    in_pref = sidx[None, :] < ap[:, None]
+    v_det = jnp.sum(in_pref & (new["log_val"] != encode_val(sidx)[None, :]))
+    v_det += jnp.sum(in_pref
+                     & (new["log_key"] != key_for(sidx, cfg.n_keys)[None, :]))
+    v_mono = jnp.sum(ap < old["applied"])
+    v_mono += jnp.sum(new["committed"] < old["committed"])
+    v_chain = jnp.sum(ap[:-1] < ap[1:])
+    v_commit = jnp.sum(new["committed"] > ap[cfg.n_replicas - 1])
+    return (v_det + v_mono + v_chain + v_commit).astype(jnp.int32)
+
+
+PROTOCOL = SimProtocol(
+    name="chain",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=step,
+    metrics=metrics,
+    invariants=invariants,
+)

@@ -1,0 +1,305 @@
+"""Multi-Paxos replica for the host (deployment) runtime.
+
+Reference: paxi paxos/paxos.go + paxos/replica.go — a single stable
+leader; phase-1 (P1a/P1b) ballot election with log recovery from P1b
+payloads; per-slot phase-2 (P2a/P2b) under a majority quorum; P3 commit
+broadcast; in-order execution against the Database; non-leaders Forward
+requests to the ballot leader [driver: HandleP1a/P1b/P2a/P2b, Quorum.ACK].
+
+This is the same protocol the TPU sim kernel (sim.py) runs as masked
+array updates; here it is the event-driven form for real deployments.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from paxi_tpu.core.ballot import ballot_id, next_ballot
+from paxi_tpu.core.command import Command, Reply, Request
+from paxi_tpu.core.config import Config
+from paxi_tpu.core.ident import ID
+from paxi_tpu.core.quorum import Quorum
+from paxi_tpu.host.codec import register_message
+from paxi_tpu.host.node import Node
+
+NOOP = Command(key=-1, value=b"\x00noop")
+
+
+@register_message
+@dataclass
+class P1a:
+    ballot: int
+
+
+@register_message
+@dataclass
+class P1b:
+    ballot: int
+    id: str
+    # slot -> [ballot, key, value, client_id, command_id, committed]
+    log: Dict[int, list] = field(default_factory=dict)
+
+
+@register_message
+@dataclass
+class P2a:
+    ballot: int
+    slot: int
+    key: int
+    value: bytes
+    client_id: str = ""
+    command_id: int = 0
+
+
+@register_message
+@dataclass
+class P2b:
+    ballot: int
+    slot: int
+    id: str
+
+
+@register_message
+@dataclass
+class P3:
+    ballot: int
+    slot: int
+    key: int
+    value: bytes
+    client_id: str = ""
+    command_id: int = 0
+
+
+@dataclass
+class Entry:
+    """Reference: paxos.go entry{ballot, command, commit, request,
+    quorum, timestamp}."""
+
+    ballot: int
+    command: Command
+    commit: bool = False
+    request: Optional[Request] = None
+    quorum: Optional[Quorum] = None
+    timestamp: float = 0.0
+
+
+class PaxosReplica(Node):
+    def __init__(self, id: ID, cfg: Config):
+        super().__init__(id, cfg)
+        self.ballot = 0
+        self.active = False
+        self.log: Dict[int, Entry] = {}
+        self.slot = -1          # highest slot used (next proposal = slot+1)
+        self.execute = 0        # next slot to execute
+        self.p1_quorum = Quorum(cfg.ids)
+        self.p1b_logs: Dict[ID, Dict[int, list]] = {}
+        self.pending: list = []  # requests queued while electing
+        self.register(Request, self.handle_request)
+        self.register(P1a, self.handle_p1a)
+        self.register(P1b, self.handle_p1b)
+        self.register(P2a, self.handle_p2a)
+        self.register(P2b, self.handle_p2b)
+        self.register(P3, self.handle_p3)
+
+    # ---- leadership ----------------------------------------------------
+    @property
+    def leader(self) -> Optional[ID]:
+        return ballot_id(self.ballot) if self.ballot else None
+
+    def is_leader(self) -> bool:
+        return self.active and self.leader == self.id
+
+    def run_phase1(self) -> None:
+        """paxos.go P1a(): bump ballot, solicit promises."""
+        self.ballot = next_ballot(self.ballot, self.id)
+        self.active = False
+        self.p1_quorum = Quorum(self.cfg.ids)
+        self.p1_quorum.ack(self.id)
+        self.p1b_logs = {self.id: self._log_payload()}
+        self.socket.broadcast(P1a(self.ballot))
+
+    def _log_payload(self) -> Dict[int, list]:
+        return {s: [e.ballot, e.command.key, e.command.value,
+                    e.command.client_id, e.command.command_id, e.commit]
+                for s, e in self.log.items() if s >= self.execute}
+
+    # ---- client requests ----------------------------------------------
+    def handle_request(self, req: Request) -> None:
+        if self.is_leader():
+            self.propose(req)
+        elif self.leader is not None and self.leader != self.id:
+            self.forward(self.leader, req)
+        else:
+            self.pending.append(req)
+            # start an election only if one of ours isn't already in
+            # flight (reference guards with ballot.ID() != self.ID)
+            if self.leader != self.id:
+                self.run_phase1()
+
+    def propose(self, req: Optional[Request],
+                command: Optional[Command] = None,
+                at_slot: Optional[int] = None) -> None:
+        """paxos.go P2a(): assign a slot, self-ack, broadcast P2a."""
+        cmd = command if command is not None else req.command
+        if at_slot is None:
+            self.slot += 1
+            slot = self.slot
+        else:
+            slot = at_slot
+            self.slot = max(self.slot, slot)
+        q = Quorum(self.cfg.ids)
+        q.ack(self.id)
+        self.log[slot] = Entry(self.ballot, cmd, request=req, quorum=q,
+                               timestamp=time.time())
+        self.socket.broadcast(P2a(self.ballot, slot, cmd.key, cmd.value,
+                                  cmd.client_id, cmd.command_id))
+        if q.majority():  # single-replica cluster
+            self._commit(slot)
+
+    # ---- phase 1 -------------------------------------------------------
+    def handle_p1a(self, m: P1a) -> None:
+        if m.ballot > self.ballot:
+            self.ballot = m.ballot
+            self.active = False
+            self._repend_inflight()
+        self.socket.send(ballot_id(m.ballot),
+                         P1b(self.ballot, str(self.id), self._log_payload()))
+
+    def _repend_inflight(self) -> None:
+        """Losing leadership: uncommitted proposals carrying client
+        requests go back to pending for forwarding to the new leader."""
+        for e in self.log.values():
+            if not e.commit and e.request is not None:
+                self.pending.append(e.request)
+                e.request = None
+        self._drain_pending()
+
+    def handle_p1b(self, m: P1b) -> None:
+        if m.ballot != self.ballot or self.active:
+            if m.ballot > self.ballot:
+                self.ballot = m.ballot
+                self.active = False
+            return
+        self.p1_quorum.ack(ID(m.id))
+        self.p1b_logs[ID(m.id)] = m.log
+        if self.p1_quorum.majority() and ballot_id(self.ballot) == self.id:
+            self._become_leader()
+
+    def _become_leader(self) -> None:
+        """Merge P1b logs: per slot adopt the highest-ballot command, keep
+        committed values, fill holes with NOOP; re-propose everything in
+        the window (paxos.go HandleP1b recovery path)."""
+        self.active = True
+        merged: Dict[int, Tuple[int, Command, bool]] = {}
+        top = self.slot
+        for log in self.p1b_logs.values():
+            for s_raw, (bal, key, value, cid, cmid, committed) in log.items():
+                s = int(s_raw)
+                top = max(top, s)
+                cmd = Command(int(key), value, cid, int(cmid))
+                cur = merged.get(s)
+                if committed:
+                    merged[s] = (bal, cmd, True)
+                elif cur is None or (not cur[2] and bal > cur[0]):
+                    merged[s] = (bal, cmd, False)
+        for s in range(self.execute, top + 1):
+            bal, cmd, committed = merged.get(s, (0, NOOP, False))
+            prev = self.log.get(s)
+            req = prev.request if prev else None
+            if prev is not None and prev.commit:
+                continue
+            if req is not None and (
+                    (prev.command.client_id, prev.command.command_id)
+                    != (cmd.client_id, cmd.command_id)):
+                self.pending.append(req)   # retry: slot taken by another cmd
+                prev.request = req = None
+            if committed:
+                self.log[s] = Entry(bal, cmd, commit=True, request=req)
+            else:
+                self.propose(req, command=cmd, at_slot=s)
+        self.slot = max(self.slot, top)
+        self._exec()
+        self._drain_pending()
+
+    def _drain_pending(self) -> None:
+        pending, self.pending = self.pending, []
+        for req in pending:
+            self.handle_request(req)
+
+    # ---- phase 2 -------------------------------------------------------
+    def handle_p2a(self, m: P2a) -> None:
+        if m.ballot >= self.ballot:
+            if m.ballot > self.ballot:
+                self.ballot = m.ballot
+                self.active = False
+                self._repend_inflight()
+            e = self.log.get(m.slot)
+            if e is None or (not e.commit and m.ballot >= e.ballot):
+                req = e.request if e else None
+                self.log[m.slot] = Entry(
+                    m.ballot, Command(m.key, m.value, m.client_id,
+                                      m.command_id), request=req)
+            self.slot = max(self.slot, m.slot)
+        self.socket.send(ballot_id(m.ballot),
+                         P2b(self.ballot, m.slot, str(self.id)))
+
+    def handle_p2b(self, m: P2b) -> None:
+        if m.ballot > self.ballot:  # rejected: someone has a newer ballot
+            self.ballot = m.ballot
+            self.active = False
+            self._repend_inflight()
+            return
+        e = self.log.get(m.slot)
+        if (self.active and e is not None and not e.commit
+                and m.ballot == self.ballot == e.ballot):
+            e.quorum.ack(ID(m.id))        # [driver: Quorum.ACK]
+            if e.quorum.majority():
+                self._commit(m.slot)
+
+    def _commit(self, slot: int) -> None:
+        e = self.log[slot]
+        e.commit = True
+        c = e.command
+        self.socket.broadcast(P3(self.ballot, slot, c.key, c.value,
+                                 c.client_id, c.command_id))
+        self._exec()
+
+    # ---- commit + execution -------------------------------------------
+    def handle_p3(self, m: P3) -> None:
+        cmd = Command(m.key, m.value, m.client_id, m.command_id)
+        e = self.log.get(m.slot)
+        req = e.request if e else None
+        if req is not None and (
+                (e.command.client_id, e.command.command_id)
+                != (cmd.client_id, cmd.command_id)):
+            # a different command committed in our slot: retry the
+            # client's request elsewhere (reference HandleP3 retry path)
+            req = None
+            self.pending.append(e.request)
+            e.request = None
+        self.log[m.slot] = Entry(m.ballot, cmd, commit=True, request=req)
+        self.slot = max(self.slot, m.slot)
+        self._exec()
+        self._drain_pending()
+
+    def _exec(self) -> None:
+        """paxos.go exec(): apply the committed prefix in slot order."""
+        while True:
+            e = self.log.get(self.execute)
+            if e is None or not e.commit:
+                break
+            if e.command.key >= 0:  # skip NOOP
+                value = self.db.execute(e.command)
+                if e.request is not None:
+                    e.request.reply(Reply(e.command, value=value))
+                    e.request = None
+            elif e.request is not None:
+                e.request.reply(Reply(e.command, err="noop"))
+                e.request = None
+            self.execute += 1
+
+
+def new_replica(id: ID, cfg: Config) -> PaxosReplica:
+    return PaxosReplica(ID(id), cfg)

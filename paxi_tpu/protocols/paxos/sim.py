@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
+from paxi_tpu.ops.hashing import fib_key
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 NO_CMD = -1    # empty log entry
@@ -57,10 +58,8 @@ def encode_cmd(bal, slot):
 
 
 def cmd_key(cmd, n_keys):
-    """Hash the command id onto the KV key space (golden-ratio multiply;
-    int32 wrap-around is intended)."""
-    h = cmd * jnp.int32(-1640531527)
-    return jnp.abs(h) % n_keys
+    """Hash the command id onto the KV key space."""
+    return fib_key(cmd, n_keys)
 
 
 def init_state(cfg: SimConfig, rng: jax.Array):
