@@ -1,0 +1,219 @@
+"""Command-line entry points.
+
+Reference: paxi's three binaries [high]:
+- ``bin/server``  -> ``python -m paxi_tpu server -id 1.1 -algorithm paxos
+  [-simulation]`` (``-simulation`` runs EVERY id from the config in one
+  process over the in-process fabric)
+- ``bin/client``  -> ``python -m paxi_tpu client`` (closed-loop benchmark
+  from the config's benchmark block + linearizability check)
+- ``bin/cmd``     -> ``python -m paxi_tpu cmd`` (admin REPL: get/put/
+  crash/drop)
+
+Plus the TPU-native runtime the reference doesn't have:
+- ``python -m paxi_tpu sim -algorithm paxos -groups 100000 -steps 100``
+  (the vmapped/jitted protocol simulator with fuzzing + invariants)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from paxi_tpu.core.config import Bconfig, Config, local_config
+from paxi_tpu.core.ident import ID
+from paxi_tpu.utils import log
+
+
+def _load_config(args) -> Config:
+    if args.config:
+        return Config.from_json(args.config)
+    return local_config(args.n, zones=getattr(args, "zones", 1))
+
+
+def cmd_server(args) -> int:
+    cfg = _load_config(args)
+    log.configure(args.log_level, args.log_dir, tag=args.id or "sim")
+    if args.simulation:
+        from paxi_tpu.host.simulation import Cluster
+        cfg.addrs = {i: f"chan://sim/{i}" for i in cfg.addrs}
+
+        async def main():
+            c = Cluster(args.algorithm, cfg=cfg)
+            await c.start()
+            log.infof("simulation: %d replicas of %s running",
+                      len(cfg.addrs), args.algorithm)
+            await asyncio.Event().wait()
+        asyncio.run(main())
+        return 0
+    from paxi_tpu.protocols import host_replica
+    replica = host_replica(args.algorithm)(ID(args.id), cfg)
+    log.infof("server %s (%s) on %s", args.id, args.algorithm,
+              cfg.addrs[ID(args.id)])
+    replica.run_forever()
+    return 0
+
+
+def cmd_client(args) -> int:
+    cfg = _load_config(args)
+    b = cfg.benchmark
+    if args.T is not None:
+        b.T, b.N = args.T, 0
+    if args.N is not None:
+        b.T, b.N = 0, args.N
+    if args.concurrency:
+        b.concurrency = args.concurrency
+    from paxi_tpu.host.benchmark import Benchmark
+    bench = Benchmark(cfg, b, seed=args.seed)
+    stats = asyncio.run(bench.run())
+    print(json.dumps(stats.summary()))
+    if args.history_file:
+        bench.history.write_file(args.history_file)
+    if stats.ops == 0 or (stats.anomalies or 0) > 0:
+        return 1   # total failure or a safety anomaly
+    return 0
+
+
+def cmd_repl(args) -> int:
+    """Interactive admin REPL (bin/cmd): get/put/crash/drop/slow/flaky."""
+    cfg = _load_config(args)
+    from paxi_tpu.host.client import AdminClient, Client
+
+    async def main():
+        client = Client(cfg, id=args.id or None, client_id="cmd")
+        admin = AdminClient(cfg)
+        print("commands: get K | put K V | crash ID T | drop ID1 ID2 T | "
+              "slow ID1 ID2 MS T | flaky ID1 ID2 P T | exit")
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                line = await loop.run_in_executor(None, input, "paxi> ")
+            except (EOFError, KeyboardInterrupt):
+                break
+            parts = line.split()
+            if not parts:
+                continue
+            try:
+                op = parts[0]
+                if op == "exit":
+                    break
+                elif op == "get":
+                    print((await client.get(int(parts[1]))).decode("latin1"))
+                elif op == "put":
+                    await client.put(int(parts[1]), parts[2].encode())
+                    print("ok")
+                elif op == "crash":
+                    await admin.crash(parts[1], float(parts[2]))
+                    print("ok")
+                elif op == "drop":
+                    await admin.drop(parts[1], parts[2], float(parts[3]))
+                    print("ok")
+                elif op == "slow":
+                    await admin.slow(parts[1], parts[2], float(parts[3]),
+                                     float(parts[4]))
+                    print("ok")
+                elif op == "flaky":
+                    await admin.flaky(parts[1], parts[2], float(parts[3]),
+                                      float(parts[4]))
+                    print("ok")
+                else:
+                    print(f"unknown command {op!r}")
+            except Exception as e:  # REPL: report, keep going
+                print(f"error: {e}")
+        client.close()
+        admin.close()
+    asyncio.run(main())
+    return 0
+
+
+def cmd_sim(args) -> int:
+    """The TPU sim runtime: vmapped protocol fuzzing at scale."""
+    from paxi_tpu.sim import FuzzConfig, SimConfig, simulate
+    from paxi_tpu.protocols import sim_protocol
+    proto = sim_protocol(args.algorithm)
+    cfg = SimConfig(n_replicas=args.replicas, n_slots=args.slots,
+                    n_keys=args.keys, n_zones=args.zones)
+    fuzz = FuzzConfig(p_drop=args.p_drop, p_dup=args.p_dup,
+                      max_delay=args.max_delay,
+                      p_crash=args.p_crash, p_partition=args.p_partition)
+    if args.shard:
+        from paxi_tpu.parallel import make_mesh, make_sharded_run
+        import jax.random as jr
+        run = make_sharded_run(proto, cfg, fuzz=fuzz, mesh=make_mesh())
+        state, metrics, viols = run(jr.PRNGKey(args.seed),
+                                    args.groups, args.steps)
+        out = {k: int(v) for k, v in metrics.items()}
+        out["invariant_violations"] = int(viols)
+    else:
+        res = simulate(proto, cfg, args.groups, args.steps, fuzz=fuzz,
+                       seed=args.seed)
+        out = {k: int(v) for k, v in res.metrics.items()}
+        out["invariant_violations"] = int(res.violations)
+    out.update(algorithm=args.algorithm, groups=args.groups,
+               steps=args.steps, replicas=args.replicas)
+    print(json.dumps(out))
+    return 0 if out["invariant_violations"] == 0 else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="paxi_tpu",
+        description="TPU-native consensus prototyping framework")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp):
+        sp.add_argument("-config", "--config", default="")
+        sp.add_argument("-n", type=int, default=3,
+                        help="replicas for the default local config")
+        sp.add_argument("-zones", "--zones", type=int, default=1)
+        sp.add_argument("-log_level", "--log-level", dest="log_level",
+                        default="info")
+        sp.add_argument("-log_dir", "--log-dir", dest="log_dir", default="")
+
+    s = sub.add_parser("server", help="run one replica (or -simulation)")
+    common(s)
+    s.add_argument("-id", "--id", default="1.1")
+    s.add_argument("-algorithm", "--algorithm", default="paxos")
+    s.add_argument("-simulation", "--simulation", action="store_true")
+    s.set_defaults(fn=cmd_server)
+
+    c = sub.add_parser("client", help="closed-loop benchmark client")
+    common(c)
+    c.add_argument("-id", "--id", default="")
+    c.add_argument("-T", type=int, default=None)
+    c.add_argument("-N", type=int, default=None)
+    c.add_argument("-concurrency", type=int, default=0)
+    c.add_argument("-seed", type=int, default=0)
+    c.add_argument("-history_file", "--history-file", default="")
+    c.set_defaults(fn=cmd_client)
+
+    r = sub.add_parser("cmd", help="admin REPL")
+    common(r)
+    r.add_argument("-id", "--id", default="")
+    r.set_defaults(fn=cmd_repl)
+
+    m = sub.add_parser("sim", help="TPU sim runtime (vmapped fuzzing)")
+    m.add_argument("-algorithm", "--algorithm", default="paxos")
+    m.add_argument("-groups", type=int, default=1024)
+    m.add_argument("-steps", type=int, default=100)
+    m.add_argument("-replicas", type=int, default=3)
+    m.add_argument("-slots", type=int, default=128)
+    m.add_argument("-keys", type=int, default=16)
+    m.add_argument("-zones", type=int, default=1)
+    m.add_argument("-seed", type=int, default=0)
+    m.add_argument("-p_drop", type=float, default=0.0)
+    m.add_argument("-p_dup", type=float, default=0.0)
+    m.add_argument("-p_crash", type=float, default=0.0)
+    m.add_argument("-p_partition", type=float, default=0.0)
+    m.add_argument("-max_delay", type=int, default=1)
+    m.add_argument("-shard", action="store_true",
+                   help="shard groups over the device mesh")
+    m.set_defaults(fn=cmd_sim)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,179 @@
+"""Closed-loop benchmark generator.
+
+Reference: paxi benchmark.go — ``Benchmark`` drives ``Bconfig.concurrency``
+closed-loop client streams for ``T`` seconds (or ``N`` ops), choosing
+keys per ``distribution`` (uniform / conflict / normal / zipfian
+[driver]), mixing ``W`` writes, optional ``throttle`` ops/s; collects
+per-op latency; prints throughput + mean/median/p95/p99; optionally
+feeds ``History`` and runs the linearizability check at the end [high].
+"""
+
+from __future__ import annotations
+
+import asyncio
+import bisect
+import math
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from paxi_tpu.core.config import Bconfig, Config
+from paxi_tpu.host.client import Client
+from paxi_tpu.host.history import History
+from paxi_tpu.utils import log
+
+
+class KeyGen:
+    """Key chooser per Bconfig.distribution (benchmark.go generators)."""
+
+    def __init__(self, b: Bconfig, seed: int = 0, stream: int = 0):
+        self.b = b
+        self.rng = random.Random(seed * 1000 + stream)
+        self.stream = stream
+        self._mu = b.mu
+        self._t0 = time.time()
+        if b.distribution == "zipfian":
+            # P(k) ∝ 1 / (k + v)^s over k in [0, K)
+            weights = [1.0 / math.pow(k + b.zipfian_v, b.zipfian_s)
+                       for k in range(b.K)]
+            total = sum(weights)
+            acc, cdf = 0.0, []
+            for w in weights:
+                acc += w / total
+                cdf.append(acc)
+            self._cdf = cdf
+
+    def next(self) -> int:
+        b = self.b
+        if b.distribution == "uniform":
+            return b.min + self.rng.randrange(max(b.K, 1))
+        if b.distribution == "conflict":
+            if self.rng.random() * 100 < b.conflicts:
+                return b.min + self.rng.randrange(max(b.K, 1))
+            # non-conflicting: a per-stream private shard above the range
+            return b.min + b.K + self.stream * b.K + \
+                self.rng.randrange(max(b.K, 1))
+        if b.distribution == "normal":
+            mu = self._mu
+            if b.move:  # drift the mean over time (benchmark.go Move/Speed)
+                mu += (time.time() - self._t0) * 1000.0 / max(b.speed, 1)
+            k = int(self.rng.gauss(mu, b.sigma)) % max(b.K, 1)
+            return b.min + abs(k)
+        if b.distribution == "zipfian":
+            return b.min + bisect.bisect_left(self._cdf, self.rng.random())
+        raise ValueError(f"unknown distribution {b.distribution!r}")
+
+
+@dataclass
+class Stats:
+    """Latency/throughput summary (benchmark.go stat output)."""
+
+    ops: int
+    errors: int
+    duration: float
+    latencies: List[float] = field(repr=False, default_factory=list)
+    anomalies: Optional[int] = None
+
+    @staticmethod
+    def _pct(sorted_lat: List[float], p: float) -> float:
+        if not sorted_lat:
+            return 0.0
+        i = min(len(sorted_lat) - 1, int(p / 100.0 * len(sorted_lat)))
+        return sorted_lat[i]
+
+    def summary(self) -> Dict[str, float]:
+        lat = sorted(self.latencies)
+        mean = sum(lat) / len(lat) if lat else 0.0
+        return {
+            "ops": self.ops,
+            "errors": self.errors,
+            "duration_s": round(self.duration, 3),
+            "throughput_ops_s": round(self.ops / self.duration, 1)
+            if self.duration > 0 else 0.0,
+            "latency_mean_ms": round(mean * 1e3, 3),
+            "latency_p50_ms": round(self._pct(lat, 50) * 1e3, 3),
+            "latency_p95_ms": round(self._pct(lat, 95) * 1e3, 3),
+            "latency_p99_ms": round(self._pct(lat, 99) * 1e3, 3),
+            "latency_min_ms": round((lat[0] if lat else 0.0) * 1e3, 3),
+            "latency_max_ms": round((lat[-1] if lat else 0.0) * 1e3, 3),
+            **({"anomalies": self.anomalies}
+               if self.anomalies is not None else {}),
+        }
+
+
+class Benchmark:
+    """Closed-loop load against a cluster via the REST client."""
+
+    def __init__(self, cfg: Config, b: Optional[Bconfig] = None,
+                 seed: int = 0):
+        self.cfg = cfg
+        self.b = b or cfg.benchmark
+        self.seed = seed
+        self.history = History()
+
+    async def run(self) -> Stats:
+        b = self.b
+        stats = Stats(ops=0, errors=0, duration=0.0)
+        stop_at = time.time() + b.T if b.T > 0 else None
+        total_ops = b.N if b.T <= 0 else None
+        counter = {"left": total_ops}
+        lock = asyncio.Lock()
+        t0 = time.time()
+
+        async def stream(si: int):
+            gen = KeyGen(b, self.seed, si)
+            rng = random.Random(self.seed * 77 + si)
+            client = Client(self.cfg,
+                            id=self.cfg.ids[si % len(self.cfg.ids)],
+                            client_id=f"bench-{si}")
+            n_local = 0
+            try:
+                while True:
+                    if stop_at is not None and time.time() >= stop_at:
+                        break
+                    if counter["left"] is not None:
+                        async with lock:
+                            if counter["left"] <= 0:
+                                break
+                            counter["left"] -= 1
+                    key = gen.next()
+                    write = rng.random() < b.W
+                    n_local += 1
+                    value = f"{si}:{n_local}".encode() if write else b""
+                    s = time.time()
+                    try:
+                        if write:
+                            await client.put(key, value)
+                            out = None
+                        else:
+                            out = await client.get(key)
+                        e = time.time()
+                        stats.latencies.append(e - s)
+                        stats.ops += 1
+                        if b.linearizability_check:
+                            self.history.add(
+                                key, value if write else None,
+                                out if not write else None, s, e)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as ex:
+                        stats.errors += 1
+                        log.debugf("bench op error: %r", ex)
+                        if b.linearizability_check and write:
+                            # a failed write may still commit later:
+                            # record it with an open end time so reads
+                            # of its value aren't flagged as anomalies
+                            self.history.add(key, value, None, s,
+                                             math.inf)
+                    if b.throttle > 0:
+                        await asyncio.sleep(
+                            b.concurrency / b.throttle)
+            finally:
+                client.close()
+
+        await asyncio.gather(*(stream(i) for i in range(b.concurrency)))
+        stats.duration = time.time() - t0
+        if b.linearizability_check:
+            stats.anomalies = self.history.linearizable()
+        return stats

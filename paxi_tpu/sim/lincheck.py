@@ -1,0 +1,87 @@
+"""Vectorized (batched) linearizability oracle for big sim histories.
+
+The precedence-graph checker in ``paxi_tpu.host.history`` is exact but
+O(n^3)-ish per key — right for benchmark-sized histories.  For the sim
+runtime's scale (100k groups) this module provides the vectorized
+**stale/future-read** check over dense op arrays, which is the register
+condition the reference's checker enforces in practice: a read must not
+return a value whose write was already overwritten by a write that
+completed before the read started, nor a value written only after the
+read ended.
+
+Arrays (ops flattened per group; pad with valid=False):
+- ``valid   (B, N) bool``
+- ``key     (B, N) int32``
+- ``is_read (B, N) bool``
+- ``val     (B, N) int32``  unique per write within (group, key)
+- ``start, end (B, N) float/int`` — any monotonic clock (sim step ids)
+
+Returns per-group anomaly counts ``(B,) int32``.  Pure numpy so the
+oracle also runs while no accelerator is attached; shapes are dense so
+the same code jits under jax.numpy if handed jax arrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def stale_read_anomalies(valid, key, is_read, val, start, end,
+                         max_elems: int = 10_000_000):
+    """Chunks the batch axis so the (chunk, N, N) intermediates stay
+    around ``max_elems`` booleans regardless of B."""
+    valid = np.asarray(valid)
+    B, N = valid.shape
+    chunk = max(1, max_elems // max(N * N, 1))
+    if B > chunk:
+        return np.concatenate([
+            stale_read_anomalies(valid[i:i + chunk],
+                                 np.asarray(key)[i:i + chunk],
+                                 np.asarray(is_read)[i:i + chunk],
+                                 np.asarray(val)[i:i + chunk],
+                                 np.asarray(start)[i:i + chunk],
+                                 np.asarray(end)[i:i + chunk],
+                                 max_elems)
+            for i in range(0, B, chunk)])
+    key = np.asarray(key)
+    is_read = np.asarray(is_read)
+    val = np.asarray(val)
+    start = np.asarray(start)
+    end = np.asarray(end)
+
+    w_ok = valid & ~is_read                      # (B, N) writes
+    r_ok = valid & is_read
+
+    # match reads to their writes: same (key, val)
+    same_key = key[:, :, None] == key[:, None, :]        # (B, r, w)
+    same_val = val[:, :, None] == val[:, None, :]
+    rw = r_ok[:, :, None] & w_ok[:, None, :] & same_key & same_val
+
+    has_src = rw.any(axis=2)                              # (B, r)
+    # a read of a non-initial value with no matching write is anomalous
+    no_src = r_ok & (val != 0) & ~has_src
+
+    src = rw.argmax(axis=2)                               # (B, r)
+    bidx = np.arange(B)[:, None]
+    w_start = np.where(has_src, start[bidx, src], 0)
+    w_end = np.where(has_src, end[bidx, src], 0)
+
+    # future read: the sourcing write started only after the read ended
+    future = has_src & (w_start > end)
+
+    # stale read: some OTHER write to the same key began after the
+    # sourcing write ended and completed before the read started
+    other = w_ok[:, None, :] & same_key & ~same_val       # (B, r, w)
+    overw = other & (start[:, None, :] > w_end[:, :, None]) \
+                  & (end[:, None, :] < start[:, :, None])
+    stale = has_src & overw.any(axis=2)
+
+    # initial-value read (val == 0): stale if ANY write to the key
+    # completed before the read started
+    init_r = r_ok & (val == 0)
+    any_w = w_ok[:, None, :] & same_key & (end[:, None, :]
+                                           < start[:, :, None])
+    init_stale = init_r & any_w.any(axis=2)
+
+    bad = no_src | future | stale | init_stale
+    return bad.sum(axis=1).astype(np.int32)

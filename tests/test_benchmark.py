@@ -1,0 +1,66 @@
+"""Benchmark generator tests: distributions, closed-loop run vs a live
+in-process cluster, linearizability of the observed history."""
+
+import asyncio
+import collections
+
+import pytest
+
+from paxi_tpu.core.config import Bconfig
+from paxi_tpu.host.benchmark import Benchmark, KeyGen
+from paxi_tpu.host.simulation import Cluster
+
+
+def test_uniform_keys_in_range():
+    g = KeyGen(Bconfig(K=16, distribution="uniform"), seed=1)
+    ks = [g.next() for _ in range(500)]
+    assert min(ks) >= 0 and max(ks) < 16
+    assert len(set(ks)) > 8
+
+
+def test_conflict_split():
+    b = Bconfig(K=4, distribution="conflict", conflicts=50)
+    g0, g1 = KeyGen(b, 1, stream=0), KeyGen(b, 1, stream=1)
+    k0 = {g0.next() for _ in range(300)}
+    k1 = {g1.next() for _ in range(300)}
+    shared = set(range(4))
+    # non-conflict shards never overlap across streams
+    assert (k0 - shared) & (k1 - shared) == set()
+    assert k0 & shared and k1 & shared
+
+
+def test_normal_distribution():
+    g = KeyGen(Bconfig(K=100, distribution="normal", mu=50, sigma=5), 1)
+    ks = [g.next() for _ in range(500)]
+    center = sum(40 <= k <= 60 for k in ks)
+    assert center > 400
+
+
+def test_zipfian_skew():
+    g = KeyGen(Bconfig(K=50, distribution="zipfian",
+                       zipfian_s=2.0, zipfian_v=1.0), 1)
+    counts = collections.Counter(g.next() for _ in range(2000))
+    top = counts.most_common(3)
+    assert top[0][0] in (0, 1)            # head of the zipf is hottest
+    assert top[0][1] > counts.get(40, 0) * 5
+
+
+def test_closed_loop_benchmark_paxos():
+    async def main():
+        c = Cluster("paxos", n=3)
+        await c.start()
+        try:
+            b = Bconfig(T=0, N=60, K=8, W=0.5, concurrency=3,
+                        distribution="uniform",
+                        linearizability_check=True)
+            bench = Benchmark(c.cfg, b, seed=2)
+            stats = await bench.run()
+            s = stats.summary()
+            assert s["ops"] == 60, s
+            assert s["errors"] == 0, s
+            assert s["anomalies"] == 0, s
+            assert s["throughput_ops_s"] > 0
+            assert len(bench.history) == 60
+        finally:
+            await c.stop()
+    asyncio.run(main())

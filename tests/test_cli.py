@@ -1,0 +1,54 @@
+"""CLI smoke tests (server/client surface; the `sim` subcommand is
+exercised by the jax-marked tests via the library API)."""
+
+import asyncio
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paxi_tpu.core.config import Bconfig, local_config
+
+
+def test_client_against_simulation_server(tmp_path):
+    cfg = local_config(3, base_port=18541)
+    # http ports = base + 1000 (local_config layout)
+    cfg.benchmark = Bconfig(T=0, N=30, K=8, W=0.5, concurrency=2,
+                            linearizability_check=True)
+    cfg_path = tmp_path / "config.json"
+    cfg.to_json(str(cfg_path))
+
+    server = subprocess.Popen(
+        [sys.executable, "-m", "paxi_tpu", "server", "-simulation",
+         "-algorithm", "paxos", "-config", str(cfg_path)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        # wait for the HTTP API to come up
+        import socket
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", 19541), 1).close()
+                break
+            except OSError:
+                time.sleep(0.2)
+
+        out = None
+        while time.time() < deadline:
+            r = subprocess.run(
+                [sys.executable, "-m", "paxi_tpu", "client",
+                 "-config", str(cfg_path), "-N", "30"],
+                capture_output=True, text=True, timeout=30)
+            if r.returncode == 0 and r.stdout.strip():
+                out = json.loads(r.stdout.strip().splitlines()[-1])
+                if out["ops"] == 30 and out["errors"] == 0:
+                    break
+            time.sleep(0.5)
+        assert out is not None, "client never succeeded"
+        assert out["ops"] == 30 and out["errors"] == 0, out
+        assert out["anomalies"] == 0, out
+    finally:
+        server.terminate()
+        server.wait(timeout=5)

@@ -1,0 +1,117 @@
+"""Linearizability checker tests on hand-built histories (the reference
+tests its checker the same way: known-good and known-anomalous logs)."""
+
+import numpy as np
+import pytest
+
+from paxi_tpu.host.history import History, check_key
+from paxi_tpu.sim.lincheck import stale_read_anomalies
+
+
+def H(ops):
+    """ops: list of (input, output, start, end) for one key."""
+    h = History()
+    for inp, out, s, e in ops:
+        h.add(0, inp, out, s, e)
+    return h
+
+
+def test_sequential_ok():
+    h = H([(b"a", None, 0, 1), (None, b"a", 2, 3),
+           (b"b", None, 4, 5), (None, b"b", 6, 7)])
+    assert h.linearizable() == 0
+
+
+def test_stale_read():
+    h = H([(b"a", None, 0, 1), (b"b", None, 2, 3), (None, b"a", 4, 5)])
+    assert h.linearizable() == 1
+
+
+def test_read_overlapping_write_ok():
+    # read concurrent with the write may see it or not
+    h = H([(b"a", None, 0, 10), (None, b"a", 1, 2)])
+    assert h.linearizable() == 0
+    h = H([(b"a", None, 0, 1), (b"b", None, 2, 10), (None, b"a", 3, 4)])
+    assert h.linearizable() == 0  # w(b) still in flight: a is readable
+
+
+def test_future_read():
+    h = H([(None, b"a", 0, 1), (b"a", None, 2, 3)])
+    assert h.linearizable() == 1
+
+
+def test_never_written_value():
+    h = H([(b"a", None, 0, 1), (None, b"zzz", 2, 3)])
+    assert h.linearizable() == 1
+
+
+def test_closure_catches_cross_read_inversion():
+    # concurrent writes; r1 fixes the order b < a, so r2 reading b after
+    # a completed is anomalous only through the closure rule
+    h = H([(b"a", None, 0, 5), (b"b", None, 0, 5),
+           (None, b"a", 6, 7), (None, b"b", 8, 9)])
+    assert h.linearizable() == 1
+
+
+def test_multi_key_independent():
+    h = History()
+    h.add(1, b"a", None, 0, 1)
+    h.add(2, b"x", None, 0, 1)
+    h.add(1, None, b"a", 2, 3)
+    h.add(2, None, b"x", 2, 3)
+    assert h.linearizable() == 0
+
+
+def test_anomaly_count_multiple():
+    h = H([(b"a", None, 0, 1), (b"b", None, 2, 3),
+           (None, b"a", 4, 5), (None, b"a", 6, 7)])
+    assert h.linearizable() == 2
+
+
+# ---- vectorized stale-read oracle --------------------------------------
+
+def _arrays(ops):
+    """ops: (key, is_read, val, start, end) rows -> batched arrays."""
+    n = len(ops)
+    key = np.array([[o[0] for o in ops]], np.int32)
+    is_read = np.array([[o[1] for o in ops]])
+    val = np.array([[o[2] for o in ops]], np.int32)
+    start = np.array([[o[3] for o in ops]], np.float64)
+    end = np.array([[o[4] for o in ops]], np.float64)
+    return np.ones((1, n), bool), key, is_read, val, start, end
+
+
+def test_vectorized_ok():
+    out = stale_read_anomalies(*_arrays([
+        (0, False, 7, 0, 1), (0, True, 7, 2, 3),
+        (0, False, 8, 4, 5), (0, True, 8, 6, 7)]))
+    assert out.tolist() == [0]
+
+
+def test_vectorized_stale_and_future():
+    out = stale_read_anomalies(*_arrays([
+        (0, False, 7, 0, 1), (0, False, 8, 2, 3), (0, True, 7, 4, 5)]))
+    assert out.tolist() == [1]
+    out = stale_read_anomalies(*_arrays([
+        (0, True, 7, 0, 1), (0, False, 7, 2, 3)]))
+    assert out.tolist() == [1]
+
+
+def test_vectorized_initial_read():
+    out = stale_read_anomalies(*_arrays([
+        (0, False, 7, 0, 1), (0, True, 0, 2, 3)]))
+    assert out.tolist() == [1]  # initial value read after a complete write
+    out = stale_read_anomalies(*_arrays([
+        (0, False, 7, 2, 3), (0, True, 0, 0, 1)]))
+    assert out.tolist() == [0]
+
+
+def test_vectorized_batch_and_padding():
+    valid = np.array([[True, True, False], [True, True, True]])
+    key = np.zeros((2, 3), np.int32)
+    is_read = np.array([[False, True, False], [False, False, True]])
+    val = np.array([[5, 5, 9], [5, 6, 5]], np.int32)
+    start = np.array([[0, 2, 9], [0, 2, 4]], np.float64)
+    end = np.array([[1, 3, 9], [1, 3, 5]], np.float64)
+    out = stale_read_anomalies(valid, key, is_read, val, start, end)
+    assert out.tolist() == [0, 1]
