@@ -58,8 +58,8 @@ class KeyGen:
             mu = self._mu
             if b.move:  # drift the mean over time (benchmark.go Move/Speed)
                 mu += (time.time() - self._t0) * 1000.0 / max(b.speed, 1)
-            k = int(self.rng.gauss(mu, b.sigma)) % max(b.K, 1)
-            return b.min + abs(k)
+            k = abs(int(self.rng.gauss(mu, b.sigma))) % max(b.K, 1)
+            return b.min + k
         if b.distribution == "zipfian":
             return b.min + bisect.bisect_left(self._cdf, self.rng.random())
         raise ValueError(f"unknown distribution {b.distribution!r}")
@@ -116,12 +116,11 @@ class Benchmark:
         b = self.b
         stats = Stats(ops=0, errors=0, duration=0.0)
         stop_at = time.time() + b.T if b.T > 0 else None
-        total_ops = b.N if b.T <= 0 else None
-        counter = {"left": total_ops}
-        lock = asyncio.Lock()
+        left = b.N if b.T <= 0 else None
         t0 = time.time()
 
         async def stream(si: int):
+            nonlocal left
             gen = KeyGen(b, self.seed, si)
             rng = random.Random(self.seed * 77 + si)
             client = Client(self.cfg,
@@ -132,11 +131,12 @@ class Benchmark:
                 while True:
                     if stop_at is not None and time.time() >= stop_at:
                         break
-                    if counter["left"] is not None:
-                        async with lock:
-                            if counter["left"] <= 0:
-                                break
-                            counter["left"] -= 1
+                    # no await between check and decrement => atomic in
+                    # single-threaded asyncio
+                    if left is not None:
+                        if left <= 0:
+                            break
+                        left -= 1
                     key = gen.next()
                     write = rng.random() < b.W
                     n_local += 1

@@ -24,6 +24,7 @@ histories) lives in ``paxi_tpu.sim.lincheck``.
 from __future__ import annotations
 
 import json
+import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -73,7 +74,8 @@ class History:
         dump = {
             str(k): [{"input": o.input.decode("latin1") if o.input is not None else None,
                       "output": o.output.decode("latin1") if o.output is not None else None,
-                      "start": o.start, "end": o.end}
+                      "start": o.start,
+                      "end": None if math.isinf(o.end) else o.end}
                      for o in sorted(v, key=lambda o: o.start)]
             for k, v in self._ops.items()
         }
@@ -123,12 +125,21 @@ def _find_cycle_read(ops: List[Operation]) -> Optional[Operation]:
     # itself an anomaly
     read_from: Dict[int, int] = {}
     for i, o in enumerate(ops):
-        if o.is_read and o.output:
+        if not o.is_read:
+            continue
+        if o.output:
             w = writes_by_val.get(o.output)
             if w is None:
                 return o
             adj[w] |= 1 << i
             read_from[i] = w
+        else:
+            # read of the initial (empty) register: it observed no write,
+            # so it precedes every write — a write completing before it
+            # then closes a cycle (lost-update detection; mirrors
+            # sim/lincheck.py's stale-initial-read rule)
+            for w2 in writes:
+                adj[i] |= 1 << w2
 
     # closure to fixpoint, two data-order rules per read r of write w:
     #   (a) every other write preceding r precedes w (r observed w last)

@@ -1,0 +1,118 @@
+"""Chain replication replica for the host (deployment) runtime.
+
+Reference: paxi chain/ — a static chain ordered head -> ... -> tail over
+the sorted node IDs [driver]: writes enter at the head, are applied and
+propagated down the chain, and are acknowledged once the tail applies
+them; reads are served at the tail (which is why the scheme is
+linearizable: the tail's state is the committed prefix).  Requests
+arriving at the wrong end are forwarded (node.go Forward).
+
+The same protocol runs as a vmapped TPU kernel in ``sim.py``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from paxi_tpu.core.command import Command, Reply, Request
+from paxi_tpu.core.config import Config
+from paxi_tpu.core.ident import ID
+from paxi_tpu.host.codec import register_message
+from paxi_tpu.host.node import Node
+
+
+@register_message
+@dataclass
+class Propagate:
+    """A write travelling down the chain (chain/ Propagate msg)."""
+
+    seq: int
+    key: int
+    value: bytes
+    client_id: str = ""
+    command_id: int = 0
+
+
+@register_message
+@dataclass
+class Ack:
+    """Tail -> head: the write at ``seq`` reached the end of the chain."""
+
+    seq: int
+
+
+class ChainReplica(Node):
+    def __init__(self, id: ID, cfg: Config):
+        super().__init__(id, cfg)
+        order = sorted(cfg.ids)
+        self.chain = order
+        self.pos = order.index(self.id)
+        self.head = order[0]
+        self.tail = order[-1]
+        self.succ: Optional[ID] = (
+            order[self.pos + 1] if self.pos + 1 < len(order) else None)
+        self.seq = 0            # head: last assigned; others: last applied
+        self.pending: Dict[int, Request] = {}   # head: seq -> client request
+        self.buffer: Dict[int, Propagate] = {}  # out-of-order propagates
+        self.register(Request, self.handle_request)
+        self.register(Propagate, self.handle_propagate)
+        self.register(Ack, self.handle_ack)
+
+    def is_head(self) -> bool:
+        return self.id == self.head
+
+    def is_tail(self) -> bool:
+        return self.id == self.tail
+
+    # ---- client requests ----------------------------------------------
+    def handle_request(self, req: Request) -> None:
+        if req.command.is_read():
+            # reads at the tail (committed prefix)
+            if self.is_tail():
+                value = self.db.execute(req.command)
+                req.reply(Reply(req.command, value=value))
+            else:
+                self.forward(self.tail, req)
+            return
+        # writes at the head
+        if not self.is_head():
+            self.forward(self.head, req)
+            return
+        self.seq += 1
+        self.pending[self.seq] = req
+        self.db.execute(req.command)
+        if self.succ is None:       # single-node chain: head == tail
+            self._ack(self.seq)
+        else:
+            c = req.command
+            self.socket.send(self.succ, Propagate(
+                self.seq, c.key, c.value, c.client_id, c.command_id))
+
+    # ---- down the chain ------------------------------------------------
+    def handle_propagate(self, m: Propagate) -> None:
+        self.buffer[m.seq] = m
+        # apply strictly in sequence order (TCP is FIFO per edge, but a
+        # restarted link may reorder across reconnects — buffer defends)
+        while self.seq + 1 in self.buffer:
+            m = self.buffer.pop(self.seq + 1)
+            self.seq += 1
+            self.db.execute(Command(m.key, m.value, m.client_id,
+                                    m.command_id))
+            if self.is_tail():
+                self.socket.send(self.head, Ack(m.seq))
+            else:
+                self.socket.send(self.succ, m)
+
+    # ---- back to the head ----------------------------------------------
+    def handle_ack(self, m: Ack) -> None:
+        self._ack(m.seq)
+
+    def _ack(self, seq: int) -> None:
+        req = self.pending.pop(seq, None)
+        if req is not None:
+            req.reply(Reply(req.command, value=b""))
+
+
+def new_replica(id: ID, cfg: Config) -> ChainReplica:
+    return ChainReplica(ID(id), cfg)

@@ -36,6 +36,13 @@ def test_normal_distribution():
     assert center > 400
 
 
+def test_normal_distribution_low_mu_no_wraparound():
+    # negative gaussian draws must reflect near 0, not wrap to K-1
+    g = KeyGen(Bconfig(K=1000, distribution="normal", mu=0, sigma=60), 1)
+    ks = [g.next() for _ in range(1000)]
+    assert sum(k > 900 for k in ks) < 10
+
+
 def test_zipfian_skew():
     g = KeyGen(Bconfig(K=50, distribution="zipfian",
                        zipfian_s=2.0, zipfian_v=1.0), 1)

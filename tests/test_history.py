@@ -27,6 +27,28 @@ def test_stale_read():
     assert h.linearizable() == 1
 
 
+def test_lost_write_empty_read():
+    # a read returning the initial (empty) value AFTER a write completed
+    # is a lost committed write — must be flagged
+    h = H([(b"a", None, 0, 1), (None, b"", 2, 3)])
+    assert h.linearizable() == 1
+
+
+def test_initial_read_before_any_write_ok():
+    h = H([(None, b"", 0, 1), (b"a", None, 2, 3), (None, b"a", 4, 5)])
+    assert h.linearizable() == 0
+
+
+def test_write_file_with_inf_end_is_valid_json(tmp_path):
+    import json
+    import math
+    h = H([(b"a", None, 0, math.inf), (None, b"a", 2, 3)])
+    p = tmp_path / "h.json"
+    h.write_file(str(p))
+    dump = json.loads(p.read_text())
+    assert dump["0"][0]["end"] is None
+
+
 def test_read_overlapping_write_ok():
     # read concurrent with the write may see it or not
     h = H([(b"a", None, 0, 10), (None, b"a", 1, 2)])

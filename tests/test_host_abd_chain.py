@@ -1,0 +1,136 @@
+"""Host-runtime integration tests for ABD and chain replication."""
+
+import asyncio
+
+import pytest
+
+from paxi_tpu.core.command import Command, Reply, Request
+from paxi_tpu.host.simulation import Cluster
+
+pytestmark = pytest.mark.host
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def do(replica, key, value=b"", cid="c1", cmd_id=1, timeout=5.0):
+    fut = asyncio.get_running_loop().create_future()
+    replica.handle_client_request(Request(
+        command=Command(key, value, cid, cmd_id), reply_to=fut))
+    rep: Reply = await asyncio.wait_for(fut, timeout)
+    assert rep.err is None, rep.err
+    return rep.value
+
+
+# ---------------------------------------------------------------- ABD --
+
+def test_abd_write_then_read():
+    async def main():
+        c = Cluster("abd", n=3, http=False)
+        await c.start()
+        try:
+            await do(c["1.1"], 7, b"x", cmd_id=1)
+            assert await do(c["1.2"], 7, cmd_id=2) == b"x"
+            assert await do(c["1.3"], 7, cmd_id=3) == b"x"
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_abd_read_missing_key_is_empty():
+    async def main():
+        c = Cluster("abd", n=3, http=False)
+        await c.start()
+        try:
+            assert await do(c["1.1"], 99, cmd_id=1) == b""
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_abd_last_writer_wins():
+    async def main():
+        c = Cluster("abd", n=3, http=False)
+        await c.start()
+        try:
+            for i, val in enumerate([b"a", b"b", b"c"]):
+                await do(c[c.ids[i]], 1, val, cmd_id=i + 1)
+            for i in c.ids:
+                assert await do(c[i], 1, cmd_id=10) == b"c", i
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_abd_tolerates_minority_crash():
+    async def main():
+        c = Cluster("abd", n=3, http=False)
+        await c.start()
+        try:
+            await do(c["1.1"], 5, b"pre", cmd_id=1)
+            c["1.3"].socket.crash(10.0)
+            await do(c["1.1"], 5, b"post", cmd_id=2)
+            assert await do(c["1.2"], 5, cmd_id=3) == b"post"
+        finally:
+            await c.stop()
+    run(main())
+
+
+# -------------------------------------------------------------- chain --
+
+def test_chain_write_head_read_tail():
+    async def main():
+        c = Cluster("chain", n=3, http=False)
+        await c.start()
+        try:
+            await do(c["1.1"], 3, b"v3", cmd_id=1)
+            # propagated down the whole chain before the head acked
+            for i in c.ids:
+                assert c[i].db.get(3) == b"v3", i
+            assert await do(c["1.3"], 3, cmd_id=2) == b"v3"
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_chain_forwarding_any_entry_point():
+    async def main():
+        c = Cluster("chain", n=3, http=False)
+        await c.start()
+        try:
+            # write at the tail (forwarded to head), read at the head
+            # (forwarded to tail)
+            await do(c["1.3"], 8, b"w", cmd_id=1)
+            assert await do(c["1.1"], 8, cmd_id=2) == b"w"
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_chain_many_writes_in_order():
+    async def main():
+        c = Cluster("chain", n=3, http=False)
+        await c.start()
+        try:
+            for k in range(20):
+                await do(c["1.1"], k, f"v{k}".encode(), cmd_id=k + 1)
+            for i in c.ids:
+                assert c[i].seq == 20
+                for k in range(20):
+                    assert c[i].db.get(k) == f"v{k}".encode()
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_chain_single_node():
+    async def main():
+        c = Cluster("chain", n=1, http=False)
+        await c.start()
+        try:
+            await do(c["1.1"], 1, b"solo", cmd_id=1)
+            assert await do(c["1.1"], 1, cmd_id=2) == b"solo"
+        finally:
+            await c.stop()
+    run(main())
