@@ -1,0 +1,88 @@
+"""ctypes loader for the native (C++) runtime components.
+
+The reference's runtime is compiled Go; our host runtime keeps its hot
+CPU paths native too: ``native/lincheck.cpp`` implements the
+linearizability checker's precedence-graph cycle search (history.go
+semantics, same algorithm as host/history.py) as a shared library.
+Loaded lazily; built on demand with ``make -C native`` when a compiler
+is around; everything degrades to the pure-Python path when not.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+EMPTY_VAL = -2
+
+_lincheck = None
+_tried = False
+
+_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(["make", "-C", str(_NATIVE_DIR)],
+                           capture_output=True, timeout=120)
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
+def load_lincheck() -> Optional[ctypes.CDLL]:
+    """The liblincheck.so handle, building it on first use if needed."""
+    global _lincheck, _tried
+    if _tried:
+        return _lincheck
+    _tried = True
+    if os.environ.get("PAXI_TPU_NO_NATIVE"):
+        return None
+    so = _NATIVE_DIR / "liblincheck.so"
+    if not so.exists() and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(str(so))
+        lib.lincheck_key.restype = ctypes.c_int32
+        lib.lincheck_key.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int32,
+        ]
+        if lib.lincheck_version() != 1:
+            return None
+        _lincheck = lib
+    except OSError:
+        _lincheck = None
+    return _lincheck
+
+
+def check_key_native(ops) -> Optional[int]:
+    """Native check_key (host/history.py semantics); None if unavailable."""
+    lib = load_lincheck()
+    if lib is None:
+        return None
+    n = len(ops)
+    is_read = (ctypes.c_int32 * n)()
+    val = (ctypes.c_int64 * n)()
+    start = (ctypes.c_double * n)()
+    end = (ctypes.c_double * n)()
+    ids = {}
+
+    def vid(b: bytes) -> int:
+        if b not in ids:
+            ids[b] = len(ids)
+        return ids[b]
+
+    for i, o in enumerate(ops):
+        is_read[i] = 1 if o.is_read else 0
+        if o.is_read:
+            val[i] = vid(o.output) if o.output else EMPTY_VAL
+        else:
+            val[i] = vid(o.input) if o.input is not None else EMPTY_VAL
+        start[i] = o.start
+        end[i] = o.end
+    return int(lib.lincheck_key(is_read, val, start, end, n))

@@ -18,7 +18,7 @@ The same protocol runs as a vmapped TPU kernel in ``sim.py``.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 from paxi_tpu.core.command import Reply, Request
 from paxi_tpu.core.config import Config

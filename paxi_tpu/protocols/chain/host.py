@@ -13,7 +13,7 @@ The same protocol runs as a vmapped TPU kernel in ``sim.py``.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 from paxi_tpu.core.command import Command, Reply, Request
 from paxi_tpu.core.config import Config
@@ -91,6 +91,8 @@ class ChainReplica(Node):
 
     # ---- down the chain ------------------------------------------------
     def handle_propagate(self, m: Propagate) -> None:
+        if m.seq <= self.seq:
+            return              # duplicate of an already-applied write
         self.buffer[m.seq] = m
         # apply strictly in sequence order (TCP is FIFO per edge, but a
         # restarted link may reorder across reconnects — buffer defends)

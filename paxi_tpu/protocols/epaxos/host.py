@@ -1,0 +1,330 @@
+"""EPaxos replica for the host (deployment) runtime.
+
+Reference: paxi epaxos/ [driver] — leaderless: the replica receiving a
+command becomes its *command leader* in its own instance space
+``(replica, instance)``; PreAccept computes conflict attributes
+(seq, deps) which acceptors merge from their conflict maps; identical
+replies from a fast quorum (ceil(3N/4)) commit on the fast path,
+otherwise Accept (majority) fixes the merged attributes, then Commit;
+execution topologically orders the committed dependency graph by
+strongly-connected components (Tarjan, epaxos exec.go) with seq as the
+tiebreak.  Deps use the standard max-interfering-instance-per-replica
+vector form.
+
+Like the reference's normal-case code this replica does not implement
+the Prepare/recovery path (paxi's epaxos recovery is likewise partial);
+the TPU sim kernel (sim.py) fuzzes the same normal-case protocol.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from paxi_tpu.core.command import Command, Reply, Request
+from paxi_tpu.core.config import Config
+from paxi_tpu.core.ident import ID
+from paxi_tpu.core.quorum import fast_quorum_size, majority_size
+from paxi_tpu.host.codec import register_message
+from paxi_tpu.host.node import Node
+
+NONE, PREACCEPTED, ACCEPTED, COMMITTED, EXECUTED = 0, 1, 2, 3, 4
+
+
+@register_message
+@dataclass
+class PreAccept:
+    owner: str
+    inst: int
+    key: int
+    value: bytes
+    seq: int
+    deps: Dict[str, int]
+    client_id: str = ""
+    command_id: int = 0
+
+
+@register_message
+@dataclass
+class PreAcceptReply:
+    owner: str
+    inst: int
+    seq: int
+    deps: Dict[str, int]
+    id: str
+
+
+@register_message
+@dataclass
+class Accept:
+    owner: str
+    inst: int
+    key: int
+    value: bytes
+    seq: int
+    deps: Dict[str, int]
+    client_id: str = ""
+    command_id: int = 0
+
+
+@register_message
+@dataclass
+class AcceptReply:
+    owner: str
+    inst: int
+    id: str
+
+
+@register_message
+@dataclass
+class Commit:
+    owner: str
+    inst: int
+    key: int
+    value: bytes
+    seq: int
+    deps: Dict[str, int]
+    client_id: str = ""
+    command_id: int = 0
+
+
+@dataclass
+class Instance:
+    command: Command
+    seq: int
+    deps: Dict[ID, int]
+    status: int = PREACCEPTED
+    request: Optional[Request] = None
+    # leader-side tallies
+    replies: int = 1
+    accept_replies: int = 1
+    changed: bool = False
+
+
+class EPaxosReplica(Node):
+    def __init__(self, id: ID, cfg: Config):
+        super().__init__(id, cfg)
+        self.insts: Dict[ID, Dict[int, Instance]] = {i: {} for i in cfg.ids}
+        self.next_inst = 0
+        # conflict map: key -> owner -> latest interfering instance
+        self.conflicts: Dict[int, Dict[ID, int]] = {}
+        self.fast = fast_quorum_size(cfg.n)
+        self.maj = majority_size(cfg.n)
+        self.fast_commits = 0
+        self.slow_commits = 0
+        self.register(Request, self.handle_request)
+        self.register(PreAccept, self.handle_preaccept)
+        self.register(PreAcceptReply, self.handle_preaccept_reply)
+        self.register(Accept, self.handle_accept)
+        self.register(AcceptReply, self.handle_accept_reply)
+        self.register(Commit, self.handle_commit)
+
+    # ---- attribute computation (exec.go conflict map) -------------------
+    def _attrs(self, key: int, excl: Tuple[ID, int]) -> Tuple[int, Dict[ID, int]]:
+        deps: Dict[ID, int] = {}
+        seq = 0
+        for owner, j in self.conflicts.get(key, {}).items():
+            if (owner, j) == excl:
+                j -= 1
+                if j < 0:
+                    continue
+            deps[owner] = j
+            e = self.insts[owner].get(j)
+            if e is not None:
+                seq = max(seq, e.seq)
+        return seq + 1, deps
+
+    def _record(self, owner: ID, inst: int, e: Instance) -> None:
+        self.insts[owner][inst] = e
+        k = e.command.key
+        cur = self.conflicts.setdefault(k, {})
+        cur[owner] = max(cur.get(owner, -1), inst)
+
+    # ---- command leader path --------------------------------------------
+    def handle_request(self, req: Request) -> None:
+        inst = self.next_inst
+        self.next_inst += 1
+        cmd = req.command
+        seq, deps = self._attrs(cmd.key, (self.id, inst))
+        e = Instance(cmd, seq, dict(deps), request=req)
+        self._record(self.id, inst, e)
+        self.socket.broadcast(PreAccept(
+            str(self.id), inst, cmd.key, cmd.value, seq,
+            {str(k): v for k, v in deps.items()},
+            cmd.client_id, cmd.command_id))
+        self._leader_check(inst, e)   # single-node cluster commits at once
+
+    def handle_preaccept(self, m: PreAccept) -> None:
+        owner = ID(m.owner)
+        cmd = Command(m.key, m.value, m.client_id, m.command_id)
+        mseq, mdeps = self._attrs(m.key, (owner, m.inst))
+        seq = max(m.seq, mseq)
+        deps = {ID(k): v for k, v in m.deps.items()}
+        for k, v in mdeps.items():
+            deps[k] = max(deps.get(k, -1), v)
+        prev = self.insts[owner].get(m.inst)
+        if prev is None or prev.status < ACCEPTED:
+            self._record(owner, m.inst, Instance(cmd, seq, dict(deps)))
+        self.socket.send(owner, PreAcceptReply(
+            m.owner, m.inst, seq, {str(k): v for k, v in deps.items()},
+            str(self.id)))
+
+    def handle_preaccept_reply(self, m: PreAcceptReply) -> None:
+        e = self.insts[self.id].get(m.inst)
+        if e is None or e.status != PREACCEPTED or e.request is None:
+            return
+        e.replies += 1
+        deps = {ID(k): v for k, v in m.deps.items()}
+        if m.seq != e.seq or deps != e.deps:
+            e.changed = True
+            e.seq = max(e.seq, m.seq)
+            for k, v in deps.items():
+                e.deps[k] = max(e.deps.get(k, -1), v)
+        self._leader_check(m.inst, e)
+
+    def _leader_check(self, inst: int, e: Instance) -> None:
+        if e.replies >= self.fast and not e.changed:
+            self.fast_commits += 1
+            self._commit(inst, e)
+        elif e.replies >= self.fast and e.changed:
+            self._run_accept(inst, e)
+
+    def _run_accept(self, inst: int, e: Instance) -> None:
+        e.status = ACCEPTED
+        e.accept_replies = 1
+        c = e.command
+        self.socket.broadcast(Accept(
+            str(self.id), inst, c.key, c.value, e.seq,
+            {str(k): v for k, v in e.deps.items()},
+            c.client_id, c.command_id))
+        if e.accept_replies >= self.maj:
+            self.slow_commits += 1
+            self._commit(inst, e)
+
+    def handle_accept(self, m: Accept) -> None:
+        owner = ID(m.owner)
+        cmd = Command(m.key, m.value, m.client_id, m.command_id)
+        prev = self.insts[owner].get(m.inst)
+        e = Instance(cmd, m.seq, {ID(k): v for k, v in m.deps.items()},
+                     status=ACCEPTED,
+                     request=prev.request if prev else None)
+        if prev is None or prev.status < COMMITTED:
+            self._record(owner, m.inst, e)
+        self.socket.send(owner, AcceptReply(m.owner, m.inst, str(self.id)))
+
+    def handle_accept_reply(self, m: AcceptReply) -> None:
+        e = self.insts[self.id].get(m.inst)
+        if e is None or e.status != ACCEPTED or e.request is None:
+            return
+        e.accept_replies += 1
+        if e.accept_replies >= self.maj:
+            self.slow_commits += 1
+            self._commit(m.inst, e)
+
+    def _commit(self, inst: int, e: Instance) -> None:
+        e.status = COMMITTED
+        c = e.command
+        self.socket.broadcast(Commit(
+            str(self.id), inst, c.key, c.value, e.seq,
+            {str(k): v for k, v in e.deps.items()},
+            c.client_id, c.command_id))
+        self._execute()
+
+    def handle_commit(self, m: Commit) -> None:
+        owner = ID(m.owner)
+        prev = self.insts[owner].get(m.inst)
+        e = Instance(Command(m.key, m.value, m.client_id, m.command_id),
+                     m.seq, {ID(k): v for k, v in m.deps.items()},
+                     status=COMMITTED,
+                     request=prev.request if prev else None)
+        self._record(owner, m.inst, e)
+        self._execute()
+
+    # ---- execution (exec.go: Tarjan SCC + seq order) --------------------
+    def _execute(self) -> None:
+        """Execute every committed instance whose transitive dependency
+        closure is committed, SCC-by-SCC in reverse topological order,
+        within an SCC by (seq, owner)."""
+        index: Dict[Tuple[ID, int], int] = {}
+        low: Dict[Tuple[ID, int], int] = {}
+        on_stack: Dict[Tuple[ID, int], bool] = {}
+        stack: List[Tuple[ID, int]] = []
+        counter = [0]
+        blocked: Dict[Tuple[ID, int], bool] = {}
+
+        def node(u: Tuple[ID, int]) -> Optional[Instance]:
+            return self.insts[u[0]].get(u[1])
+
+        def strongconnect(u: Tuple[ID, int]) -> None:
+            # iterative Tarjan (explicit stack) to survive deep chains
+            work = [(u, iter(self._neighbors(u)))]
+            index[u] = low[u] = counter[0]
+            counter[0] += 1
+            stack.append(u)
+            on_stack[u] = True
+            while work:
+                v, it = work[-1]
+                advanced = False
+                for w in it:
+                    nw = node(w)
+                    if nw is None or nw.status < COMMITTED:
+                        blocked[v] = True   # uncommitted dep: defer
+                        continue
+                    if nw.status >= EXECUTED:
+                        continue
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack[w] = True
+                        work.append((w, iter(self._neighbors(w))))
+                        advanced = True
+                        break
+                    elif on_stack.get(w):
+                        low[v] = min(low[v], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[v])
+                    blocked[parent] = blocked.get(parent) or blocked.get(v, False)
+                if low[v] == index[v]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack[w] = False
+                        comp.append(w)
+                        if w == v:
+                            break
+                    if not any(blocked.get(w, False) for w in comp):
+                        comp.sort(key=lambda w: (node(w).seq, str(w[0]), w[1]))
+                        for w in comp:
+                            self._apply(node(w))
+                    else:
+                        for w in comp:
+                            blocked[w] = True
+
+        for owner, insts in self.insts.items():
+            for i, e in sorted(insts.items()):
+                if e.status == COMMITTED and (owner, i) not in index:
+                    strongconnect((owner, i))
+
+    def _neighbors(self, u: Tuple[ID, int]) -> List[Tuple[ID, int]]:
+        e = self.insts[u[0]].get(u[1])
+        if e is None:
+            return []
+        return [(p, j) for p, j in e.deps.items() if j >= 0]
+
+    def _apply(self, e: Instance) -> None:
+        if e.status >= EXECUTED:
+            return
+        e.status = EXECUTED
+        value = self.db.execute(e.command)
+        if e.request is not None:
+            e.request.reply(Reply(e.command, value=value))
+            e.request = None
+
+
+def new_replica(id: ID, cfg: Config) -> EPaxosReplica:
+    return EPaxosReplica(ID(id), cfg)

@@ -1,0 +1,457 @@
+"""EPaxos — leaderless consensus with dependency tracking, as a TPU kernel.
+
+Reference: paxi epaxos/ [driver] — every replica owns an instance space
+``(replica, instance)``; a command leader PreAccepts a command, acceptors
+merge conflict-derived attributes (seq, deps); if a fast quorum
+(ceil(3N/4), quorum.go) returns identical attributes the command commits
+on the fast path, otherwise the leader runs Accept (majority) with the
+merged attributes and then Commit; execution orders the committed
+dependency graph by strongly-connected components with seq as tiebreak
+(epaxos exec.go, Tarjan SCC).  BASELINE config exercises Zipfian
+conflicting keys [driver].
+
+TPU re-design (not a translation):
+- The per-replica instance window is a dense SoA: ``cmd/seq/status
+  [R, R, I]`` and ``deps[R, R, I, R]`` — deps in the standard
+  max-conflict-per-owner vector form (one int per owner replica).
+- Conflict attribute computation (exec.go's conflict map) is a masked
+  max over the recorded window, vectorized over all inboxes at once.
+- Execution replaces Tarjan with **boolean transitive closure by
+  repeated matrix squaring** over the window graph — log2(R*I) bool
+  matmuls that map straight onto the MXU.  SCCs are ``reach & reach^T``;
+  a committed instance executes when every cross-SCC instance it
+  reaches is executed; same-key executables are always in one SCC (two
+  conflicting commands see each other through quorum intersection), so
+  per-step application in global (seq, id) order is linearizable.
+- The in-kernel safety oracle: commit agreement on (cmd, seq, deps),
+  commit/execute stability, and cross-replica agreement of the per-key
+  execution hash chain.
+- No ballots/Prepare (recovery): fuzz crashes pause comms and heal, so
+  liveness resumes without ownership transfer; the host runtime
+  (host.py) carries the message-level recovery surface.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+
+NO_CMD = -1
+ST_NONE, ST_PRE, ST_ACC, ST_COMMIT = 0, 1, 2, 3
+HASH_PRIME = 1000003
+
+
+def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
+    R = cfg.n_replicas
+    dep_fields = tuple(f"d{p}" for p in range(R))
+    return {
+        "pa": ("inst", "seq", "cmd") + dep_fields,    # PreAccept
+        "par": ("inst", "seq") + dep_fields,          # PreAcceptReply
+        "acc": ("inst", "seq", "cmd") + dep_fields,   # Accept
+        "accr": ("inst",),                            # AcceptReply
+        "cmt": ("inst", "seq", "cmd") + dep_fields,   # Commit
+    }
+
+
+def encode_cmd(owner, inst):
+    return (owner << 8) | inst          # unique per (owner, inst), I <= 256
+
+
+def cmd_key(cmd, n_keys):
+    return fib_key(cmd, n_keys)
+
+
+def _deps_pack(m, R, prefix="d"):
+    """Gather dep fields d0..dR-1 from a mailbox into (..., R)."""
+    return jnp.stack([m[f"{prefix}{p}"] for p in range(R)], axis=-1)
+
+
+def _deps_out(deps, R, shape):
+    """Spread (..., R) deps into broadcast per-field planes."""
+    return {f"d{p}": jnp.broadcast_to(deps[..., p], shape)
+            for p in range(R)}
+
+
+def init_state(cfg: SimConfig, rng: jax.Array):
+    R, I, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
+    del rng
+    return dict(
+        cmd=jnp.full((R, R, I), NO_CMD, jnp.int32),
+        seq=jnp.zeros((R, R, I), jnp.int32),
+        deps=jnp.full((R, R, I, R), -1, jnp.int32),
+        status=jnp.zeros((R, R, I), jnp.int32),
+        executed=jnp.zeros((R, R, I), bool),
+        # command-leader driving state (one in-flight instance per replica)
+        cur=jnp.zeros((R,), jnp.int32),
+        phase=jnp.zeros((R,), jnp.int32),     # 0 idle, 1 preaccept, 2 accept
+        pa_acks=jnp.zeros((R, R), bool),
+        ac_acks=jnp.zeros((R, R), bool),
+        agree=jnp.ones((R,), bool),
+        seq0=jnp.zeros((R,), jnp.int32),      # original proposed attrs
+        deps0=jnp.full((R, R), -1, jnp.int32),
+        mseq=jnp.zeros((R,), jnp.int32),      # merged attrs
+        mdeps=jnp.full((R, R), -1, jnp.int32),
+        stuck=jnp.zeros((R,), jnp.int32),
+        # per-key execution oracle: count + order-sensitive hash chain
+        kcount=jnp.zeros((R, K), jnp.int32),
+        khash=jnp.zeros((R, K), jnp.int32),
+    )
+
+
+def _conflict_attrs(state_cmd, state_seq, state_status, new_cmd, excl_owner,
+                    excl_inst, cfg: SimConfig):
+    """Attributes (seq, deps) a replica derives for ``new_cmd`` from its
+    recorded window, excluding the instance itself.
+
+    state_*: (R_own, I) views of ONE replica's window; new_cmd scalar-ish
+    broadcastable leading dims.  Returns (seq, deps[R]).
+    """
+    R, I, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
+    k_new = cmd_key(new_cmd, K)                              # (...,)
+    k_tab = cmd_key(state_cmd, K)                            # (..., R, I)
+    recorded = state_status >= ST_PRE
+    pidx = jnp.arange(R, dtype=jnp.int32)
+    iidx = jnp.arange(I, dtype=jnp.int32)
+    is_self = ((pidx[:, None] == excl_owner[..., None, None])
+               & (iidx[None, :] == excl_inst[..., None, None]))
+    conflict = (recorded & (k_tab == k_new[..., None, None]) & ~is_self)
+    cseq = jnp.max(jnp.where(conflict, state_seq, 0), axis=-1)   # (..., R)
+    cseq = jnp.max(cseq, axis=-1)                                # (...,)
+    cdep = jnp.max(jnp.where(conflict, iidx[None, :], -1), axis=-1)  # (...,R)
+    return cseq + 1, cdep
+
+
+def step(state, inbox, ctx: StepCtx):
+    cfg = ctx.cfg
+    R, I, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
+    MAJ, FAST = cfg.majority, cfg.fast_size
+    N = R * I
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    iidx = jnp.arange(I, dtype=jnp.int32)
+
+    cmd = state["cmd"]
+    seq = state["seq"]
+    deps = state["deps"]
+    status = state["status"]
+    executed = state["executed"]
+    cur = state["cur"]
+    phase = state["phase"]
+    pa_acks = state["pa_acks"]
+    ac_acks = state["ac_acks"]
+    agree = state["agree"]
+    seq0, deps0 = state["seq0"], state["deps0"]
+    mseq, mdeps = state["mseq"], state["mdeps"]
+    kcount, khash = state["kcount"], state["khash"]
+
+    def record(cmd_a, seq_a, deps_a, status_a, v, owner, inst, c, s, d, st):
+        """Masked write of (c, s, d, st) at [me, owner(me), inst(me)].
+
+        v/owner/inst/c/s: (R, R) planes (me, src); d: (R, R, R).
+        Writes are status-monotone: a phase only overwrites attributes
+        recorded by a strictly lower phase (late PreAccepts cannot
+        clobber Accept attrs; commits are frozen forever)."""
+        oh = (v[:, :, None, None]
+              & (ridx[None, None, :, None] == owner[:, :, None, None])
+              & (iidx[None, None, None, :] == inst[:, :, None, None]))
+        # each (owner, inst) cell has exactly one driving src (= owner),
+        # so at most one src writes a given cell per step and a flat
+        # any()/argmax() over the src axis is collision-free
+        hit = jnp.any(oh, axis=1)                         # (me, R, I)
+        pick = jnp.argmax(oh, axis=1)                     # (me, R, I) src idx
+        c_w = jnp.take_along_axis(
+            jnp.broadcast_to(c[:, :, None, None], oh.shape),
+            pick[:, None], axis=1)[:, 0]
+        s_w = jnp.take_along_axis(
+            jnp.broadcast_to(s[:, :, None, None], oh.shape),
+            pick[:, None], axis=1)[:, 0]
+        st_i = jnp.int32(st)
+        wr_c = hit & (status_a < st_i)
+        cmd_a = jnp.where(wr_c, c_w, cmd_a)
+        seq_a = jnp.where(wr_c, s_w, seq_a)
+        d_w = jnp.take_along_axis(
+            jnp.broadcast_to(d[:, :, None, None, :],
+                             oh.shape + (R,)),
+            pick[:, None, :, :, None] * jnp.ones(
+                (1, 1, 1, 1, R), jnp.int32), axis=1)[:, 0]
+        deps_a = jnp.where(wr_c[..., None], d_w, deps_a)
+        status_a = jnp.where(hit, jnp.maximum(status_a, st_i), status_a)
+        return cmd_a, seq_a, deps_a, status_a
+
+    # ---------------- PreAccept: merge conflict attrs, reply ------------
+    m = inbox["pa"]
+    v = jnp.transpose(m["valid"])                          # (me, src)
+    pa_inst = jnp.transpose(m["inst"])
+    pa_seq = jnp.transpose(m["seq"])
+    pa_cmd = jnp.transpose(m["cmd"])
+    pa_deps = jnp.stack([jnp.transpose(m[f"d{p}"]) for p in range(R)],
+                        axis=-1)                           # (me, src, R)
+    own_src = jnp.broadcast_to(ridx[None, :], (R, R))      # owner == src
+    a_seq, a_dep = _conflict_attrs(
+        cmd[:, None], seq[:, None], status[:, None],
+        pa_cmd, own_src, pa_inst, cfg)                     # (me, src[,R])
+    r_seq = jnp.maximum(pa_seq, a_seq)
+    r_deps = jnp.maximum(pa_deps, a_dep)
+    cmd, seq, deps, status = record(
+        cmd, seq, deps, status, v, own_src, pa_inst,
+        pa_cmd, r_seq, r_deps, ST_PRE)
+    out_par = {"valid": v, "inst": pa_inst, "seq": r_seq,
+               **_deps_out(r_deps, R, (R, R))}
+
+    # ---------------- PreAcceptReply at the command leader --------------
+    m = inbox["par"]
+    v = jnp.transpose(m["valid"])                          # (ldr, src)
+    rp_inst = jnp.transpose(m["inst"])
+    rp_seq = jnp.transpose(m["seq"])
+    rp_deps = jnp.stack([jnp.transpose(m[f"d{p}"]) for p in range(R)],
+                        axis=-1)
+    ok = v & (rp_inst == cur[:, None]) & (phase == 1)[:, None] & ~pa_acks
+    pa_acks = pa_acks | ok
+    same = (rp_seq == seq0[:, None]) & jnp.all(
+        rp_deps == deps0[:, None, :], axis=-1)
+    agree = agree & jnp.all(~ok | same, axis=1)
+    mseq = jnp.maximum(mseq, jnp.max(jnp.where(ok, rp_seq, 0), axis=1))
+    mdeps = jnp.maximum(mdeps, jnp.max(
+        jnp.where(ok[..., None], rp_deps, -1), axis=1))
+    n_pa = jnp.sum(pa_acks, axis=1)
+    fast_commit = (phase == 1) & agree & (n_pa >= FAST)
+    go_accept = (phase == 1) & ~fast_commit & (n_pa >= MAJ) & (
+        (~agree & (n_pa >= FAST))
+        | (state["stuck"] >= cfg.retry_timeout))
+
+    # ---------------- AcceptReply then Accept ---------------------------
+    m = inbox["accr"]
+    v = jnp.transpose(m["valid"])
+    ok = v & (jnp.transpose(m["inst"]) == cur[:, None]) & (phase == 2)[:, None]
+    ac_acks = ac_acks | ok
+    slow_commit = (phase == 2) & (jnp.sum(ac_acks, axis=1) >= MAJ)
+
+    m = inbox["acc"]
+    v = jnp.transpose(m["valid"])
+    ac_inst = jnp.transpose(m["inst"])
+    ac_seq = jnp.transpose(m["seq"])
+    ac_cmd = jnp.transpose(m["cmd"])
+    ac_deps = jnp.stack([jnp.transpose(m[f"d{p}"]) for p in range(R)],
+                        axis=-1)
+    cmd, seq, deps, status = record(
+        cmd, seq, deps, status, v, own_src, ac_inst,
+        ac_cmd, ac_seq, ac_deps, ST_ACC)
+    out_accr = {"valid": v, "inst": ac_inst}
+
+    # ---------------- Commit delivery -----------------------------------
+    m = inbox["cmt"]
+    v = jnp.transpose(m["valid"])
+    cm_inst = jnp.transpose(m["inst"])
+    cm_seq = jnp.transpose(m["seq"])
+    cm_cmd = jnp.transpose(m["cmd"])
+    cm_deps = jnp.stack([jnp.transpose(m[f"d{p}"]) for p in range(R)],
+                        axis=-1)
+    cmd, seq, deps, status = record(
+        cmd, seq, deps, status, v, own_src, cm_inst,
+        cm_cmd, cm_seq, cm_deps, ST_COMMIT)
+
+    # ---------------- leader transitions --------------------------------
+    # fast/slow commit: freeze my instance as committed with the decided
+    # attrs (fast: originals == everyone's; slow: merged)
+    dec_seq = jnp.where(fast_commit, seq0, mseq)
+    dec_deps = jnp.where(fast_commit[:, None], deps0, mdeps)
+    do_commit = fast_commit | slow_commit
+    my_cmd = encode_cmd(ridx, jnp.clip(cur, 0, I - 1))
+    oh_me = (ridx[:, None, None] == ridx[None, :, None]) \
+        & (iidx[None, None, :] == jnp.clip(cur, 0, I - 1)[:, None, None])
+    wrm = do_commit[:, None, None] & oh_me
+    cmd = jnp.where(wrm, my_cmd[:, None, None], cmd)
+    seq = jnp.where(wrm, dec_seq[:, None, None], seq)
+    deps = jnp.where(wrm[..., None], dec_deps[:, None, None, :], deps)
+    status = jnp.where(wrm, ST_COMMIT, status)
+    out_cmt_new = {
+        "valid": jnp.broadcast_to(do_commit[:, None], (R, R)),
+        "inst": jnp.broadcast_to(cur[:, None], (R, R)),
+        "seq": jnp.broadcast_to(dec_seq[:, None], (R, R)),
+        "cmd": jnp.broadcast_to(my_cmd[:, None], (R, R)),
+        **_deps_out(jnp.broadcast_to(dec_deps[:, None, :], (R, R, R)),
+                    R, (R, R)),
+    }
+
+    # accept phase start
+    wra = go_accept[:, None, None] & oh_me
+    seq = jnp.where(wra, mseq[:, None, None], seq)
+    deps = jnp.where(wra[..., None], mdeps[:, None, None, :], deps)
+    status = jnp.where(wra, jnp.maximum(status, ST_ACC), status)
+    ac_acks = jnp.where(go_accept[:, None], ridx[None, :] == ridx[:, None],
+                        ac_acks)
+    out_acc = {
+        "valid": jnp.broadcast_to(go_accept[:, None], (R, R)),
+        "inst": jnp.broadcast_to(cur[:, None], (R, R)),
+        "seq": jnp.broadcast_to(mseq[:, None], (R, R)),
+        "cmd": jnp.broadcast_to(my_cmd[:, None], (R, R)),
+        **_deps_out(jnp.broadcast_to(mdeps[:, None, :], (R, R, R)),
+                    R, (R, R)),
+    }
+
+    phase = jnp.where(do_commit, 0, jnp.where(go_accept, 2, phase))
+    cur = cur + do_commit
+    stuck = jnp.where(do_commit | go_accept, 0, state["stuck"])
+
+    # ---------------- propose the next command --------------------------
+    propose = (phase == 0) & (cur < I)
+    p_inst = jnp.clip(cur, 0, I - 1)
+    p_cmd = encode_cmd(ridx, p_inst)
+    p_seq, p_deps = _conflict_attrs(cmd, seq, status, p_cmd,
+                                    ridx, p_inst, cfg)     # own-window attrs
+    oh_p = (ridx[:, None, None] == ridx[None, :, None]) \
+        & (iidx[None, None, :] == p_inst[:, None, None])
+    wrp = propose[:, None, None] & oh_p
+    cmd = jnp.where(wrp, p_cmd[:, None, None], cmd)
+    seq = jnp.where(wrp, p_seq[:, None, None], seq)
+    deps = jnp.where(wrp[..., None], p_deps[:, None, None, :], deps)
+    status = jnp.where(wrp, jnp.maximum(status, ST_PRE), status)
+    seq0 = jnp.where(propose, p_seq, seq0)
+    deps0 = jnp.where(propose[:, None], p_deps, deps0)
+    mseq = jnp.where(propose, p_seq, mseq)
+    mdeps = jnp.where(propose[:, None], p_deps, mdeps)
+    agree = jnp.where(propose, True, agree)
+    pa_acks = jnp.where(propose[:, None], ridx[None, :] == ridx[:, None],
+                        pa_acks)
+    phase = jnp.where(propose, 1, phase)
+
+    # retransmit the in-flight phase message when stuck
+    retry = (stuck >= cfg.retry_timeout)
+    send_pa = propose | (retry & (phase == 1))
+    send_acc = go_accept | (retry & (phase == 2))
+    out_pa = {
+        "valid": jnp.broadcast_to(send_pa[:, None], (R, R)),
+        "inst": jnp.broadcast_to(p_inst[:, None], (R, R)),
+        "seq": jnp.broadcast_to(seq0[:, None], (R, R)),
+        "cmd": jnp.broadcast_to(encode_cmd(ridx, p_inst)[:, None], (R, R)),
+        **_deps_out(jnp.broadcast_to(deps0[:, None, :], (R, R, R)),
+                    R, (R, R)),
+    }
+    out_acc["valid"] = jnp.broadcast_to(send_acc[:, None], (R, R))
+    stuck = jnp.where(retry, 0, stuck + (phase > 0))
+
+    # late/periodic commit retransmit: round-robin over my committed
+    # instances so followers with dropped cmt messages eventually heal
+    rr = ctx.t % jnp.maximum(cur, 1)
+    rr_cmd = cmd[ridx, ridx, rr]
+    rr_committed = (status[ridx, ridx, rr] == ST_COMMIT) & ~jnp.any(
+        out_cmt_new["valid"], axis=1)
+    out_cmt = {
+        "valid": out_cmt_new["valid"] | rr_committed[:, None],
+        "inst": jnp.where(out_cmt_new["valid"], out_cmt_new["inst"],
+                          rr[:, None] * jnp.ones((1, R), jnp.int32)),
+        "seq": jnp.where(out_cmt_new["valid"], out_cmt_new["seq"],
+                         seq[ridx, ridx, rr][:, None]),
+        "cmd": jnp.where(out_cmt_new["valid"], out_cmt_new["cmd"],
+                         rr_cmd[:, None]),
+        **{f"d{p}": jnp.where(out_cmt_new["valid"], out_cmt_new[f"d{p}"],
+                              deps[ridx, ridx, rr, p][:, None])
+           for p in range(R)},
+    }
+
+    # ---------------- execution: closure -> SCC -> ordered apply --------
+    committed = (status == ST_COMMIT).reshape(R, N)
+    seq_f = seq.reshape(R, N)
+    cmd_f = cmd.reshape(R, N)
+    exec_f = executed.reshape(R, N)
+    # adjacency: u=(p,j) -> v=(q, deps[u][q])
+    A = jnp.zeros((R, N, N), bool)
+    deps_f = deps.reshape(R, N, R)
+    for q in range(R):
+        tgt = deps_f[:, :, q]                              # (R, N)
+        has = tgt >= 0
+        col = q * I + jnp.clip(tgt, 0, I - 1)
+        A = A | (has[:, :, None]
+                 & (jnp.arange(N)[None, None, :] == col[:, :, None]))
+    A = A & committed[:, :, None]       # only committed sources constrain
+    reach = A
+    n_iter = max(1, (N - 1).bit_length())
+    for _ in range(n_iter):
+        reach = reach | (jnp.matmul(reach.astype(jnp.float32),
+                                    reach.astype(jnp.float32)) > 0)
+    # an instance is ready when every reachable dep is committed
+    blocked = jnp.any(reach & ~committed[:, None, :], axis=2)
+    ready = committed & ~blocked & ~exec_f
+    scc = reach & jnp.swapaxes(reach, 1, 2)
+    cross = reach & ~scc
+    exec_ok = ready & ~jnp.any(cross & ~exec_f[:, None, :], axis=2)
+    # apply up to exec_window commands in global (seq, id) order
+    BIG = jnp.int32(1 << 20)
+    order = seq_f * N + jnp.arange(N)[None, :]
+    new_exec = exec_f
+    for _ in range(cfg.exec_window):
+        cand = exec_ok & ~new_exec
+        pick = jnp.argmin(jnp.where(cand, order, BIG), axis=1)   # (R,)
+        any_c = jnp.any(cand, axis=1)
+        c_e = cmd_f[ridx, pick]
+        k_e = cmd_key(c_e, K)
+        ohk = any_c[:, None] & (jnp.arange(K)[None, :] == k_e[:, None])
+        khash = jnp.where(ohk, khash * HASH_PRIME + c_e[:, None], khash)
+        kcount = kcount + ohk
+        new_exec = new_exec | (any_c[:, None]
+                               & (jnp.arange(N)[None, :] == pick[:, None]))
+    executed = new_exec.reshape(R, R, I)
+
+    new_state = dict(
+        cmd=cmd, seq=seq, deps=deps, status=status, executed=executed,
+        cur=cur, phase=phase, pa_acks=pa_acks, ac_acks=ac_acks,
+        agree=agree, seq0=seq0, deps0=deps0, mseq=mseq, mdeps=mdeps,
+        stuck=stuck, kcount=kcount, khash=khash,
+    )
+    outbox = {"pa": out_pa, "par": out_par, "acc": out_acc,
+              "accr": out_accr, "cmt": out_cmt}
+    return new_state, outbox
+
+
+def metrics(state, cfg: SimConfig):
+    com = jnp.any(state["status"] == ST_COMMIT, axis=0)    # (R, I) anywhere
+    return {
+        "committed_slots": jnp.sum(com),
+        "executed": jnp.max(jnp.sum(state["executed"], axis=(1, 2))),
+        "fastpath_cur": jnp.sum(state["cur"]),
+    }
+
+
+def invariants(old, new, cfg: SimConfig) -> jax.Array:
+    """1. Commit agreement: two replicas that both committed (p, j)
+    agree on (cmd, seq, deps).  2. Stability: commits never change
+    attrs or un-commit; executed is monotone.  3. Executed implies
+    committed.  4. Execution-order agreement: replicas with equal
+    per-key counts have equal per-key hash chains."""
+    c = new["status"] == ST_COMMIT                        # (Rv, R, I)
+    pair = c[:, None] & c[None, :]                        # (Rv, Rv, R, I)
+    same = ((new["cmd"][:, None] == new["cmd"][None, :])
+            & (new["seq"][:, None] == new["seq"][None, :])
+            & jnp.all(new["deps"][:, None] == new["deps"][None, :],
+                      axis=-1))
+    v_agree = jnp.sum(pair & ~same) // 2
+
+    was = old["status"] == ST_COMMIT
+    v_stable = jnp.sum(was & ((new["status"] != ST_COMMIT)
+                              | (new["cmd"] != old["cmd"])
+                              | (new["seq"] != old["seq"])
+                              | jnp.any(new["deps"] != old["deps"],
+                                        axis=-1)))
+    v_exec_mono = jnp.sum(old["executed"] & ~new["executed"])
+    v_exec_com = jnp.sum(new["executed"] & ~c)
+
+    eqc = new["kcount"][:, None] == new["kcount"][None, :]
+    eqh = new["khash"][:, None] == new["khash"][None, :]
+    v_order = jnp.sum(eqc & ~eqh) // 2
+
+    return (v_agree + v_stable + v_exec_mono + v_exec_com
+            + v_order).astype(jnp.int32)
+
+
+PROTOCOL = SimProtocol(
+    name="epaxos",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=step,
+    metrics=metrics,
+    invariants=invariants,
+)

@@ -1,0 +1,231 @@
+"""KPaxos — statically key-partitioned Multi-Paxos as a pure TPU kernel.
+
+Reference: paxi kpaxos/ — the key space is split into static partitions,
+each owned by a fixed leader running its own Paxos log (per-partition
+``paxos.Paxos`` instances); the contrast case to WPaxos's dynamic object
+stealing.  With leaders fixed there are no elections: every replica
+permanently runs phase-2 for its own partition and accepts for all
+others.
+
+TPU re-design — the multi-leader structure is a *vectorization win*:
+partition index == leader index, so a replica's inbox holds up to R
+concurrent P2a messages (one per partition/source) and all of them are
+applied in one masked scatter — no argmax winner-pick like the
+single-leader paxos kernel needs.  Per-replica state carries an
+(R partitions x S slots) log replica-of-record; commit = majority
+popcount over the leader's per-slot ack matrix; execution advances an
+independent frontier per partition.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+
+NO_CMD = -1
+
+
+def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
+    # partition is implicit: == src for p2a/p3, == dst for p2b
+    return {
+        "p2a": ("slot", "cmd"),
+        "p2b": ("slot",),
+        "p3": ("slot", "cmd", "upto"),
+    }
+
+
+def encode_cmd(part, slot):
+    """Unique command id per (partition, slot) proposal."""
+    return ((part & 0x7FFF) << 16) | (slot & 0xFFFF)
+
+
+def init_state(cfg: SimConfig, rng: jax.Array):
+    R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
+    del rng
+    return dict(
+        # replica-of-record logs: [replica, partition, slot]
+        log_cmd=jnp.full((R, R, S), NO_CMD, jnp.int32),
+        log_commit=jnp.zeros((R, R, S), bool),
+        # leader-side state for my own partition
+        acks=jnp.zeros((R, S, R), bool),   # [ldr, slot, src]
+        next_slot=jnp.zeros((R,), jnp.int32),
+        # execution frontier per partition at each replica
+        execute=jnp.zeros((R, R), jnp.int32),
+        kv=jnp.zeros((R, K), jnp.int32),
+        stuck=jnp.zeros((R,), jnp.int32),
+    )
+
+
+def step(state, inbox, ctx: StepCtx):
+    cfg = ctx.cfg
+    R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
+    MAJ = cfg.majority
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    sidx = jnp.arange(S, dtype=jnp.int32)
+
+    log_cmd = state["log_cmd"]
+    log_commit = state["log_commit"]
+    acks = state["acks"]
+    next_slot = state["next_slot"]
+    execute = state["execute"]
+    kv = state["kv"]
+
+    # ---------------- P2a: accept for partition == src ------------------
+    m = inbox["p2a"]
+    # scatter (src, dst) messages into [dst(replica), src(partition), slot]
+    v = jnp.transpose(m["valid"])                  # (dst, src)
+    slot = jnp.transpose(m["slot"])
+    cmd = jnp.transpose(m["cmd"])
+    oh = v[:, :, None] & (sidx[None, None, :] == slot[:, :, None])
+    wr = oh & ~log_commit                          # committed entries frozen
+    log_cmd = jnp.where(wr, cmd[:, :, None], log_cmd)
+    # reply to the leader: outbox planes are [sender, recipient]; the
+    # sender is this acceptor (our dst axis), the recipient the p2a's src
+    out_p2b = {"valid": v, "slot": slot}
+
+    # ---------------- P2b: leader tallies, commits own partition --------
+    m = inbox["p2b"]
+    okb = jnp.transpose(m["valid"])                # (ldr, src)
+    bslot = jnp.transpose(m["slot"])
+    add = okb[:, :, None] & (sidx[None, None, :] == bslot[:, :, None])
+    acks = acks | jnp.transpose(add, (0, 2, 1))    # (ldr, slot, src)
+    mine = log_cmd[ridx, ridx]                     # (ldr, S) my partition log
+    newly = ((jnp.sum(acks, axis=2) >= MAJ) & (mine != NO_CMD)
+             & ~log_commit[ridx, ridx])
+    self_part = ridx[:, None, None] == ridx[None, :, None]  # (rep,part,1)
+    log_commit = log_commit | (self_part & newly[:, None, :])
+
+    # ---------------- P3: commit notifications for partition == src -----
+    m = inbox["p3"]
+    v = jnp.transpose(m["valid"])                  # (dst, src)
+    slot = jnp.transpose(m["slot"])
+    cmd = jnp.transpose(m["cmd"])
+    upto = jnp.transpose(m["upto"])
+    oh = v[:, :, None] & (sidx[None, None, :] == slot[:, :, None])
+    log_cmd = jnp.where(oh, cmd[:, :, None], log_cmd)
+    log_commit = log_commit | oh
+    # frontier rule: a static leader proposes exactly one command per
+    # slot, so any locally-accepted slot < upto is safe to commit
+    ohu = (v[:, :, None] & (sidx[None, None, :] < upto[:, :, None])
+           & (log_cmd != NO_CMD))
+    log_commit = log_commit | ohu
+
+    # ---------------- leader proposes in its own partition --------------
+    # new slot while the pipe is healthy; retransmit the frontier slot
+    # when it has stalled for retry_timeout steps (lost p2a/p2b)
+    my_exec = execute[ridx, ridx]                  # (ldr,)
+    retry = state["stuck"] >= cfg.retry_timeout
+    can_new = next_slot < S
+    prop_slot = jnp.where(retry, jnp.clip(my_exec, 0, S - 1),
+                          next_slot).astype(jnp.int32)
+    do = can_new | retry
+    new_cmd = encode_cmd(ridx, prop_slot)
+    re_cmd = mine[ridx, jnp.clip(prop_slot, 0, S - 1)]
+    prop_cmd = jnp.where(retry & (re_cmd != NO_CMD), re_cmd, new_cmd)
+    # self-accept + self-ack
+    ohp = do[:, None] & (sidx[None, :] == prop_slot[:, None])
+    self_row = self_part & ohp[:, None, :]
+    log_cmd = jnp.where(self_row & ~log_commit, prop_cmd[:, None, None],
+                        log_cmd)
+    acks = acks | (ohp[:, :, None] & (ridx[None, None, :] == ridx[:, None, None]))
+    next_slot = next_slot + (do & ~retry & can_new)
+    out_p2a = {
+        "valid": jnp.broadcast_to(do[:, None], (R, R)),
+        "slot": jnp.broadcast_to(prop_slot[:, None], (R, R)),
+        "cmd": jnp.broadcast_to(prop_cmd[:, None], (R, R)),
+    }
+
+    # ---------------- execute committed prefixes, apply to KV -----------
+    # each replica advances R independent frontiers; keys are partition-
+    # striped (key = part + R * hash) so applies never conflict
+    advanced = jnp.zeros((R, R), jnp.int32)
+    running = jnp.ones((R, R), bool)
+    for e in range(cfg.exec_window):
+        idx = jnp.clip(execute + e, 0, S - 1)      # (rep, part)
+        inb = (execute + e) < S
+        com = jnp.take_along_axis(log_commit, idx[:, :, None], axis=2)[..., 0]
+        running = running & com & inb
+        cmd_e = jnp.take_along_axis(log_cmd, idx[:, :, None], axis=2)[..., 0]
+        key_e = (ridx[None, :] + R * fib_key(cmd_e, max(K // R, 1))) % K
+        wr = running & (cmd_e >= 0)
+        ohk = wr[:, :, None] & (jnp.arange(K)[None, None, :] == key_e[:, :, None])
+        kv = jnp.where(jnp.any(ohk, axis=1),
+                       jnp.max(jnp.where(ohk, cmd_e[:, :, None], -1), axis=1),
+                       kv)
+        advanced = advanced + running
+    new_execute = execute + advanced
+
+    # ---------------- stuck-frontier counter (drives retransmits) -------
+    my_exec_new = new_execute[ridx, ridx]
+    stalled = (my_exec_new == my_exec) & (next_slot > my_exec_new)
+    stuck = jnp.where(retry, 0, jnp.where(stalled, state["stuck"] + 1, 0))
+
+    # ---------------- P3 out: newly committed or frontier retransmit ----
+    low_new = jnp.argmin(jnp.where(newly, sidx[None, :], S), axis=1)
+    any_new = jnp.any(newly, axis=1)
+    # otherwise cycle retransmits through my committed prefix (leader-
+    # local knowledge only: laggards' holes are all < my frontier, so a
+    # round-robin over it eventually re-covers every hole)
+    rr = ctx.t % jnp.maximum(my_exec_new, 1)
+    p3_slot = jnp.where(any_new, low_new,
+                        jnp.clip(rr, 0, S - 1)).astype(jnp.int32)
+    p3_committed = log_commit[ridx, ridx, p3_slot]
+    p3_cmd = mine[ridx, p3_slot]
+    p3_do = p3_committed
+    my_upto = new_execute[ridx, ridx]
+    out_p3 = {
+        "valid": jnp.broadcast_to(p3_do[:, None], (R, R)),
+        "slot": jnp.broadcast_to(p3_slot[:, None], (R, R)),
+        "cmd": jnp.broadcast_to(p3_cmd[:, None], (R, R)),
+        "upto": jnp.broadcast_to(my_upto[:, None], (R, R)),
+    }
+
+    new_state = dict(
+        log_cmd=log_cmd, log_commit=log_commit, acks=acks,
+        next_slot=next_slot, execute=new_execute, kv=kv, stuck=stuck,
+    )
+    outbox = {"p2a": out_p2a, "p2b": out_p2b, "p3": out_p3}
+    return new_state, outbox
+
+
+def metrics(state, cfg: SimConfig):
+    """Committed slots summed over all partitions (most advanced copy)."""
+    return {
+        "committed_slots": jnp.sum(jnp.max(state["execute"], axis=0)),
+        "min_execute": jnp.min(state["execute"]),
+    }
+
+
+def invariants(old, new, cfg: SimConfig) -> jax.Array:
+    """1. Agreement: committed commands for a (partition, slot) agree.
+    2. Stability: committed entries never change or un-commit.
+    3. Executed prefix is committed."""
+    BIG = jnp.int32(2**30)
+    c, cmd = new["log_commit"], new["log_cmd"]
+    mx = jnp.max(jnp.where(c, cmd, -BIG), axis=0)   # (part, slot)
+    mn = jnp.min(jnp.where(c, cmd, BIG), axis=0)
+    n_c = jnp.sum(c, axis=0)
+    v_agree = jnp.sum((n_c >= 1) & (mx != mn))
+
+    was = old["log_commit"]
+    v_stable = jnp.sum(was & (~c | (cmd != old["log_cmd"])))
+
+    prefix_len = jnp.sum(jnp.cumprod(c.astype(jnp.int32), axis=2), axis=2)
+    v_exec = jnp.sum(new["execute"] > prefix_len)
+
+    return (v_agree + v_stable + v_exec).astype(jnp.int32)
+
+
+PROTOCOL = SimProtocol(
+    name="kpaxos",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=step,
+    metrics=metrics,
+    invariants=invariants,
+)

@@ -253,9 +253,12 @@ def step(state, inbox, ctx: StepCtx):
     # ---------------- P3 out: newly committed + frontier retransmit -----
     low_new = jnp.argmin(jnp.where(newly, sidx[None, :], S), axis=1)
     any_new = jnp.any(newly, axis=1)
-    gmin = jnp.min(new_execute)  # group-min frontier (sim-side global read)
+    # otherwise cycle retransmits through my committed prefix (leader-
+    # local knowledge only: laggards' holes are all below my frontier,
+    # so a round-robin over it eventually re-covers every hole)
+    rr = ctx.t % jnp.maximum(new_execute, 1)
     p3_slot = jnp.where(any_new, low_new,
-                        jnp.clip(gmin, 0, S - 1)).astype(jnp.int32)
+                        jnp.clip(rr, 0, S - 1)).astype(jnp.int32)
     p3_committed = jnp.take_along_axis(
         log_commit, p3_slot[:, None], axis=1)[:, 0]
     p3_cmd = jnp.take_along_axis(log_cmd, p3_slot[:, None], axis=1)[:, 0]

@@ -1,0 +1,469 @@
+"""WPaxos — multi-leader WAN Paxos with object stealing, as a TPU kernel.
+
+Reference: paxi wpaxos/ [driver] — every key is a separate Paxos object
+whose ballot embeds the owning zone/node; a zone *steals* an object by
+running phase-1 on that object's ballot when the access policy
+(policy.go, ``Config.Policy``/``Threshold``) says its clients dominate;
+quorums are flexible grids (quorum.go): phase-1 needs zone-majorities in
+``Z - q2 + 1`` zones, phase-2 only in ``q2`` zones (q2=1 => steady-state
+commits stay inside the owner's zone — the WAN latency win the paper
+dissects).  BASELINE config: 3x3 zone grid, locality-skewed workload.
+
+TPU re-design (not a translation):
+- Replicas r in 0..R-1 are arranged in Z zones of R/Z nodes,
+  ``zone(r) = r // (R/Z)``.
+- Per-object per-replica log SoA: ``log_{bal,cmd,commit}[R, O, S]`` and
+  a 4-D phase-2 ack matrix ``log_acks[R, O, S, R]``; quorum tests are
+  zone-segmented popcounts (zone-majority per zone, then >= q1 / q2
+  zones).
+- The workload generator is in-kernel: each replica demands one object
+  per step, drawn home-zone-biased (``cfg.locality``).  Owners propose
+  for the demanded object; non-owners accumulate per-object demand
+  (``hits``) — the requester-side form of policy.go's counters — and
+  fire a phase-1 steal at ``steal_threshold``.
+- At most one steal is in flight per replica (``steal_obj``); P1b acks
+  are merged with the same by-reference log-merge argument as the
+  paxos kernel (acceptor logs only grow in ballot).
+- All handlers are fully masked; messages for *different* objects from
+  different sources in the same step are all applied via dense
+  (dst, src, O) one-hot scatters, per-(dst, obj) max-ballot selected.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+
+NO_CMD = -1
+NOOP = -2
+
+
+def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
+    return {
+        "p1a": ("obj", "bal"),
+        "p1b": ("obj", "bal"),
+        "p2a": ("obj", "bal", "slot", "cmd"),
+        "p2b": ("obj", "bal", "slot"),
+        "p3": ("obj", "bal", "slot", "cmd", "upto"),
+    }
+
+
+def encode_cmd(bal, slot):
+    return ((bal & 0x7FFF) << 16) | (slot & 0xFFFF)
+
+
+def _zone_of(ridx, npz):
+    return ridx // npz
+
+
+def _zone_quorums(acks, cfg: SimConfig):
+    """acks: (..., R) boolean -> (...,) count of zones with a
+    zone-majority of acks (the flexible-grid primitive, quorum.go)."""
+    Z = cfg.n_zones
+    npz = cfg.n_replicas // Z
+    per_zone = jnp.sum(acks.reshape(acks.shape[:-1] + (Z, npz)), axis=-1)
+    return jnp.sum(per_zone >= (npz // 2 + 1), axis=-1)
+
+
+def init_state(cfg: SimConfig, rng: jax.Array):
+    R, O, S = cfg.n_replicas, cfg.n_objects, cfg.n_slots
+    del rng
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    oidx = jnp.arange(O, dtype=jnp.int32)
+    owner0 = oidx % R                      # initial round-robin ownership
+    return dict(
+        # per-object ballots: round 1, owner0 (everyone agrees at init)
+        ballot=jnp.broadcast_to(cfg.ballot_stride + owner0[None, :],
+                                (R, O)).astype(jnp.int32),
+        active=(ridx[:, None] == owner0[None, :]),
+        log_bal=jnp.zeros((R, O, S), jnp.int32),
+        log_cmd=jnp.full((R, O, S), NO_CMD, jnp.int32),
+        log_commit=jnp.zeros((R, O, S), bool),
+        log_acks=jnp.zeros((R, O, S, R), bool),
+        proposed=jnp.zeros((R, O, S), bool),
+        next_slot=jnp.zeros((R, O), jnp.int32),
+        execute=jnp.zeros((R, O), jnp.int32),
+        kv=jnp.zeros((R, O), jnp.int32),       # object register (last cmd)
+        hits=jnp.zeros((R, O), jnp.int32),     # policy demand counters
+        steal_obj=jnp.full((R,), -1, jnp.int32),
+        p1_acks=jnp.zeros((R, R), bool),       # for the in-flight steal
+        steal_timer=jnp.zeros((R,), jnp.int32),
+        steals=jnp.zeros((), jnp.int32),       # completed steals (metric)
+    )
+
+
+def step(state, inbox, ctx: StepCtx):
+    cfg = ctx.cfg
+    R, O, S = cfg.n_replicas, cfg.n_objects, cfg.n_slots
+    Z, STRIDE = cfg.n_zones, cfg.ballot_stride
+    npz = R // Z
+    Q1 = Z - cfg.grid_q2 + 1
+    Q2 = cfg.grid_q2
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    oidx = jnp.arange(O, dtype=jnp.int32)
+    sidx = jnp.arange(S, dtype=jnp.int32)
+
+    ballot = state["ballot"]          # (R, O)
+    active = state["active"]
+    log_bal = state["log_bal"]        # (R, O, S)
+    log_cmd = state["log_cmd"]
+    log_commit = state["log_commit"]
+    log_acks = state["log_acks"]      # (R, O, S, R)
+    proposed = state["proposed"]
+    next_slot = state["next_slot"]    # (R, O)
+    execute = state["execute"]
+    kv = state["kv"]
+    hits = state["hits"]
+    steal_obj = state["steal_obj"]    # (R,)
+    p1_acks = state["p1_acks"]        # (R, R)
+    steals = state["steals"]
+
+    def per_obj_best(m, extra=()):
+        """Select, per (dst, obj), the max-ballot message among sources.
+
+        Returns (has, bal, *extra_fields) each of shape (R, O)."""
+        v = jnp.transpose(m["valid"])                  # (dst, src)
+        ob = jnp.transpose(m["obj"])
+        bl = jnp.transpose(m["bal"])
+        onehot = v[:, :, None] & (ob[:, :, None] == oidx[None, None, :])
+        b3 = jnp.where(onehot, bl[:, :, None], -1)     # (dst, src, O)
+        src_best = jnp.argmax(b3, axis=1)              # (dst, O)
+        bal_best = jnp.max(b3, axis=1)
+        has = bal_best > 0
+
+        def pick(f):
+            f3 = jnp.broadcast_to(jnp.transpose(m[f])[:, :, None], b3.shape)
+            return jnp.take_along_axis(f3, src_best[:, None, :],
+                                       axis=1)[:, 0, :]
+
+        return has, bal_best, src_best, [pick(f) for f in extra]
+
+    # ---------------- P1a: promise to higher per-object ballots ---------
+    m = inbox["p1a"]
+    has1, b1, src1, _ = per_obj_best(m)
+    promote = has1 & (b1 > ballot)                     # (dst, O)
+    ballot = jnp.where(promote, b1, ballot)
+    active = active & ~promote
+    # a promoted object kills my own in-flight steal of it
+    my_steal_oh = (steal_obj[:, None] == oidx[None, :])
+    steal_killed = jnp.any(promote & my_steal_oh, axis=1)
+    steal_obj = jnp.where(steal_killed, -1, steal_obj)
+    # P1b back to the (single) best stealer per promoted object; a replica
+    # can promote several objects in one step but the mailbox holds one
+    # p1b per edge — reply for the highest-ballot promoted object
+    # (stealers retry via steal_timer, so serializing here is safe)
+    pb = jnp.where(promote, b1, -1)
+    best_o = jnp.argmax(pb, axis=1)                    # (dst,)
+    any_p = jnp.any(promote, axis=1)
+    to_src = src1[ridx, best_o]
+    out_p1b = {
+        "valid": any_p[:, None] & (ridx[None, :] == to_src[:, None]),
+        "obj": jnp.broadcast_to(best_o[:, None].astype(jnp.int32), (R, R)),
+        "bal": jnp.broadcast_to(ballot[ridx, best_o][:, None], (R, R)),
+    }
+
+    # ---------------- P1b: stealer tallies grid-quorum acks -------------
+    m = inbox["p1b"]
+    v = jnp.transpose(m["valid"])                      # (me, src)
+    ob = jnp.transpose(m["obj"])
+    bl = jnp.transpose(m["bal"])
+    my_obj = steal_obj[:, None]
+    my_bal = ballot[ridx, jnp.clip(steal_obj, 0, O - 1)][:, None]
+    ack = v & (ob == my_obj) & (bl == my_bal) & (steal_obj >= 0)[:, None]
+    p1_acks = p1_acks | ack
+    zq = _zone_quorums(p1_acks, cfg)                   # (me,)
+    p1_win = (steal_obj >= 0) & (zq >= Q1)
+
+    # ---------------- steal win: adopt object, merge ackers' logs -------
+    so = jnp.clip(steal_obj, 0, O - 1)
+    win_oh = p1_win[:, None] & (oidx[None, :] == so[:, None])   # (R, O)
+    amask = p1_acks                                    # (me, src)
+    # merge the stolen object's log across ackers (by reference)
+    lb_o = log_bal[:, so, :].transpose(1, 0, 2)        # (me, src, S) ... no:
+    # log_bal[src, so[me], slot] -> build via take: for each me, object so[me]
+    lb = jnp.take(log_bal, so, axis=1)                 # (src, me, S)
+    lb = jnp.transpose(lb, (1, 0, 2))                  # (me, src, S)
+    lc = jnp.transpose(jnp.take(log_cmd, so, axis=1), (1, 0, 2))
+    lk = jnp.transpose(jnp.take(log_commit, so, axis=1), (1, 0, 2))
+    lbm = jnp.where(amask[:, :, None], lb, -1)
+    src_best = jnp.argmax(lbm, axis=1)                 # (me, S)
+    best_bal = jnp.max(lbm, axis=1)
+    merged_cmd = jnp.take_along_axis(lc, src_best[:, None, :], axis=1)[:, 0]
+    cmask = amask[:, :, None] & lk
+    merged_commit = jnp.any(cmask, axis=1)
+    csrc = jnp.argmax(cmask, axis=1)
+    committed_cmd = jnp.take_along_axis(lc, csrc[:, None, :], axis=1)[:, 0]
+    has_acc = (best_bal > 0) | merged_commit
+    top = jnp.max(jnp.where(has_acc, sidx[None, :] + 1, 0), axis=1)  # (me,)
+    my_next = next_slot[ridx, so]
+    new_next = jnp.maximum(my_next, top)
+    in_win = sidx[None, :] < new_next[:, None]         # (me, S)
+    adopt_cmd = jnp.where(merged_commit, committed_cmd,
+                          jnp.where(best_bal > 0, merged_cmd, NOOP))
+    w3 = win_oh[:, :, None]                            # (R, O, 1)
+    iw3 = in_win[:, None, :]                           # (R, 1, S)
+    my_bal2 = ballot[ridx, so]                         # (me,)
+    log_cmd = jnp.where(w3 & iw3, adopt_cmd[:, None, :], log_cmd)
+    log_bal = jnp.where(w3 & iw3, my_bal2[:, None, None], log_bal)
+    log_commit = jnp.where(w3 & iw3,
+                           merged_commit[:, None, :] | log_commit,
+                           log_commit)
+    keep = merged_commit[:, None, :] | jnp.take_along_axis(
+        log_commit, so[:, None, None] * jnp.ones((1, 1, S), jnp.int32),
+        axis=1)[:, 0][:, None, :]
+    proposed = jnp.where(w3, iw3 & keep, proposed)
+    self_only = ridx[None, None, None, :] == ridx[:, None, None, None]
+    log_acks = jnp.where(w3[..., None], iw3[..., None] & self_only,
+                         log_acks)
+    next_slot = jnp.where(win_oh, new_next[:, None], next_slot)
+    active = active | win_oh
+    steals = steals + jnp.sum(p1_win)
+    steal_obj = jnp.where(p1_win, -1, steal_obj)
+    p1_acks = p1_acks & ~p1_win[:, None]
+
+    own = (ballot % STRIDE) == ridx[:, None]           # (R, O)
+
+    # ---------------- P2a: accept from the highest-ballot owner ---------
+    m = inbox["p2a"]
+    has2, b2, src2, (slot2, cmd2) = per_obj_best(m, ("slot", "cmd"))
+    acc_ok = has2 & (b2 >= ballot)                     # (dst, O)
+    demote = acc_ok & (b2 > ballot)
+    ballot = jnp.where(acc_ok, b2, ballot)
+    active = active & ~demote
+    sk = jnp.any(demote & my_steal_oh, axis=1)
+    steal_obj = jnp.where(sk, -1, steal_obj)
+    oh = (acc_ok[:, :, None] & (sidx[None, None, :] == slot2[:, :, None]))
+    writable = oh & (log_bal <= b2[:, :, None]) & ~log_commit
+    log_bal = jnp.where(writable, b2[:, :, None], log_bal)
+    log_cmd = jnp.where(writable, cmd2[:, :, None], log_cmd)
+    # p2b back to the accepted object's owner — one per edge; pick the
+    # highest-ballot accepted object per destination owner is overkill:
+    # since each owner proposes one object per step, per (dst, src-owner)
+    # there is at most one accepted p2a => reply on that edge directly
+    v2 = jnp.transpose(m["valid"])                     # (dst, src)
+    ob2 = jnp.transpose(m["obj"])
+    # accepted mask per (dst, src): the p2a on this edge was the winner
+    win_edge = (v2 & (jnp.take_along_axis(acc_ok, ob2, axis=1))
+                & (jnp.take_along_axis(src2, ob2, axis=1)
+                   == ridx[None, :]))
+    out_p2b = {
+        "valid": win_edge,
+        "obj": ob2,
+        "bal": jnp.transpose(m["bal"]),
+        "slot": jnp.transpose(m["slot"]),
+    }
+
+    own = (ballot % STRIDE) == ridx[:, None]
+
+    # ---------------- P2b: owner tallies zone-grid acks, commits --------
+    m = inbox["p2b"]
+    v = jnp.transpose(m["valid"])                      # (own, src)
+    ob = jnp.transpose(m["obj"])
+    bl = jnp.transpose(m["bal"])
+    sl = jnp.transpose(m["slot"])
+    my_b = jnp.take_along_axis(ballot, ob, axis=1)     # (own, src)
+    my_act = jnp.take_along_axis(active & own, ob, axis=1)
+    okb = v & (bl == my_b) & my_act
+    add = (okb[:, :, None, None]
+           & (ob[:, :, None, None] == oidx[None, None, :, None])
+           & (sl[:, :, None, None] == sidx[None, None, None, :]))
+    log_acks = log_acks | jnp.transpose(add, (0, 2, 3, 1))  # (own, O, S, src)
+    zq2 = _zone_quorums(log_acks, cfg)                 # (own, O, S)
+    newly = ((active & own)[:, :, None] & (zq2 >= Q2)
+             & ~log_commit & (log_cmd != NO_CMD) & proposed)
+    log_commit = log_commit | newly
+
+    # ---------------- P3: commit notifications --------------------------
+    m = inbox["p3"]
+    has3, b3_, src3, (slot3, cmd3, upto3) = per_obj_best(
+        m, ("slot", "cmd", "upto"))
+    oh = has3[:, :, None] & (sidx[None, None, :] == slot3[:, :, None])
+    log_cmd = jnp.where(oh, cmd3[:, :, None], log_cmd)
+    log_bal = jnp.where(oh, jnp.maximum(log_bal, b3_[:, :, None]), log_bal)
+    log_commit = log_commit | oh
+    ohu = (has3[:, :, None] & (sidx[None, None, :] < upto3[:, :, None])
+           & (log_bal == b3_[:, :, None]) & (log_cmd != NO_CMD))
+    log_commit = log_commit | ohu
+
+    # ---------------- workload: demand one object per step --------------
+    # locality-skewed demand: each replica mostly touches its own block
+    # of "home" objects (modeling paxi's zone-routed clients; when O < R
+    # several replicas share a home object, giving steady contention)
+    k_d, k_loc, k_jit = jr.split(ctx.rng, 3)
+    blk = max(O // R, 1)
+    home = (ridx * blk + jr.randint(k_d, (R,), 0, blk)) % O
+    anywhere = jr.randint(jr.fold_in(k_d, 1), (R,), 0, O)
+    local = jr.bernoulli(k_loc, cfg.locality, (R,))
+    demand = jnp.where(local, home, anywhere).astype(jnp.int32)
+
+    # ---------------- owner proposes for the demanded object ------------
+    d_oh = oidx[None, :] == demand[:, None]            # (R, O)
+    is_owner_d = jnp.any(d_oh & active & own, axis=1)
+    d = demand
+    d_bal = ballot[ridx, d]
+    d_next = next_slot[ridx, d]
+    # re-propose the first unfinished slot if any, else a new one
+    mask_re = (~jnp.take_along_axis(
+        log_commit, d[:, None, None] * jnp.ones((1, 1, S), jnp.int32),
+        axis=1)[:, 0]) & (~jnp.take_along_axis(
+            proposed, d[:, None, None] * jnp.ones((1, 1, S), jnp.int32),
+            axis=1)[:, 0]) & (sidx[None, :] < d_next[:, None])
+    first_re = jnp.argmin(jnp.where(mask_re, sidx[None, :], S), axis=1)
+    has_re = jnp.any(mask_re, axis=1)
+    can_new = d_next < S
+    prop_slot = jnp.where(has_re, first_re, d_next).astype(jnp.int32)
+    new_cmd = encode_cmd(d_bal, prop_slot)
+    re_cmd = log_cmd[ridx, d, jnp.clip(prop_slot, 0, S - 1)]
+    re_cmd = jnp.where(re_cmd == NO_CMD, NOOP, re_cmd)
+    prop_cmd = jnp.where(has_re, re_cmd, new_cmd)
+    do = is_owner_d & (has_re | can_new)
+    p_oh = (do[:, None, None] & d_oh[:, :, None]
+            & (sidx[None, None, :] == prop_slot[:, None, None]))
+    log_bal = jnp.where(p_oh, d_bal[:, None, None], log_bal)
+    log_cmd = jnp.where(p_oh & ~log_commit, prop_cmd[:, None, None], log_cmd)
+    proposed = proposed | p_oh
+    log_acks = log_acks | (p_oh[..., None] & self_only)
+    next_slot = next_slot + (do & ~has_re)[:, None] * d_oh
+    out_p2a = {
+        "valid": jnp.broadcast_to(do[:, None], (R, R)),
+        "obj": jnp.broadcast_to(d[:, None], (R, R)),
+        "bal": jnp.broadcast_to(d_bal[:, None], (R, R)),
+        "slot": jnp.broadcast_to(prop_slot[:, None], (R, R)),
+        "cmd": jnp.broadcast_to(prop_cmd[:, None], (R, R)),
+    }
+
+    # ---------------- policy: count misses, fire steals ------------------
+    miss = d_oh & ~(active & own)                      # demanded, not owned
+    # consecutive policy (policy.go): the counter survives only while
+    # the replica keeps demanding the same unowned object
+    hits = jnp.where(miss, hits + 1, 0)
+    # fire a steal for the hottest over-threshold object when idle
+    can_steal = (steal_obj < 0)
+    hot = jnp.max(hits, axis=1)
+    hot_obj = jnp.argmax(hits, axis=1).astype(jnp.int32)
+    fire = can_steal & (hot >= cfg.steal_threshold)
+    new_bal = (jnp.max(ballot, axis=1) // STRIDE + 1) * STRIDE + ridx
+    f_oh = fire[:, None] & (oidx[None, :] == hot_obj[:, None])
+    ballot = jnp.where(f_oh, new_bal[:, None], ballot)
+    active = active & ~f_oh
+    steal_obj = jnp.where(fire, hot_obj, steal_obj)
+    p1_acks = jnp.where(fire[:, None], ridx[None, :] == ridx[:, None],
+                        p1_acks)
+    hits = jnp.where(f_oh, 0, hits)
+    out_p1a = {
+        "valid": jnp.broadcast_to(fire[:, None], (R, R)),
+        "obj": jnp.broadcast_to(hot_obj[:, None], (R, R)),
+        "bal": jnp.broadcast_to(new_bal[:, None], (R, R)),
+    }
+    # stalled steal: retry (rebump) after a timeout
+    steal_timer = jnp.where(steal_obj >= 0, state["steal_timer"] + 1,
+                            0)
+    timeout = steal_timer >= cfg.election_timeout + \
+        jr.randint(k_jit, (R,), 0, cfg.backoff + 1)
+    steal_obj = jnp.where(timeout, -1, steal_obj)      # give up; re-fire later
+    steal_timer = jnp.where(timeout, 0, steal_timer)
+
+    # ---------------- execute committed prefixes ------------------------
+    advanced = jnp.zeros((R, O), jnp.int32)
+    running = jnp.ones((R, O), bool)
+    for e in range(cfg.exec_window):
+        idx = jnp.clip(execute + e, 0, S - 1)
+        inb = (execute + e) < S
+        com = jnp.take_along_axis(log_commit, idx[:, :, None],
+                                  axis=2)[..., 0]
+        running = running & com & inb
+        cmd_e = jnp.take_along_axis(log_cmd, idx[:, :, None],
+                                    axis=2)[..., 0]
+        wr = running & (cmd_e >= 0)
+        kv = jnp.where(wr, cmd_e, kv)
+        advanced = advanced + running
+    new_execute = execute + advanced
+
+    # ---------------- P3 out: per owner, its demanded object ------------
+    any_new_d = jnp.take_along_axis(jnp.any(newly, axis=2), d[:, None],
+                                    axis=1)[:, 0]
+    low_new = jnp.argmin(jnp.where(
+        jnp.take_along_axis(newly, d[:, None, None]
+                            * jnp.ones((1, 1, S), jnp.int32),
+                            axis=1)[:, 0], sidx[None, :], S), axis=1)
+    my_exec_d = new_execute[ridx, d]
+    rr = ctx.t % jnp.maximum(my_exec_d, 1)
+    p3_slot = jnp.where(any_new_d, low_new, rr).astype(jnp.int32)
+    p3_slot = jnp.clip(p3_slot, 0, S - 1)
+    p3_committed = log_commit[ridx, d, p3_slot]
+    p3_cmd = log_cmd[ridx, d, p3_slot]
+    p3_do = (active & own)[ridx, d] & p3_committed
+    out_p3 = {
+        "valid": jnp.broadcast_to(p3_do[:, None], (R, R)),
+        "obj": jnp.broadcast_to(d[:, None], (R, R)),
+        "bal": jnp.broadcast_to(d_bal[:, None], (R, R)),
+        "slot": jnp.broadcast_to(p3_slot[:, None], (R, R)),
+        "cmd": jnp.broadcast_to(p3_cmd[:, None], (R, R)),
+        "upto": jnp.broadcast_to(my_exec_d[:, None], (R, R)),
+    }
+
+    new_state = dict(
+        ballot=ballot, active=active, log_bal=log_bal, log_cmd=log_cmd,
+        log_commit=log_commit, log_acks=log_acks, proposed=proposed,
+        next_slot=next_slot, execute=new_execute, kv=kv, hits=hits,
+        steal_obj=steal_obj, p1_acks=p1_acks, steal_timer=steal_timer,
+        steals=steals,
+    )
+    outbox = {"p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
+              "p2b": out_p2b, "p3": out_p3}
+    return new_state, outbox
+
+
+def metrics(state, cfg: SimConfig):
+    return {
+        "committed_slots": jnp.sum(jnp.max(state["execute"], axis=0)),
+        "steals": state["steals"],
+        "owned_objects": jnp.sum(state["active"]).astype(jnp.int32),
+    }
+
+
+def invariants(old, new, cfg: SimConfig) -> jax.Array:
+    """1. Agreement per (object, slot); 2. commit stability; 3. per-
+    (replica, object) ballot monotonicity; 4. executed prefix committed;
+    5. single ownership: at most one active owner per object."""
+    BIG = jnp.int32(2**30)
+    c, cmd = new["log_commit"], new["log_cmd"]
+    mx = jnp.max(jnp.where(c, cmd, -BIG), axis=0)
+    mn = jnp.min(jnp.where(c, cmd, BIG), axis=0)
+    n_c = jnp.sum(c, axis=0)
+    v_agree = jnp.sum((n_c >= 1) & (mx != mn))
+
+    was = old["log_commit"]
+    v_stable = jnp.sum(was & (~c | (cmd != old["log_cmd"])))
+
+    v_bal = jnp.sum(new["ballot"] < old["ballot"])
+
+    prefix_len = jnp.sum(jnp.cumprod(c.astype(jnp.int32), axis=2), axis=2)
+    v_exec = jnp.sum(new["execute"] > prefix_len)
+
+    # two active replicas owning the same object at the same ballot round
+    # would be a stolen-twice bug; different ballots are a transient
+    own = new["active"]
+    bal = jnp.where(own, new["ballot"], -1)
+    same = (own[:, None, :] & own[None, :, :]
+            & (bal[:, None, :] == bal[None, :, :])
+            & (jnp.arange(cfg.n_replicas)[:, None, None]
+               != jnp.arange(cfg.n_replicas)[None, :, None]))
+    v_own = jnp.sum(same) // 2
+
+    return (v_agree + v_stable + v_bal + v_exec + v_own).astype(jnp.int32)
+
+
+PROTOCOL = SimProtocol(
+    name="wpaxos",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=step,
+    metrics=metrics,
+    invariants=invariants,
+)

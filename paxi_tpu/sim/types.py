@@ -47,6 +47,8 @@ class SimConfig:
     # protocol-specific extras (ignored by protocols that don't use them)
     n_objects: int = 8         # WPaxos: per-key paxos objects per group
     steal_threshold: int = 3   # WPaxos policy.go threshold analog
+    grid_q2: int = 1           # WPaxos: zones in a phase-2 grid quorum
+    locality: float = 0.8      # WPaxos workload: P(demand home-zone object)
     fast_quorum: bool = True   # EPaxos fast path enabled
 
     @property

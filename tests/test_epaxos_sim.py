@@ -1,0 +1,60 @@
+"""EPaxos TPU-sim kernel tests: fast path, conflicts, SCC exec, fuzzing."""
+
+import jax.numpy as jnp
+import pytest
+
+from paxi_tpu.protocols import sim_protocol
+from paxi_tpu.sim import FuzzConfig, SimConfig, simulate
+
+EPAXOS = sim_protocol("epaxos")
+
+
+def run(groups=2, steps=40, fuzz=None, seed=0, **cfg_kw):
+    cfg = SimConfig(**{"n_replicas": 5, "n_slots": 16, "n_keys": 4,
+                       **cfg_kw})
+    return simulate(EPAXOS, cfg, groups, steps,
+                    fuzz=fuzz or FuzzConfig(), seed=seed), cfg
+
+
+def test_progress_and_safety():
+    res, cfg = run(groups=2, steps=40)
+    assert int(res.violations) == 0
+    assert int(res.metrics["committed_slots"]) > 20
+    # executed tracks committed (execution not starved by dependencies)
+    assert int(res.metrics["executed"]) > 10
+
+
+def test_committed_instances_agree():
+    res, _ = run(groups=2, steps=40, seed=2)
+    st, cmd = res.state["status"], res.state["cmd"]
+    com = st == 3
+    both = com[:, :, None] & com[:, None]     # pairwise across view axis?
+    # direct check: for every (owner, inst), committed views share cmd
+    mx = jnp.where(com, cmd, -(2 ** 30)).max(axis=1)
+    mn = jnp.where(com, cmd, 2 ** 30).min(axis=1)
+    n = com.sum(axis=1)
+    assert bool((((n < 1) | (mx == mn))).all())
+
+
+def test_conflict_heavy_small_keyspace():
+    # tiny key space => most commands conflict => deps + SCC execution
+    res, _ = run(groups=2, steps=50, n_keys=1, seed=3)
+    assert int(res.violations) == 0
+    assert int(res.metrics["executed"]) > 5
+
+
+def test_deterministic():
+    r1, _ = run(groups=2, steps=30, seed=7)
+    r2, _ = run(groups=2, steps=30, seed=7)
+    assert (r1.state["cmd"] == r2.state["cmd"]).all()
+    assert (r1.state["khash"] == r2.state["khash"]).all()
+
+
+@pytest.mark.parametrize("fuzz", [
+    FuzzConfig(p_drop=0.15, max_delay=2),
+    FuzzConfig(p_partition=0.3, p_crash=0.15, max_delay=2, window=10),
+])
+def test_fuzzed_safety(fuzz):
+    res, _ = run(groups=4, steps=80, fuzz=fuzz, seed=5, n_keys=2)
+    assert int(res.violations) == 0
+    assert int(res.metrics["committed_slots"]) > 0

@@ -1,0 +1,84 @@
+"""Native (C++) linearizability checker: build + parity with Python."""
+
+import math
+import random
+
+import pytest
+
+from paxi_tpu.host.history import Operation, check_key
+from paxi_tpu.host.native import check_key_native, load_lincheck
+
+pytestmark = pytest.mark.host
+
+
+def _python_check(ops):
+    """Force the pure-Python path regardless of history length."""
+    from paxi_tpu.host import history
+
+    anomalies = 0
+    ops = sorted(ops, key=lambda o: (o.start, o.end))
+    while True:
+        bad = history._find_cycle_read(ops)
+        if bad is None:
+            return anomalies
+        anomalies += 1
+        ops = [o for o in ops if o is not bad]
+
+
+def _random_history(rng, n_ops, lossy=False):
+    """A register history from a simulated (possibly buggy) register."""
+    ops = []
+    t = 0.0
+    current = b""
+    vals = 0
+    for _ in range(n_ops):
+        t += rng.random()
+        dur = rng.random() * 2
+        if rng.random() < 0.5:
+            vals += 1
+            v = f"v{vals}".encode()
+            if not (lossy and rng.random() < 0.3):
+                current = v
+            ops.append(Operation(v, None, t, t + dur))
+        else:
+            out = current
+            if lossy and rng.random() < 0.2 and vals:
+                out = f"v{rng.randrange(1, vals + 1)}".encode()
+            ops.append(Operation(None, out, t, t + dur))
+    return ops
+
+
+def test_native_builds():
+    assert load_lincheck() is not None, "native lincheck failed to build"
+
+
+def test_parity_on_known_cases():
+    cases = [
+        # linearizable
+        [Operation(b"a", None, 0, 1), Operation(None, b"a", 2, 3)],
+        # stale read
+        [Operation(b"a", None, 0, 1), Operation(b"b", None, 2, 3),
+         Operation(None, b"a", 4, 5)],
+        # lost write (empty read after write)
+        [Operation(b"a", None, 0, 1), Operation(None, b"", 2, 3)],
+        # never-written value
+        [Operation(b"a", None, 0, 1), Operation(None, b"zz", 2, 3)],
+        # open-ended write (inf end) then read of it
+        [Operation(b"a", None, 0, math.inf), Operation(None, b"a", 2, 3)],
+    ]
+    for ops in cases:
+        assert check_key_native(ops) == _python_check(ops), ops
+
+
+def test_parity_random_histories():
+    rng = random.Random(42)
+    for trial in range(30):
+        ops = _random_history(rng, rng.randrange(4, 40),
+                              lossy=trial % 2 == 0)
+        assert check_key_native(ops) == _python_check(ops), trial
+
+
+def test_check_key_uses_native_for_big_histories():
+    rng = random.Random(7)
+    ops = _random_history(rng, 120, lossy=True)
+    assert check_key(ops) == _python_check(ops)
