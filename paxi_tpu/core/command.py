@@ -89,3 +89,77 @@ class Reply:
     command: Command
     value: Value = b""
     err: Optional[str] = None
+
+
+TXN_MAGIC = b"\x00txn:"
+
+
+def pack_transaction(commands) -> Value:
+    """Encode a command batch as ONE opaque write value, so a
+    Transaction rides the normal per-protocol replication path as a
+    single totally-ordered command and applies atomically in
+    Database.execute (db.py)."""
+    import json
+    return TXN_MAGIC + json.dumps(
+        [[c.key, c.value.decode("latin1")] for c in commands]).encode()
+
+
+def unpack_transaction(value: Value):
+    """The batch back out of a packed value, or None for plain values."""
+    import json
+    if not value.startswith(TXN_MAGIC):
+        return None
+    return [Command(int(k), v.encode("latin1"))
+            for k, v in json.loads(value[len(TXN_MAGIC):].decode())]
+
+
+def pack_values(values) -> Value:
+    import json
+    return json.dumps([v.decode("latin1") for v in values]).encode()
+
+
+def unpack_values(payload: Value):
+    import json
+    return [v.encode("latin1") for v in json.loads(payload.decode())]
+
+
+@dataclass
+class Read:
+    """Reference: msg.go Read{CommandID, Key} — a raw (non-linearized)
+    read probe answered straight from a replica's local store."""
+
+    command_id: int
+    key: Key
+
+
+@dataclass
+class ReadReply:
+    """Reference: msg.go ReadReply{CommandID, Value}."""
+
+    command_id: int
+    value: Value = b""
+
+
+@dataclass
+class Transaction:
+    """Reference: msg.go Transaction{Commands, ClientID, CommandID,
+    Timestamp} — a batch of commands applied atomically by the replica
+    that executes it (paxi's transactions are a node/db-layer surface;
+    protocols order the batch as one unit)."""
+
+    commands: list = field(default_factory=list)   # List[Command]
+    client_id: str = ""
+    command_id: int = 0
+    timestamp: float = 0.0
+
+
+@dataclass
+class TransactionReply:
+    """Reference: msg.go TransactionReply{OK, CommandID, LeaderID,
+    Timestamp}."""
+
+    ok: bool
+    command_id: int = 0
+    leader_id: str = ""
+    timestamp: float = 0.0
+    values: list = field(default_factory=list)     # List[Value]

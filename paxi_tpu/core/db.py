@@ -27,8 +27,17 @@ class Database:
 
     def execute(self, cmd: Command) -> Value:
         """Apply a command; returns the PREVIOUS value (read for gets,
-        old-value for puts) exactly like the reference's Execute."""
+        old-value for puts) exactly like the reference's Execute.
+
+        A command whose value packs a Transaction (command.py
+        pack_transaction) applies the whole batch atomically and returns
+        the packed previous values — this is how transactions replicate:
+        as one ordered command through whatever protocol runs."""
+        from paxi_tpu.core.command import pack_values, unpack_transaction
         with self._lock:
+            batch = unpack_transaction(cmd.value) if cmd.value else None
+            if batch is not None:
+                return pack_values(self.execute_transaction(batch))
             prev = self._data.get(cmd.key, b"")
             if cmd.is_write():
                 self._data[cmd.key] = cmd.value
@@ -36,6 +45,13 @@ class Database:
                 if self._multi_version:
                     self._history.setdefault(cmd.key, []).append(cmd.value)
             return prev
+
+    def execute_transaction(self, commands: List[Command]) -> List[Value]:
+        """Apply a command batch atomically (msg.go Transaction surface):
+        all commands run under one lock acquisition, returning each
+        command's previous value in order."""
+        with self._lock:
+            return [self.execute(c) for c in commands]
 
     def get(self, key: Key) -> Optional[Value]:
         with self._lock:

@@ -10,6 +10,7 @@ keep-alive connection per contacted node.
 from __future__ import annotations
 
 import asyncio
+import json
 from typing import Dict, Optional, Tuple
 
 from paxi_tpu.core.command import Key, Value
@@ -106,6 +107,34 @@ class Client:
 
     async def put(self, key: Key, value: Value) -> None:
         await self._with_retry("PUT", key, value)
+
+    async def local_get(self, key: Key, id: Optional[ID] = None) -> Value:
+        """msg.go Read: raw non-linearized read of one replica's store."""
+        status, headers, payload = await self._conn(ID(id) if id else
+                                                    self.id).request(
+            "GET", f"/local/{key}", {}, b"")
+        if status != 200:
+            raise IOError(headers.get("err", f"http {status}"))
+        return payload
+
+    async def transaction(self, ops, id: Optional[ID] = None) -> list:
+        """msg.go Transaction: [(key, value), ...] packed into one
+        protocol-ordered command and applied atomically by the state
+        machine on every replica; returns each op's previous value.
+        Ops with an empty value are reads (db.go empty-value semantics)."""
+        self.command_id += 1
+        body = json.dumps([
+            {"key": k, "value": v.decode("latin1")} for k, v in ops
+        ]).encode()
+        status, headers, payload = await self._conn(ID(id) if id else
+                                                    self.id).request(
+            "POST", "/transaction",
+            {"Client-Id": self.client_id,
+             "Command-Id": str(self.command_id)}, body)
+        if status != 200:
+            raise IOError(headers.get("err", f"http {status}"))
+        return [v.encode("latin1")
+                for v in json.loads(payload.decode())["values"]]
 
     def close(self) -> None:
         for c in self._conns.values():

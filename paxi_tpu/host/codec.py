@@ -146,3 +146,16 @@ def decode_from(codec: Codec, buf: bytes) -> Tuple[Any, bytes]:
     if len(buf) < 4 + n:
         return None, buf
     return codec.decode_body(buf[4:4 + n]), buf[4 + n:]
+
+
+# Shared client wire types are registered here, the analog of msg.go's
+# init() gob.Register calls (core/ cannot depend on host/).
+def _register_core_types() -> None:
+    from paxi_tpu.core.command import (Command, Read, ReadReply, Reply,
+                                       Transaction, TransactionReply)
+    for cls in (Command, Reply, Read, ReadReply, Transaction,
+                TransactionReply):
+        register_message(cls)
+
+
+_register_core_types()

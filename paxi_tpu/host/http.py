@@ -37,6 +37,7 @@ from paxi_tpu.host.transport import parse_addr
 def _response(status: int, body: bytes = b"",
               headers: Dict[str, str] = {}) -> bytes:
     reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+              405: "Method Not Allowed",
               500: "Internal Server Error"}.get(status, "OK")
     head = [f"HTTP/1.1 {status} {reason}",
             f"Content-Length: {len(body)}",
@@ -96,6 +97,18 @@ class HTTPServer:
         parts = [p for p in url.path.split("/") if p]
         if parts and parts[0] == "admin":
             return self._admin(method, parts[1:], parse_qs(url.query))
+        if parts and parts[0] == "local" and len(parts) == 2:
+            # msg.go Read: a raw non-linearized probe of the local store
+            if method != "GET":
+                return _response(405, b"", {"Err": "GET only"})
+            try:
+                return _response(200, self.node.db.get(int(parts[1])) or b"")
+            except ValueError:
+                return _response(400, b"", {"Err": "key must be an int"})
+        if parts and parts[0] == "transaction":
+            if method != "POST":
+                return _response(405, b"", {"Err": "POST only"})
+            return await self._transaction(headers, body)
         if len(parts) != 1:
             return _response(404)
         try:
@@ -120,6 +133,41 @@ class HTTPServer:
         if rep.err:
             return _response(500, b"", {"Err": str(rep.err)})
         return _response(200, rep.value or b"")
+
+    async def _transaction(self, headers: Dict[str, str],
+                           body: bytes) -> bytes:
+        """msg.go Transaction: a command batch packed into ONE command
+        (command.py pack_transaction) and pushed through the protocol's
+        normal Request path, so it replicates and totally orders like
+        any write and applies atomically in Database.execute.  Batch
+        ops with empty values are reads (db.go empty-value semantics)."""
+        from paxi_tpu.core.command import pack_transaction, unpack_values
+        try:
+            ops = json.loads(body.decode() or "[]")
+            cmds = [Command(int(o["key"]),
+                            o.get("value", "").encode("latin1"))
+                    for o in ops]
+            if not cmds:
+                raise ValueError("empty transaction")
+        except (ValueError, KeyError, TypeError) as e:
+            return _response(400, b"", {"Err": repr(e)})
+        cmd = Command(cmds[0].key, pack_transaction(cmds),
+                      client_id=headers.get("client-id", ""),
+                      command_id=int(headers.get("command-id", "0")))
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.node.handle_client_request(Request(
+            command=cmd, timestamp=time.time(),
+            node_id=str(self.node.id), reply_to=fut))
+        try:
+            rep = await asyncio.wait_for(fut, timeout=10.0)
+        except asyncio.TimeoutError:
+            return _response(500, b"", {"Err": "transaction timed out"})
+        if rep.err:
+            return _response(500, b"", {"Err": str(rep.err)})
+        # register-style protocols (abd) ack writes with an empty value
+        values = unpack_values(rep.value) if rep.value else []
+        out = {"ok": True, "values": [v.decode("latin1") for v in values]}
+        return _response(200, json.dumps(out).encode())
 
     def _admin(self, method: str, parts, q) -> bytes:
         """Fault injection + introspection (AdminClient endpoints)."""

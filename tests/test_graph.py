@@ -1,0 +1,60 @@
+"""lib/-parity helpers: graph SCC/BFS + priority queue."""
+
+from paxi_tpu.utils.graph import Graph, PriorityQueue
+
+
+def test_bfs_order():
+    g = Graph()
+    g.add_edge(1, 2)
+    g.add_edge(1, 3)
+    g.add_edge(2, 4)
+    assert g.bfs(1) == [1, 2, 3, 4]
+    assert g.bfs(2) == [2, 4]
+
+
+def test_scc_reverse_topological():
+    g = Graph()
+    # cycle {1,2} -> 3 -> cycle {4,5}; 3 depends on 4/5
+    g.add_edge(1, 2)
+    g.add_edge(2, 1)
+    g.add_edge(2, 3)
+    g.add_edge(3, 4)
+    g.add_edge(4, 5)
+    g.add_edge(5, 4)
+    comps = g.scc()
+    sets = [frozenset(c) for c in comps]
+    assert frozenset({1, 2}) in sets
+    assert frozenset({4, 5}) in sets
+    assert frozenset({3}) in sets
+    # dependencies come first (reverse topological)
+    assert sets.index(frozenset({4, 5})) < sets.index(frozenset({3}))
+    assert sets.index(frozenset({3})) < sets.index(frozenset({1, 2}))
+
+
+def test_scc_self_loop_and_isolated():
+    g = Graph()
+    g.add_node("a")
+    g.add_edge("b", "b")
+    comps = g.scc()
+    assert sorted(map(len, comps)) == [1, 1]
+
+
+def test_remove_node():
+    g = Graph()
+    g.add_edge(1, 2)
+    g.add_edge(2, 3)
+    g.remove(2)
+    assert 2 not in g
+    assert g.neighbors(1) == set()
+
+
+def test_priority_queue_order_and_ties():
+    q = PriorityQueue()
+    q.push(3, "c")
+    q.push(1, "a1")
+    q.push(1, "a2")
+    q.push(2, "b")
+    assert len(q) == 4
+    assert q.peek() == "a1"
+    assert [q.pop() for _ in range(4)] == ["a1", "a2", "b", "c"]
+    assert not q
