@@ -129,7 +129,9 @@ def cmd_repl(args) -> int:
 
 def cmd_sim(args) -> int:
     """The TPU sim runtime: vmapped protocol fuzzing at scale."""
-    from paxi_tpu.sim import FuzzConfig, SimConfig, simulate
+    import contextlib
+
+    from paxi_tpu.sim import FuzzConfig, SimConfig
     from paxi_tpu.protocols import sim_protocol
     proto = sim_protocol(args.algorithm)
     cfg = SimConfig(n_replicas=args.replicas, n_slots=args.slots,
@@ -137,6 +139,20 @@ def cmd_sim(args) -> int:
     fuzz = FuzzConfig(p_drop=args.p_drop, p_dup=args.p_dup,
                       max_delay=args.max_delay,
                       p_crash=args.p_crash, p_partition=args.p_partition)
+    if args.profile:
+        # tracing/profiling surface (SURVEY §5): the reference leans on
+        # go pprof; here the XLA/TPU profile is first-class — view with
+        # tensorboard or xprof
+        import jax
+        prof = jax.profiler.trace(args.profile)
+    else:
+        prof = contextlib.nullcontext()
+    with prof:
+        return _run_sim(args, proto, cfg, fuzz)
+
+
+def _run_sim(args, proto, cfg, fuzz) -> int:
+    from paxi_tpu.sim import simulate
     if args.shard:
         from paxi_tpu.parallel import make_mesh, make_sharded_run
         import jax.random as jr
@@ -209,6 +225,8 @@ def main(argv=None) -> int:
     m.add_argument("-max_delay", type=int, default=1)
     m.add_argument("-shard", action="store_true",
                    help="shard groups over the device mesh")
+    m.add_argument("-profile", "--profile", default="",
+                   help="write a JAX/XLA profiler trace to this dir")
     m.set_defaults(fn=cmd_sim)
 
     args = p.parse_args(argv)

@@ -72,6 +72,16 @@ def make_scan_body(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig):
     return body
 
 
+def _finish(proto: SimProtocol, cfg: SimConfig, carry, viols):
+    """Shared aggregation tail: per-group metrics summed over groups.
+    One implementation for both the straight and the resumed path, so
+    checkpointed runs can never diverge from uninterrupted ones."""
+    state = carry[0]
+    per_group = jax.vmap(lambda s: proto.metrics(s, cfg))(state)
+    metrics = {k: jnp.sum(v) for k, v in per_group.items()}
+    return state, metrics, jnp.sum(viols)
+
+
 def make_run(proto: SimProtocol, cfg: SimConfig,
              fuzz: FuzzConfig = FAULT_FREE):
     """Build ``run(rng, n_groups, n_steps) -> SimResult`` (jitted).
@@ -85,10 +95,7 @@ def make_run(proto: SimProtocol, cfg: SimConfig,
     def run(rng, n_groups: int, n_steps: int):
         carry = init_carry(proto, cfg, fuzz, n_groups, rng)
         carry, viols = jax.lax.scan(body, carry, jnp.arange(n_steps))
-        state = carry[0]
-        per_group = jax.vmap(lambda s: proto.metrics(s, cfg))(state)
-        metrics = {k: jnp.sum(v) for k, v in per_group.items()}
-        return state, metrics, jnp.sum(viols)
+        return _finish(proto, cfg, carry, viols)
 
     return run
 
@@ -102,3 +109,33 @@ def simulate(proto: SimProtocol, cfg: SimConfig, n_groups: int,
     jax.block_until_ready(viols)
     return SimResult(state=state, metrics=metrics, violations=viols,
                      steps=n_steps, groups=n_groups)
+
+
+_CONTINUE_CACHE: dict = {}
+
+
+def continue_run(proto: SimProtocol, cfg: SimConfig, carry,
+                 t0: int, n_steps: int,
+                 fuzz: FuzzConfig = FAULT_FREE):
+    """Advance a simulation from an existing carry (checkpoint/resume
+    seam — see sim/checkpoint.py).  ``t0`` is the absolute step index the
+    carry was paused at (a traced operand, so resuming at a new offset
+    reuses the compiled executable); resumed runs are bit-for-bit
+    identical to uninterrupted ones.  Returns (SimResult, new_carry)."""
+    key = (id(proto), cfg, fuzz)
+    run = _CONTINUE_CACHE.get(key)
+    if run is None:
+        body = make_scan_body(proto, cfg, fuzz)
+
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def run(carry, t0, n_steps: int):
+            carry, viols = jax.lax.scan(body, carry,
+                                        t0 + jnp.arange(n_steps))
+            return carry, *_finish(proto, cfg, carry, viols)
+
+        _CONTINUE_CACHE[key] = run
+    carry, state, metrics, viols = run(carry, jnp.int32(t0), n_steps)
+    jax.block_until_ready(viols)
+    n_groups = jax.tree_util.tree_leaves(state)[0].shape[0]
+    return SimResult(state=state, metrics=metrics, violations=viols,
+                     steps=n_steps, groups=n_groups), carry

@@ -1,0 +1,40 @@
+"""Checkpoint/resume: a split run is bit-for-bit equal to a straight run."""
+
+import jax.random as jr
+import pytest
+
+from paxi_tpu.protocols import sim_protocol
+from paxi_tpu.sim import (FAULT_FREE, FuzzConfig, SimConfig, continue_run,
+                          load_carry, save_carry, simulate)
+from paxi_tpu.sim.runner import init_carry
+
+PAXOS = sim_protocol("paxos")
+
+
+def test_resume_equals_straight_run(tmp_path):
+    cfg = SimConfig(n_replicas=3, n_slots=64)
+    fuzz = FuzzConfig(p_drop=0.1, max_delay=2)   # fuzzed: rng must carry
+    straight = simulate(PAXOS, cfg, 3, 60, fuzz=fuzz, seed=5)
+
+    carry = init_carry(PAXOS, cfg, fuzz, 3, jr.PRNGKey(5))
+    res1, carry = continue_run(PAXOS, cfg, carry, 0, 30, fuzz=fuzz)
+    path = str(tmp_path / "ck.npz")
+    save_carry(path, carry, meta={"t": 30, "proto": "paxos"})
+    carry2, meta = load_carry(path, carry)
+    assert meta == {"t": 30, "proto": "paxos"}
+    res2, _ = continue_run(PAXOS, cfg, carry2, 30, 30, fuzz=fuzz)
+
+    assert int(straight.violations) == 0
+    assert int(res1.violations) + int(res2.violations) == 0
+    for k in straight.state:
+        assert (straight.state[k] == res2.state[k]).all(), k
+
+
+def test_load_rejects_wrong_shape(tmp_path):
+    cfg = SimConfig(n_replicas=3, n_slots=64)
+    carry = init_carry(PAXOS, cfg, FAULT_FREE, 2, jr.PRNGKey(0))
+    path = str(tmp_path / "ck.npz")
+    save_carry(path, carry)
+    bigger = init_carry(PAXOS, cfg, FAULT_FREE, 4, jr.PRNGKey(0))
+    with pytest.raises(ValueError):
+        load_carry(path, bigger)
