@@ -20,6 +20,7 @@ _SIM_MODULES = {
     "wpaxos": "paxi_tpu.protocols.wpaxos.sim",
     "epaxos": "paxi_tpu.protocols.epaxos.sim",
     "kpaxos": "paxi_tpu.protocols.kpaxos.sim",
+    "dynamo": "paxi_tpu.protocols.dynamo.sim",
 }
 
 _HOST_MODULES = {
@@ -29,6 +30,7 @@ _HOST_MODULES = {
     "wpaxos": "paxi_tpu.protocols.wpaxos.host",
     "epaxos": "paxi_tpu.protocols.epaxos.host",
     "kpaxos": "paxi_tpu.protocols.kpaxos.host",
+    "dynamo": "paxi_tpu.protocols.dynamo.host",
 }
 
 

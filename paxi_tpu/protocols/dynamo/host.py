@@ -1,0 +1,181 @@
+"""Dynamo-style eventually-consistent store for the host runtime.
+
+Reference: the paxi lineage's dynamo/ package (SURVEY §2.2 "others") —
+a quorum R/W store with NO consensus: any replica coordinates an op;
+writes stamp a Lamport (counter, node) version, store locally, and
+replicate to all peers, acking the client after W acknowledgements;
+reads query all peers, wait for R replies, return the max-version value
+and *read-repair* stale replicas.  W + R > N gives read-your-writes in
+the failure-free case; conflicting concurrent writes resolve
+last-writer-wins by version — weaker than ABD (which serializes through
+two quorum phases) and exactly the contrast case the benchmark's
+linearizability checker is expected to flag under concurrency.
+
+The sim kernel (sim.py) checks the honest guarantees instead:
+per-replica version monotonicity and eventual convergence.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+from paxi_tpu.core.command import Reply, Request
+from paxi_tpu.core.config import Config
+from paxi_tpu.core.ident import ID
+from paxi_tpu.core.quorum import Quorum
+from paxi_tpu.host.codec import register_message
+from paxi_tpu.host.node import Node
+
+Ver = Tuple[int, int]          # (lamport counter, node index)
+ZERO: Ver = (0, -1)
+
+
+@register_message
+@dataclass
+class RWrite:
+    """Coordinator -> peers: replicate (key, version, value)."""
+
+    src: str
+    tag: int
+    key: int
+    counter: int
+    node: int
+    value: bytes
+
+
+@register_message
+@dataclass
+class RWriteAck:
+    src: str
+    tag: int
+
+
+@register_message
+@dataclass
+class RRead:
+    src: str
+    tag: int
+    key: int
+
+
+@register_message
+@dataclass
+class RReadReply:
+    src: str
+    tag: int
+    key: int
+    counter: int
+    node: int
+    value: bytes
+
+
+@dataclass
+class _Op:
+    request: Request
+    key: int
+    is_read: bool
+    quorum: Quorum
+    best: Ver = ZERO
+    best_value: bytes = b""
+
+
+class DynamoReplica(Node):
+    def __init__(self, id: ID, cfg: Config):
+        super().__init__(id, cfg)
+        self.store: Dict[int, Tuple[int, int, bytes]] = {}
+        self.clock = 0
+        self.ops: Dict[int, _Op] = {}
+        self._seq = 0
+        # W and R: majority each (W + R > N); the knob dynamo exposes
+        self.W = cfg.n // 2 + 1
+        self.R = cfg.n // 2 + 1
+        self.register(Request, self.handle_request)
+        self.register(RWrite, self.handle_write)
+        self.register(RWriteAck, self.handle_write_ack)
+        self.register(RRead, self.handle_read)
+        self.register(RReadReply, self.handle_read_reply)
+
+    def _local(self, key: int) -> Tuple[int, int, bytes]:
+        return self.store.get(key, (0, -1, b""))
+
+    def _apply(self, key: int, counter: int, node: int, value: bytes) -> None:
+        """Last-writer-wins merge by (counter, node) version."""
+        c, n, _ = self._local(key)
+        if (counter, node) > (c, n):
+            self.store[key] = (counter, node, value)
+            self.clock = max(self.clock, counter)
+            self.db.put(key, value)
+
+    # ---- coordinator ---------------------------------------------------
+    def handle_request(self, req: Request) -> None:
+        self._seq += 1
+        tag = self._seq
+        key = req.command.key
+        if req.command.is_read():
+            op = _Op(req, key, True, Quorum(self.cfg.ids))
+            self.ops[tag] = op
+            c, n, v = self._local(key)
+            op.best, op.best_value = (c, n), v
+            op.quorum.ack(self.id)
+            self.socket.broadcast(RRead(str(self.id), tag, key))
+            self._read_done(tag, op)
+        else:
+            self.clock += 1
+            ver = (self.clock, self.cfg.index(self.id))
+            self._apply(key, ver[0], ver[1], req.command.value)
+            op = _Op(req, key, False, Quorum(self.cfg.ids))
+            self.ops[tag] = op
+            op.quorum.ack(self.id)
+            self.socket.broadcast(RWrite(str(self.id), tag, key,
+                                         ver[0], ver[1],
+                                         req.command.value))
+            self._write_done(tag, op)
+
+    # ---- replication ---------------------------------------------------
+    def handle_write(self, m: RWrite) -> None:
+        self._apply(m.key, m.counter, m.node, m.value)
+        self.socket.send(ID(m.src), RWriteAck(str(self.id), m.tag))
+
+    def handle_write_ack(self, m: RWriteAck) -> None:
+        op = self.ops.get(m.tag)
+        if op is None or op.is_read:
+            return
+        op.quorum.ack(ID(m.src))
+        self._write_done(m.tag, op)
+
+    def _write_done(self, tag: int, op: _Op) -> None:
+        if op.quorum.size() >= self.W:
+            del self.ops[tag]
+            op.request.reply(Reply(op.request.command, value=b""))
+
+    # ---- reads + read repair -------------------------------------------
+    def handle_read(self, m: RRead) -> None:
+        c, n, v = self._local(m.key)
+        self.socket.send(ID(m.src),
+                         RReadReply(str(self.id), m.tag, m.key, c, n, v))
+
+    def handle_read_reply(self, m: RReadReply) -> None:
+        op = self.ops.get(m.tag)
+        if op is None or not op.is_read:
+            return
+        op.quorum.ack(ID(m.src))
+        if (m.counter, m.node) > op.best:
+            op.best, op.best_value = (m.counter, m.node), m.value
+        self._read_done(m.tag, op)
+
+    def _read_done(self, tag: int, op: _Op) -> None:
+        if op.quorum.size() < self.R:
+            return
+        del self.ops[tag]
+        # read repair: push the winning version back out
+        if op.best > ZERO:
+            self._apply(op.key, op.best[0], op.best[1], op.best_value)
+            self.socket.broadcast(RWrite(str(self.id), 0, op.key,
+                                         op.best[0], op.best[1],
+                                         op.best_value))
+        op.request.reply(Reply(op.request.command, value=op.best_value))
+
+
+def new_replica(id: ID, cfg: Config) -> DynamoReplica:
+    return DynamoReplica(ID(id), cfg)

@@ -1,0 +1,141 @@
+"""Dynamo-style eventual store as a pure TPU kernel.
+
+Reference: the paxi lineage's dynamo/ package (SURVEY §2.2 "others") —
+no consensus: writes stamp Lamport (counter, node) versions, replicate
+best-effort, and merge last-writer-wins; anti-entropy gossip heals
+divergence.  See host.py for the deployment form.
+
+TPU re-design:
+- The whole store is two version planes ``ver_c/ver_n[R, K]`` — the
+  value is a deterministic function of the version, so payloads never
+  need to be carried or stored; LWW merge is a lexicographic max.
+- Each step, each replica writes one hashed key while ``t <
+  write_rounds`` (= cfg.n_slots — the write window), then switches to
+  pure anti-entropy: broadcasting a rotating key's version.  After
+  quiescence, gossip alone must converge every replica (the honest
+  guarantee of an eventual store; the convergence count is a metric and
+  the quiesced run's endpoint is asserted in tests).
+- The always-on safety oracle checks what dynamo really promises:
+  per-(replica, key) version monotonicity and Lamport-clock sanity —
+  NOT linearizability, which this protocol intentionally lacks (the
+  host benchmark's checker is expected to flag it under contention).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+
+
+def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
+    return {"gossip": ("key", "c", "n")}
+
+
+def init_state(cfg: SimConfig, rng: jax.Array):
+    R, K = cfg.n_replicas, cfg.n_keys
+    del rng
+    return dict(
+        ver_c=jnp.zeros((R, K), jnp.int32),
+        ver_n=jnp.full((R, K), -1, jnp.int32),
+        clock=jnp.zeros((R,), jnp.int32),
+        writes=jnp.zeros((), jnp.int32),
+    )
+
+
+def step(state, inbox, ctx: StepCtx):
+    cfg = ctx.cfg
+    R, K = cfg.n_replicas, cfg.n_keys
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    kidx = jnp.arange(K, dtype=jnp.int32)
+
+    ver_c = state["ver_c"]
+    ver_n = state["ver_n"]
+    clock = state["clock"]
+
+    # ---------------- merge incoming gossip (LWW by (c, n)) -------------
+    m = inbox["gossip"]
+    v = jnp.transpose(m["valid"])                       # (me, src)
+    g_key = jnp.transpose(m["key"])
+    g_c = jnp.transpose(m["c"])
+    g_n = jnp.transpose(m["n"])
+    oh = v[:, :, None] & (g_key[:, :, None] == kidx[None, None, :])
+    in_c = jnp.max(jnp.where(oh, g_c[:, :, None], -1), axis=1)   # (me, K)
+    pick = jnp.argmax(jnp.where(oh, g_c[:, :, None] * R
+                                + jnp.maximum(g_n[:, :, None], 0), -1),
+                      axis=1)
+    in_n = jnp.take_along_axis(g_n, pick, axis=1)
+    has = jnp.any(oh, axis=1)
+    newer = has & ((in_c > ver_c)
+                   | ((in_c == ver_c) & (in_n > ver_n)))
+    ver_c = jnp.where(newer, in_c, ver_c)
+    ver_n = jnp.where(newer, in_n, ver_n)
+    clock = jnp.maximum(clock, jnp.max(ver_c, axis=1))
+
+    # ---------------- local write while inside the write window ---------
+    writing = ctx.t < cfg.n_slots
+    k_w = jr.fold_in(ctx.rng, 3)
+    wkey = fib_key(jr.randint(k_w, (R,), 0, 1 << 16) + ridx * 977, K)
+    clock = clock + jnp.where(writing, 1, 0)
+    oh_w = (kidx[None, :] == wkey[:, None]) & writing
+    bump = oh_w & ((clock[:, None] > ver_c)
+                   | ((clock[:, None] == ver_c) & (ridx[:, None] > ver_n)))
+    ver_c = jnp.where(bump, clock[:, None], ver_c)
+    ver_n = jnp.where(bump, ridx[:, None], ver_n)
+    writes = state["writes"] + jnp.sum(writing & jnp.ones((R,), bool))
+
+    # ---------------- gossip out: written key, else rotate anti-entropy -
+    akey = (ctx.t + ridx) % K
+    gkey = jnp.where(writing, wkey, akey).astype(jnp.int32)
+    out_c = ver_c[ridx, gkey]
+    out_n = ver_n[ridx, gkey]
+    out = {
+        "valid": jnp.ones((R, R), bool),
+        "key": jnp.broadcast_to(gkey[:, None], (R, R)),
+        "c": jnp.broadcast_to(out_c[:, None], (R, R)),
+        "n": jnp.broadcast_to(out_n[:, None], (R, R)),
+    }
+
+    new_state = dict(ver_c=ver_c, ver_n=ver_n, clock=clock, writes=writes)
+    return new_state, {"gossip": out}
+
+
+def metrics(state, cfg: SimConfig):
+    c, n = state["ver_c"], state["ver_n"]
+    same = (jnp.all(c == c[:1], axis=0) & jnp.all(n == n[:1], axis=0))
+    return {
+        "converged_keys": jnp.sum(same),
+        "total_keys": jnp.int32(cfg.n_keys),
+        "writes": state["writes"],
+        "committed_slots": state["writes"],   # comparable progress metric
+    }
+
+
+def invariants(old, new, cfg: SimConfig) -> jax.Array:
+    """What an eventual store really promises, checked every step:
+    1. per-(replica, key) versions never regress (LWW monotonicity);
+    2. a replica's Lamport clock bounds every version it stores;
+    3. version owner indices stay in range."""
+    regress = ((new["ver_c"] < old["ver_c"])
+               | ((new["ver_c"] == old["ver_c"])
+                  & (new["ver_n"] < old["ver_n"])))
+    v1 = jnp.sum(regress)
+    v2 = jnp.sum(jnp.max(new["ver_c"], axis=1) > new["clock"])
+    v3 = jnp.sum((new["ver_n"] < -1)
+                 | (new["ver_n"] >= cfg.n_replicas))
+    return (v1 + v2 + v3).astype(jnp.int32)
+
+
+PROTOCOL = SimProtocol(
+    name="dynamo",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=step,
+    metrics=metrics,
+    invariants=invariants,
+)
