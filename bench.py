@@ -32,10 +32,13 @@ def _start_init_watchdog():
     pid = os.fork()
     if pid:                       # parent: the benchmark itself
         os.close(r)
-        return w
+        return w, pid
     os.close(w)
     ready, _, _ = select.select([r], [], [], timeout)
-    if not ready:                 # no ready byte and no EOF: wedged
+    # re-poll: distinguish "wedged" from "parent already exited" (EOF
+    # makes the fd readable) so a reparented child never signals PID 1
+    ready = ready or select.select([r], [], [], 0)[0]
+    if not ready and os.getppid() > 1:
         print(json.dumps({
             "metric": "committed_paxos_slots_per_sec_100k_groups",
             "value": 0, "unit": "slots/s", "vs_baseline": 0.0,
@@ -49,7 +52,7 @@ def _start_init_watchdog():
 
 
 def main():
-    ready_fd = _start_init_watchdog()
+    ready_fd, watchdog_pid = _start_init_watchdog()
 
     import jax
     from paxi_tpu.utils import ensure_env_platform
@@ -57,6 +60,7 @@ def main():
     jax.devices()                 # force backend init under the watchdog
     os.write(ready_fd, b"1")
     os.close(ready_fd)
+    os.waitpid(watchdog_pid, 0)   # reap (child exits on the ready byte)
     import jax.random as jr
     from paxi_tpu.protocols import sim_protocol
     from paxi_tpu.sim import SimConfig, make_run

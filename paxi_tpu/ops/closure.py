@@ -1,0 +1,87 @@
+"""Boolean transitive closure by repeated matrix squaring.
+
+Used by the EPaxos execution engine (protocols/epaxos/sim.py): the
+committed dependency graph's reachability relation is ``closure(A)``,
+SCCs are ``reach & reach^T`` — Tarjan (epaxos exec.go) re-expressed as
+batched boolean matmuls that map straight onto the MXU.
+
+Two paths:
+- **XLA** (default off-TPU): ``log2(N)`` batched matmuls; XLA handles
+  batching/fusion, but each squaring round-trips the matrix through HBM.
+- **Pallas** (TPU, or ``PAXI_TPU_PALLAS=1`` with interpret fallback):
+  one kernel instance per batch element keeps the (padded-to-128)
+  matrix resident in VMEM across ALL squarings — one HBM read and one
+  write total.  Zero-padding is closure-neutral (no spurious edges).
+
+Matrices here are small (N = replicas x instance-window, typically
+64-256) — the batch axis (groups x replicas) carries the parallelism.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def _n_iter(n: int) -> int:
+    return max(1, (max(n, 2) - 1).bit_length())
+
+
+def closure_xla(adj: jax.Array) -> jax.Array:
+    """Repeated squaring in plain XLA; adj: bool[..., N, N]."""
+    n = adj.shape[-1]
+    reach = adj
+    for _ in range(_n_iter(n)):
+        sq = jnp.matmul(reach.astype(jnp.float32),
+                        reach.astype(jnp.float32)) > 0
+        reach = reach | sq
+    return reach
+
+
+def _closure_kernel(n_iter: int, a_ref, out_ref):
+    r = a_ref[0].astype(jnp.float32)
+    for _ in range(n_iter):
+        sq = jax.lax.dot(r, r, preferred_element_type=jnp.float32)
+        r = jnp.where(r + sq > 0, 1.0, 0.0)
+    out_ref[0] = r > 0
+
+
+def closure_pallas(adj: jax.Array, interpret: bool = False) -> jax.Array:
+    """VMEM-resident closure; adj: bool[B, N, N] (one block per batch)."""
+    from jax.experimental import pallas as pl
+
+    b, n, _ = adj.shape
+    pad = (-n) % 128
+    if pad:
+        adj = jnp.pad(adj, ((0, 0), (0, pad), (0, pad)))
+    np_ = n + pad
+    out = pl.pallas_call(
+        functools.partial(_closure_kernel, _n_iter(n)),
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, np_, np_), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, np_, np_), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, np_, np_), jnp.bool_),
+        interpret=interpret,
+    )(adj)
+    return out[:, :n, :n]
+
+
+def transitive_closure(adj: jax.Array) -> jax.Array:
+    """Reachability closure of bool[..., N, N] (batched).
+
+    Picks the Pallas VMEM-resident path on TPU (or when
+    ``PAXI_TPU_PALLAS`` is set — interpreted off-TPU, for testing);
+    plain XLA squaring otherwise.
+    """
+    mode = os.environ.get("PAXI_TPU_PALLAS", "")
+    on_tpu = jax.default_backend() == "tpu"
+    if mode == "0" or (not on_tpu and not mode):
+        return closure_xla(adj)
+    lead = adj.shape[:-2]
+    n = adj.shape[-1]
+    flat = adj.reshape((-1, n, n))
+    out = closure_pallas(flat, interpret=not on_tpu)
+    return out.reshape(lead + (n, n))

@@ -78,6 +78,7 @@ class _Op:
     quorum: Quorum
     best: Ver = ZERO
     best_value: bytes = b""
+    reported: Dict[ID, Ver] = None  # per-responder versions (reads)
 
 
 class DynamoReplica(Node):
@@ -113,10 +114,11 @@ class DynamoReplica(Node):
         tag = self._seq
         key = req.command.key
         if req.command.is_read():
-            op = _Op(req, key, True, Quorum(self.cfg.ids))
+            op = _Op(req, key, True, Quorum(self.cfg.ids), reported={})
             self.ops[tag] = op
             c, n, v = self._local(key)
             op.best, op.best_value = (c, n), v
+            op.reported[self.id] = (c, n)
             op.quorum.ack(self.id)
             self.socket.broadcast(RRead(str(self.id), tag, key))
             self._read_done(tag, op)
@@ -160,6 +162,7 @@ class DynamoReplica(Node):
         if op is None or not op.is_read:
             return
         op.quorum.ack(ID(m.src))
+        op.reported[ID(m.src)] = (m.counter, m.node)
         if (m.counter, m.node) > op.best:
             op.best, op.best_value = (m.counter, m.node), m.value
         self._read_done(m.tag, op)
@@ -168,12 +171,16 @@ class DynamoReplica(Node):
         if op.quorum.size() < self.R:
             return
         del self.ops[tag]
-        # read repair: push the winning version back out
+        # read repair, targeted: only responders that reported a version
+        # below the winner get the write-back (healthy clusters pay no
+        # repair traffic)
         if op.best > ZERO:
             self._apply(op.key, op.best[0], op.best[1], op.best_value)
-            self.socket.broadcast(RWrite(str(self.id), 0, op.key,
-                                         op.best[0], op.best[1],
-                                         op.best_value))
+            for peer, ver in op.reported.items():
+                if peer != self.id and ver < op.best:
+                    self.socket.send(peer, RWrite(
+                        str(self.id), 0, op.key, op.best[0], op.best[1],
+                        op.best_value))
         op.request.reply(Reply(op.request.command, value=op.best_value))
 
 

@@ -38,6 +38,7 @@ from typing import Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from paxi_tpu.ops.closure import transitive_closure
 from paxi_tpu.ops.hashing import fib_key
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
@@ -368,11 +369,9 @@ def step(state, inbox, ctx: StepCtx):
         A = A | (has[:, :, None]
                  & (jnp.arange(N)[None, None, :] == col[:, :, None]))
     A = A & committed[:, :, None]       # only committed sources constrain
-    reach = A
-    n_iter = max(1, (N - 1).bit_length())
-    for _ in range(n_iter):
-        reach = reach | (jnp.matmul(reach.astype(jnp.float32),
-                                    reach.astype(jnp.float32)) > 0)
+    # MXU-shaped reachability: Pallas VMEM-resident squaring on TPU,
+    # plain XLA elsewhere (ops/closure.py)
+    reach = transitive_closure(A)
     # an instance is ready when every reachable dep is committed
     blocked = jnp.any(reach & ~committed[:, None, :], axis=2)
     ready = committed & ~blocked & ~exec_f
