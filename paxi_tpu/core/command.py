@@ -105,12 +105,22 @@ def pack_transaction(commands) -> Value:
 
 
 def unpack_transaction(value: Value):
-    """The batch back out of a packed value, or None for plain values."""
+    """The batch back out of a packed value, or None for plain values.
+
+    A malformed payload (e.g. a client-supplied value that merely
+    starts with TXN_MAGIC and slipped past the HTTP guard) is treated
+    as a plain write rather than raised: an uncaught decode error here
+    would be a poison command crashing every replica at execute time.
+    """
     import json
     if not value.startswith(TXN_MAGIC):
         return None
-    return [Command(int(k), v.encode("latin1"))
-            for k, v in json.loads(value[len(TXN_MAGIC):].decode())]
+    try:
+        batch = json.loads(value[len(TXN_MAGIC):].decode())
+        return [Command(int(k), v.encode("latin1")) for k, v in batch]
+    except (ValueError, TypeError, KeyError, AttributeError,
+            UnicodeDecodeError):
+        return None
 
 
 def pack_values(values) -> Value:

@@ -63,6 +63,18 @@ class Database:
             if self._multi_version:
                 self._history.setdefault(key, []).append(value)
 
+    def snapshot(self) -> Dict[Key, Value]:
+        """Copy of the full KV map — the state-transfer payload for
+        leader-change log compaction (P1b snap)."""
+        with self._lock:
+            return dict(self._data)
+
+    def restore(self, snap: Dict[Key, Value]) -> None:
+        """Adopt a snapshot (state transfer at leader change)."""
+        with self._lock:
+            for k, v in snap.items():
+                self.put(int(k), v)
+
     def history(self, key: Key) -> List[Value]:
         with self._lock:
             return list(self._history.get(key, []))

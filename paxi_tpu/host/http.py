@@ -26,7 +26,7 @@ import time
 from typing import TYPE_CHECKING, Dict, Tuple
 from urllib.parse import parse_qs, urlparse
 
-from paxi_tpu.core.command import Command, Request
+from paxi_tpu.core.command import TXN_MAGIC, Command, Request
 
 if TYPE_CHECKING:
     from paxi_tpu.host.node import Node
@@ -117,6 +117,11 @@ class HTTPServer:
             return _response(400, b"", {"Err": "key must be an int"})
 
         value = body if method in ("PUT", "POST") else b""
+        if value.startswith(TXN_MAGIC):
+            # the packed-transaction encoding is internal; a client value
+            # carrying the magic prefix would be reinterpreted as a batch
+            # at execute time on every replica
+            return _response(400, b"", {"Err": "reserved value prefix"})
         cmd = Command(key, value,
                       client_id=headers.get("client-id", ""),
                       command_id=int(headers.get("command-id", "0")))
@@ -140,7 +145,14 @@ class HTTPServer:
         (command.py pack_transaction) and pushed through the protocol's
         normal Request path, so it replicates and totally orders like
         any write and applies atomically in Database.execute.  Batch
-        ops with empty values are reads (db.go empty-value semantics)."""
+        ops with empty values are reads (db.go empty-value semantics).
+
+        Ordering caveat: the packed command is sequenced under
+        cmds[0].key's log/object/conflict set, so on multi-log
+        protocols (kpaxos/wpaxos/epaxos) a cross-key batch orders
+        atomically only against commands touching that first key; use
+        single-log protocols (paxos/chain) for cross-key serializable
+        batches."""
         from paxi_tpu.core.command import pack_transaction, unpack_values
         try:
             ops = json.loads(body.decode() or "[]")

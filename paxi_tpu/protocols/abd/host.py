@@ -20,7 +20,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, Tuple
 
-from paxi_tpu.core.command import Reply, Request
+from paxi_tpu.core.command import Command, Reply, Request
 from paxi_tpu.core.config import Config
 from paxi_tpu.core.ident import ID
 from paxi_tpu.core.quorum import Quorum
@@ -108,6 +108,11 @@ class ABDReplica(Node):
         cts, cw, _ = self._local(key)
         if (ts, writer) > (cts, cw):
             self.store[key] = (ts, writer, value)
+            # mirror into the KV store on EVERY replica so /local/{key}
+            # and Client.local_get see the register here too (dynamo
+            # behaves the same); db.execute (not put) so a packed
+            # /transaction batch unpacks and applies atomically
+            self.db.execute(Command(key, value))
 
     # ---- client ops ----------------------------------------------------
     def handle_request(self, req: Request) -> None:
@@ -177,7 +182,6 @@ class ABDReplica(Node):
         if op.is_read:
             op.request.reply(Reply(cmd, value=op.max_value))
         else:
-            self.db.execute(cmd)  # mirror into the KV store for inspection
             op.request.reply(Reply(cmd, value=b""))
 
 

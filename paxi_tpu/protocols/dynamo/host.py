@@ -79,6 +79,7 @@ class _Op:
     best: Ver = ZERO
     best_value: bytes = b""
     reported: Dict[ID, Ver] = None  # per-responder versions (reads)
+    done: bool = False              # replied to client; repair-only phase
 
 
 class DynamoReplica(Node):
@@ -112,6 +113,16 @@ class DynamoReplica(Node):
     def handle_request(self, req: Request) -> None:
         self._seq += 1
         tag = self._seq
+        # GC by age: answered reads kept only for straggler repair, and
+        # ops wedged below quorum by crashed/partitioned peers would
+        # otherwise leak for the whole outage
+        if not tag % 256:
+            stale = [t for t in self.ops if t <= tag - 1024]
+            for t in stale:
+                op = self.ops.pop(t)
+                if not op.done:
+                    op.request.reply(Reply(op.request.command,
+                                           err="quorum timed out"))
         key = req.command.key
         if req.command.is_read():
             op = _Op(req, key, True, Quorum(self.cfg.ids), reported={})
@@ -161,26 +172,52 @@ class DynamoReplica(Node):
         op = self.ops.get(m.tag)
         if op is None or not op.is_read:
             return
-        op.quorum.ack(ID(m.src))
-        op.reported[ID(m.src)] = (m.counter, m.node)
-        if (m.counter, m.node) > op.best:
+        src = ID(m.src)
+        op.quorum.ack(src)
+        op.reported[src] = (m.counter, m.node)
+        newer = (m.counter, m.node) > op.best
+        if newer:
             op.best, op.best_value = (m.counter, m.node), m.value
+            if op.done:
+                # newer version surfaced after the client reply: adopt it
+                # locally so our own store is not the laggard
+                self._apply(op.key, op.best[0], op.best[1], op.best_value)
+        if op.done:
+            # repair-only phase: the client is answered, but a straggler
+            # that reports a stale version still gets the write-back —
+            # exactly the laggards read repair exists to heal.  If the
+            # straggler RAISED the best, everyone who reported the old
+            # best is now stale too: re-repair them all.
+            if newer:
+                for peer in op.reported:
+                    self._repair_peer(op, peer)
+            else:
+                self._repair_peer(op, src)
+            if op.quorum.size() >= len(self.cfg.ids):
+                del self.ops[m.tag]
+            return
         self._read_done(m.tag, op)
+
+    def _repair_peer(self, op: _Op, peer: ID) -> None:
+        if peer != self.id and op.best > ZERO and op.reported[peer] < op.best:
+            self.socket.send(peer, RWrite(
+                str(self.id), 0, op.key, op.best[0], op.best[1],
+                op.best_value))
 
     def _read_done(self, tag: int, op: _Op) -> None:
         if op.quorum.size() < self.R:
             return
-        del self.ops[tag]
+        op.done = True
+        if op.quorum.size() >= len(self.cfg.ids):
+            del self.ops[tag]
         # read repair, targeted: only responders that reported a version
         # below the winner get the write-back (healthy clusters pay no
-        # repair traffic)
+        # repair traffic).  The op stays alive (done=True) until all N
+        # replies arrive so post-quorum stragglers are repaired too.
         if op.best > ZERO:
             self._apply(op.key, op.best[0], op.best[1], op.best_value)
-            for peer, ver in op.reported.items():
-                if peer != self.id and ver < op.best:
-                    self.socket.send(peer, RWrite(
-                        str(self.id), 0, op.key, op.best[0], op.best[1],
-                        op.best_value))
+            for peer in op.reported:
+                self._repair_peer(op, peer)
         op.request.reply(Reply(op.request.command, value=op.best_value))
 
 

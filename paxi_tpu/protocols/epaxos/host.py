@@ -11,16 +11,33 @@ strongly-connected components (Tarjan, epaxos exec.go) with seq as the
 tiebreak.  Deps use the standard max-interfering-instance-per-replica
 vector form.
 
-Like the reference's normal-case code this replica does not implement
-the Prepare/recovery path (paxi's epaxos recovery is likewise partial);
-the TPU sim kernel (sim.py) fuzzes the same normal-case protocol.
+Recovery (epaxos Prepare/PrepareReply, explicit-prepare): a watchdog
+scans for instances stuck uncommitted past ``recovery_timeout`` —
+either blocking local execution as deps of committed instances, or
+carrying an unanswered client request — and runs Prepare at a higher
+ballot.  On a majority of PrepareReplies the recoverer finishes the
+instance: seen-committed => re-Commit; seen-accepted => Accept the
+highest-ballot attrs; seen-preaccepted => Accept the attrs reported by
+the most repliers (a surviving fast-path commit is always the
+plurality, since every majority intersects the fast quorum in
+>= F+M-N replicas holding identical attrs); seen-nowhere => commit a
+NOOP to unblock the hole.
+
+Liveness fallback (slow path): the command leader schedules an Accept
+round once a MAJORITY of PreAcceptReplies is in but the fast quorum
+has not materialized within ``accept_fallback`` seconds — without it,
+one dead replica out of N=3 (or two of N=5) wedges every command even
+though a live majority exists.
 """
 
 from __future__ import annotations
 
+import asyncio
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from paxi_tpu.core.ballot import next_ballot
 from paxi_tpu.core.command import Command, Reply, Request
 from paxi_tpu.core.config import Config
 from paxi_tpu.core.ident import ID
@@ -29,6 +46,8 @@ from paxi_tpu.host.codec import register_message
 from paxi_tpu.host.node import Node
 
 NONE, PREACCEPTED, ACCEPTED, COMMITTED, EXECUTED = 0, 1, 2, 3, 4
+
+NOOP_KEY = -1
 
 
 @register_message
@@ -65,6 +84,8 @@ class Accept:
     deps: Dict[str, int]
     client_id: str = ""
     command_id: int = 0
+    ballot: int = 0       # >0 when a recoverer drives the round
+    src: str = ""         # who runs the round (defaults to owner)
 
 
 @register_message
@@ -73,6 +94,35 @@ class AcceptReply:
     owner: str
     inst: int
     id: str
+    ballot: int = 0
+
+
+@register_message
+@dataclass
+class Prepare:
+    """Recovery phase-1: claim instance (owner, inst) at a new ballot."""
+
+    owner: str
+    inst: int
+    ballot: int
+    src: str
+
+
+@register_message
+@dataclass
+class PrepareReply:
+    owner: str
+    inst: int
+    ballot: int           # the replier's promised ballot after this msg
+    status: int           # NONE/PREACCEPTED/ACCEPTED/COMMITTED/EXECUTED
+    accepted_ballot: int
+    key: int
+    value: bytes
+    seq: int
+    deps: Dict[str, int]
+    id: str
+    client_id: str = ""
+    command_id: int = 0
 
 
 @register_message
@@ -95,10 +145,28 @@ class Instance:
     deps: Dict[ID, int]
     status: int = PREACCEPTED
     request: Optional[Request] = None
-    # leader-side tallies
-    replies: int = 1
-    accept_replies: int = 1
+    # leader-side tallies: distinct acker sets, so retransmit-induced
+    # duplicate replies can never fake a quorum
+    acked: set = field(default_factory=set)
+    accept_acked: set = field(default_factory=set)
     changed: bool = False
+    # recovery state
+    ballot: int = 0            # promised ballot (0 = owner's implicit)
+    accepted_ballot: int = 0   # ballot the current attrs were taken at
+    born: float = field(default_factory=time.monotonic)
+    fallback_armed: bool = False
+
+
+@dataclass
+class _Recovery:
+    """Recoverer-side tally for one Prepare round over (owner, inst)."""
+
+    ballot: int
+    replies: Dict[ID, PrepareReply] = field(default_factory=dict)
+    phase: int = 1             # 1 = prepare round, 2 = accept round
+    accept_acks: int = 0
+    decided: bool = False
+    born: float = field(default_factory=time.monotonic)
 
 
 class EPaxosReplica(Node):
@@ -112,12 +180,25 @@ class EPaxosReplica(Node):
         self.maj = majority_size(cfg.n)
         self.fast_commits = 0
         self.slow_commits = 0
+        self.recoveries: Dict[Tuple[ID, int], _Recovery] = {}
+        # every instance not yet EXECUTED: the watchdog and the executor
+        # walk this set instead of the full (ever-growing) instance log
+        self._live: set = set()
+        self.recovery_timeout = 0.5    # uncommitted-instance age trigger
+        self.recovery_interval = 0.15  # watchdog period
+        self.accept_fallback = 0.15    # majority-but-no-fast-quorum timer
         self.register(Request, self.handle_request)
         self.register(PreAccept, self.handle_preaccept)
         self.register(PreAcceptReply, self.handle_preaccept_reply)
         self.register(Accept, self.handle_accept)
         self.register(AcceptReply, self.handle_accept_reply)
         self.register(Commit, self.handle_commit)
+        self.register(Prepare, self.handle_prepare)
+        self.register(PrepareReply, self.handle_prepare_reply)
+
+    async def start(self) -> None:
+        await super().start()
+        self._tasks.append(asyncio.create_task(self._recovery_watchdog()))
 
     # ---- attribute computation (exec.go conflict map) -------------------
     def _attrs(self, key: int, excl: Tuple[ID, int]) -> Tuple[int, Dict[ID, int]]:
@@ -136,7 +217,11 @@ class EPaxosReplica(Node):
 
     def _record(self, owner: ID, inst: int, e: Instance) -> None:
         self.insts[owner][inst] = e
+        if e.status < EXECUTED:
+            self._live.add((owner, inst))
         k = e.command.key
+        if k == NOOP_KEY:
+            return                 # NOOPs never interfere
         cur = self.conflicts.setdefault(k, {})
         cur[owner] = max(cur.get(owner, -1), inst)
 
@@ -147,6 +232,7 @@ class EPaxosReplica(Node):
         cmd = req.command
         seq, deps = self._attrs(cmd.key, (self.id, inst))
         e = Instance(cmd, seq, dict(deps), request=req)
+        e.acked.add(self.id)
         self._record(self.id, inst, e)
         self.socket.broadcast(PreAccept(
             str(self.id), inst, cmd.key, cmd.value, seq,
@@ -163,6 +249,8 @@ class EPaxosReplica(Node):
         for k, v in mdeps.items():
             deps[k] = max(deps.get(k, -1), v)
         prev = self.insts[owner].get(m.inst)
+        if prev is not None and prev.ballot > 0:
+            return                 # promised a recoverer; owner is stale
         if prev is None or prev.status < ACCEPTED:
             self._record(owner, m.inst, Instance(cmd, seq, dict(deps)))
         self.socket.send(owner, PreAcceptReply(
@@ -173,7 +261,7 @@ class EPaxosReplica(Node):
         e = self.insts[self.id].get(m.inst)
         if e is None or e.status != PREACCEPTED or e.request is None:
             return
-        e.replies += 1
+        e.acked.add(ID(m.id))
         deps = {ID(k): v for k, v in m.deps.items()}
         if m.seq != e.seq or deps != e.deps:
             e.changed = True
@@ -183,56 +271,291 @@ class EPaxosReplica(Node):
         self._leader_check(m.inst, e)
 
     def _leader_check(self, inst: int, e: Instance) -> None:
-        if e.replies >= self.fast and not e.changed:
+        if e.ballot > 0:
+            return   # a recoverer claimed this instance; stop driving it
+        if len(e.acked) >= self.fast and not e.changed:
             self.fast_commits += 1
-            self._commit(inst, e)
-        elif e.replies >= self.fast and e.changed:
+            self._commit(self.id, inst, e)
+        elif len(e.acked) >= self.fast and e.changed:
+            self._run_accept(inst, e)
+        elif len(e.acked) >= self.maj and not e.fallback_armed:
+            # fast quorum may never materialize (dead replicas); after a
+            # grace period run the always-safe slow path on the majority
+            e.fallback_armed = True
+            asyncio.get_running_loop().call_later(
+                self.accept_fallback, self._fallback_accept, inst)
+
+    def _fallback_accept(self, inst: int) -> None:
+        e = self.insts[self.id].get(inst)
+        if (e is not None and e.status == PREACCEPTED and e.ballot == 0
+                and e.request is not None and len(e.acked) >= self.maj):
             self._run_accept(inst, e)
 
     def _run_accept(self, inst: int, e: Instance) -> None:
         e.status = ACCEPTED
-        e.accept_replies = 1
+        e.accepted_ballot = e.ballot
+        e.accept_acked = {self.id}
         c = e.command
         self.socket.broadcast(Accept(
             str(self.id), inst, c.key, c.value, e.seq,
             {str(k): v for k, v in e.deps.items()},
-            c.client_id, c.command_id))
-        if e.accept_replies >= self.maj:
+            c.client_id, c.command_id, e.ballot, str(self.id)))
+        if len(e.accept_acked) >= self.maj:
             self.slow_commits += 1
-            self._commit(inst, e)
+            self._commit(self.id, inst, e)
 
     def handle_accept(self, m: Accept) -> None:
         owner = ID(m.owner)
         cmd = Command(m.key, m.value, m.client_id, m.command_id)
         prev = self.insts[owner].get(m.inst)
+        if prev is not None and m.ballot < prev.ballot:
+            return        # promised a higher-ballot recoverer
         e = Instance(cmd, m.seq, {ID(k): v for k, v in m.deps.items()},
                      status=ACCEPTED,
-                     request=prev.request if prev else None)
+                     request=prev.request if prev else None,
+                     ballot=m.ballot, accepted_ballot=m.ballot)
         if prev is None or prev.status < COMMITTED:
             self._record(owner, m.inst, e)
-        self.socket.send(owner, AcceptReply(m.owner, m.inst, str(self.id)))
+        self.socket.send(ID(m.src) if m.src else owner,
+                         AcceptReply(m.owner, m.inst, str(self.id),
+                                     m.ballot))
 
     def handle_accept_reply(self, m: AcceptReply) -> None:
-        e = self.insts[self.id].get(m.inst)
-        if e is None or e.status != ACCEPTED or e.request is None:
+        owner = ID(m.owner)
+        r = self.recoveries.get((owner, m.inst))
+        if r is not None and r.phase == 2 and m.ballot == r.ballot:
+            self._recovery_accept_ack(owner, m.inst, r)
             return
-        e.accept_replies += 1
-        if e.accept_replies >= self.maj:
+        if owner != self.id:
+            return
+        e = self.insts[self.id].get(m.inst)
+        if (e is None or e.status != ACCEPTED or e.request is None
+                or m.ballot != e.ballot):
+            return   # ballot mismatch: a recoverer superseded this round
+        e.accept_acked.add(ID(m.id))
+        if len(e.accept_acked) >= self.maj:
             self.slow_commits += 1
-            self._commit(m.inst, e)
+            self._commit(self.id, m.inst, e)
 
-    def _commit(self, inst: int, e: Instance) -> None:
+    def _commit(self, owner: ID, inst: int, e: Instance) -> None:
         e.status = COMMITTED
         c = e.command
         self.socket.broadcast(Commit(
-            str(self.id), inst, c.key, c.value, e.seq,
+            str(owner), inst, c.key, c.value, e.seq,
             {str(k): v for k, v in e.deps.items()},
             c.client_id, c.command_id))
         self._execute()
 
+    # ---- recovery (epaxos Prepare/PrepareReply, explicit prepare) -------
+    async def _recovery_watchdog(self) -> None:
+        while True:
+            await asyncio.sleep(self.recovery_interval)
+            try:
+                # GC recovery records whose instance committed via a
+                # competing recoverer or the returning owner; also
+                # expire stalled rounds (lost Prepare/Accept broadcast)
+                # so the stuck-scan can retry them at a higher ballot
+                now = time.monotonic()
+                for (o, i) in list(self.recoveries):
+                    e = self.insts[o].get(i)
+                    r = self.recoveries[(o, i)]
+                    if e is not None and e.status >= COMMITTED:
+                        del self.recoveries[(o, i)]
+                    elif now - r.born > 2 * self.recovery_timeout:
+                        del self.recoveries[(o, i)]
+                for owner, inst in self._stuck_instances():
+                    self.recover(owner, inst)
+            except Exception:     # never kill the watchdog
+                from paxi_tpu.utils import log
+                import traceback
+                log.errorf("%s: recovery watchdog: %s", self.id,
+                           traceback.format_exc())
+
+    def _stuck_instances(self) -> List[Tuple[ID, int]]:
+        """Instances needing takeover, from the _live set only:
+        uncommitted past the timeout and either blocking execution as a
+        direct dep of a committed instance, or known locally on a
+        peer's row.  Dep holes (instances we have never seen) get a
+        placeholder so the same age gate applies to them."""
+        now = time.monotonic()
+        stuck: List[Tuple[ID, int]] = []
+        holes: List[Tuple[ID, int]] = []
+        for (owner, i) in self._live:
+            e = self.insts[owner].get(i)
+            if e is None or e.status >= EXECUTED:
+                continue
+            if e.status == COMMITTED:
+                for p, j in e.deps.items():
+                    if j < 0:
+                        continue
+                    d = self.insts[p].get(j)
+                    if d is None:
+                        holes.append((p, j))
+                    elif (d.status < COMMITTED
+                            and now - d.born > self.recovery_timeout
+                            and (p, j) not in self.recoveries):
+                        stuck.append((p, j))
+            elif (owner != self.id
+                    and now - e.born > self.recovery_timeout
+                    and (owner, i) not in self.recoveries):
+                stuck.append((owner, i))
+            elif (owner == self.id and e.ballot == 0
+                    and e.request is not None
+                    and now - e.born > self.recovery_timeout):
+                # own stalled round (lost PreAccepts/Accepts and below
+                # the fallback's majority): retransmit; dedup by the
+                # distinct-acker sets, so this can never fake a quorum
+                self._retransmit(i, e)
+        for (p, j) in holes:
+            # first sighting: start the age clock, recover next rounds
+            ph = Instance(Command(NOOP_KEY, b""), 0, {}, status=NONE)
+            self.insts[p][j] = ph
+            self._live.add((p, j))
+        return stuck
+
+    def _retransmit(self, inst: int, e: Instance) -> None:
+        e.born = time.monotonic()
+        c = e.command
+        if e.status == PREACCEPTED:
+            self.socket.broadcast(PreAccept(
+                str(self.id), inst, c.key, c.value, e.seq,
+                {str(k): v for k, v in e.deps.items()},
+                c.client_id, c.command_id))
+        elif e.status == ACCEPTED:
+            self.socket.broadcast(Accept(
+                str(self.id), inst, c.key, c.value, e.seq,
+                {str(k): v for k, v in e.deps.items()},
+                c.client_id, c.command_id, e.ballot, str(self.id)))
+
+    def recover(self, owner: ID, inst: int) -> None:
+        """Take over (owner, inst) at a ballot above anything seen."""
+        if (owner, inst) in self.recoveries:
+            return
+        e = self.insts[owner].get(inst)
+        if e is not None and e.status >= COMMITTED:
+            return
+        if e is None:
+            e = Instance(Command(NOOP_KEY, b""), 0, {}, status=NONE)
+            self.insts[owner][inst] = e
+            self._live.add((owner, inst))
+        b = next_ballot(max(e.ballot, e.accepted_ballot), self.id)
+        r = _Recovery(ballot=b)
+        self.recoveries[(owner, inst)] = r
+        e.ballot = b
+        r.replies[self.id] = PrepareReply(
+            str(owner), inst, b, e.status, e.accepted_ballot,
+            e.command.key, e.command.value, e.seq,
+            {str(k): v for k, v in e.deps.items()}, str(self.id),
+            e.command.client_id, e.command.command_id)
+        self.socket.broadcast(Prepare(str(owner), inst, b, str(self.id)))
+        self._recovery_decide(owner, inst, r)
+
+    def handle_prepare(self, m: Prepare) -> None:
+        owner = ID(m.owner)
+        e = self.insts[owner].get(m.inst)
+        if e is None:
+            e = Instance(Command(NOOP_KEY, b""), 0, {}, status=NONE)
+            self.insts[owner][m.inst] = e
+            self._live.add((owner, m.inst))
+        if m.ballot > e.ballot:
+            e.ballot = m.ballot
+        self.socket.send(ID(m.src), PrepareReply(
+            m.owner, m.inst, e.ballot, e.status, e.accepted_ballot,
+            e.command.key, e.command.value, e.seq,
+            {str(k): v for k, v in e.deps.items()}, str(self.id),
+            e.command.client_id, e.command.command_id))
+
+    def handle_prepare_reply(self, m: PrepareReply) -> None:
+        owner = ID(m.owner)
+        r = self.recoveries.get((owner, m.inst))
+        if r is None or r.decided:
+            return
+        if m.ballot > r.ballot:
+            # a higher-ballot recoverer owns this instance now; back off
+            # (the watchdog re-triggers if it dies too)
+            del self.recoveries[(owner, m.inst)]
+            e = self.insts[owner].get(m.inst)
+            if e is not None:
+                e.ballot = max(e.ballot, m.ballot)
+                e.born = time.monotonic()
+            return
+        if m.ballot < r.ballot:
+            return   # stale reply from an older prepare round of ours
+        r.replies[ID(m.id)] = m
+        self._recovery_decide(owner, m.inst, r)
+
+    def _recovery_decide(self, owner: ID, inst: int, r: _Recovery) -> None:
+        if r.decided or len(r.replies) < self.maj:
+            return
+        replies = list(r.replies.values())
+        committed = [p for p in replies if p.status >= COMMITTED]
+        accepted = [p for p in replies if p.status == ACCEPTED]
+        preaccepted = [p for p in replies if p.status == PREACCEPTED]
+        r.decided = True
+        if committed:
+            p = committed[0]
+            self._finish_recovery(owner, inst, r, p, commit=True)
+        elif accepted:
+            p = max(accepted, key=lambda p: p.accepted_ballot)
+            self._finish_recovery(owner, inst, r, p, commit=False)
+        elif preaccepted:
+            # plurality attrs: a surviving fast-path commit implies
+            # >= F+M-N identical replies in any prepare majority, which
+            # is always the largest group; Accept (slow path) fixes them
+            groups: Dict[tuple, List[PrepareReply]] = {}
+            for p in preaccepted:
+                sig = (p.seq, tuple(sorted(p.deps.items())), p.key, p.value)
+                groups.setdefault(sig, []).append(p)
+            best = max(groups.values(), key=len)
+            self._finish_recovery(owner, inst, r, best[0], commit=False)
+        else:
+            # nobody saw the command: commit a NOOP to unblock the hole
+            noop = PrepareReply(str(owner), inst, r.ballot, NONE, 0,
+                                NOOP_KEY, b"", 0, {}, str(self.id))
+            self._finish_recovery(owner, inst, r, noop, commit=True)
+
+    def _finish_recovery(self, owner: ID, inst: int, r: _Recovery,
+                         p: PrepareReply, commit: bool) -> None:
+        cmd = Command(p.key, p.value, p.client_id, p.command_id)
+        deps = {ID(k): v for k, v in p.deps.items()}
+        prev = self.insts[owner].get(inst)
+        e = Instance(cmd, p.seq, dict(deps),
+                     request=prev.request if prev else None,
+                     ballot=r.ballot, accepted_ballot=r.ballot)
+        if commit:
+            e.status = COMMITTED
+            self._record(owner, inst, e)   # NOOPs skip the conflict map
+            del self.recoveries[(owner, inst)]
+            self._commit(owner, inst, e)
+        else:
+            e.status = ACCEPTED
+            self._record(owner, inst, e)
+            r.phase = 2
+            r.accept_acks = 1
+            self.socket.broadcast(Accept(
+                str(owner), inst, cmd.key, cmd.value, e.seq,
+                {str(k): v for k, v in e.deps.items()},
+                cmd.client_id, cmd.command_id, r.ballot, str(self.id)))
+            self._recovery_accept_ack(owner, inst, r, initial=True)
+
+    def _recovery_accept_ack(self, owner: ID, inst: int, r: _Recovery,
+                             initial: bool = False) -> None:
+        if not initial:
+            r.accept_acks += 1
+        if r.accept_acks >= self.maj:
+            e = self.insts[owner].get(inst)
+            if e is None or e.status >= COMMITTED:
+                self.recoveries.pop((owner, inst), None)
+                return
+            self.slow_commits += 1
+            self.recoveries.pop((owner, inst), None)
+            self._commit(owner, inst, e)
+
     def handle_commit(self, m: Commit) -> None:
         owner = ID(m.owner)
         prev = self.insts[owner].get(m.inst)
+        if prev is not None and prev.status >= COMMITTED:
+            return   # recovery re-Commits must not re-execute
         e = Instance(Command(m.key, m.value, m.client_id, m.command_id),
                      m.seq, {ID(k): v for k, v in m.deps.items()},
                      status=COMMITTED,
@@ -300,15 +623,18 @@ class EPaxosReplica(Node):
                     if not any(blocked.get(w, False) for w in comp):
                         comp.sort(key=lambda w: (node(w).seq, str(w[0]), w[1]))
                         for w in comp:
-                            self._apply(node(w))
+                            self._apply(w, node(w))
                     else:
                         for w in comp:
                             blocked[w] = True
 
-        for owner, insts in self.insts.items():
-            for i, e in sorted(insts.items()):
-                if e.status == COMMITTED and (owner, i) not in index:
-                    strongconnect((owner, i))
+        roots = sorted((w for w in self._live
+                        if (n := node(w)) is not None
+                        and n.status == COMMITTED),
+                       key=lambda w: (str(w[0]), w[1]))
+        for w in roots:
+            if w not in index:
+                strongconnect(w)
 
     def _neighbors(self, u: Tuple[ID, int]) -> List[Tuple[ID, int]]:
         e = self.insts[u[0]].get(u[1])
@@ -316,10 +642,16 @@ class EPaxosReplica(Node):
             return []
         return [(p, j) for p, j in e.deps.items() if j >= 0]
 
-    def _apply(self, e: Instance) -> None:
+    def _apply(self, w: Tuple[ID, int], e: Instance) -> None:
         if e.status >= EXECUTED:
             return
         e.status = EXECUTED
+        self._live.discard(w)
+        if e.command.key == NOOP_KEY:
+            if e.request is not None:
+                e.request.reply(Reply(e.command, err="noop"))
+                e.request = None
+            return
         value = self.db.execute(e.command)
         if e.request is not None:
             e.request.reply(Reply(e.command, value=value))

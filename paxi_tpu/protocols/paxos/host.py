@@ -31,6 +31,10 @@ NOOP = Command(key=-1, value=b"\x00noop")
 @dataclass
 class P1a:
     ballot: int
+    # candidate's execute frontier: ackers ship the KV snapshot only
+    # when they are ahead of it, so steady-state elections (equal
+    # frontiers) pay no O(DB) wire cost
+    execute: int = 0
 
 
 @register_message
@@ -40,6 +44,13 @@ class P1b:
     id: str
     # slot -> [ballot, key, value, client_id, command_id, committed]
     log: Dict[int, list] = field(default_factory=dict)
+    # state transfer: the log payload omits slots below the sender's
+    # execute frontier (log-compaction analog), so the frontier plus a
+    # KV snapshot stands in for the executed prefix — without it a new
+    # leader behind an all-executed quorum would NOOP-fill committed,
+    # executed slots and diverge
+    execute: int = 0
+    snap: Dict[int, bytes] = field(default_factory=dict)
 
 
 @register_message
@@ -95,6 +106,7 @@ class PaxosReplica(Node):
         self.execute = 0        # next slot to execute
         self.p1_quorum = Quorum(cfg.ids)
         self.p1b_logs: Dict[ID, Dict[int, list]] = {}
+        self.p1b_meta: Dict[ID, tuple] = {}   # id -> (execute, snapshot)
         self.pending: list = []  # requests queued while electing
         self.register(Request, self.handle_request)
         self.register(P1a, self.handle_p1a)
@@ -118,7 +130,8 @@ class PaxosReplica(Node):
         self.p1_quorum = Quorum(self.cfg.ids)
         self.p1_quorum.ack(self.id)
         self.p1b_logs = {self.id: self._log_payload()}
-        self.socket.broadcast(P1a(self.ballot))
+        self.p1b_meta = {self.id: (self.execute, {})}  # own db is local
+        self.socket.broadcast(P1a(self.ballot, self.execute))
 
     def _log_payload(self) -> Dict[int, list]:
         return {s: [e.ballot, e.command.key, e.command.value,
@@ -164,8 +177,12 @@ class PaxosReplica(Node):
             self.ballot = m.ballot
             self.active = False
             self._repend_inflight()
+        snap = (self.db.snapshot()
+                if self.execute > m.execute and m.ballot >= self.ballot
+                else {})   # stale candidates discard the P1b anyway
         self.socket.send(ballot_id(m.ballot),
-                         P1b(self.ballot, str(self.id), self._log_payload()))
+                         P1b(self.ballot, str(self.id), self._log_payload(),
+                             self.execute, snap))
 
     def _repend_inflight(self) -> None:
         """Losing leadership: uncommitted proposals carrying client
@@ -184,6 +201,7 @@ class PaxosReplica(Node):
             return
         self.p1_quorum.ack(ID(m.id))
         self.p1b_logs[ID(m.id)] = m.log
+        self.p1b_meta[ID(m.id)] = (m.execute, m.snap)
         if self.p1_quorum.majority() and ballot_id(self.ballot) == self.id:
             self._become_leader()
 
@@ -192,6 +210,31 @@ class PaxosReplica(Node):
         committed values, fill holes with NOOP; re-propose everything in
         the window (paxos.go HandleP1b recovery path)."""
         self.active = True
+        # state transfer first: an acker ahead of our execute frontier
+        # has executed (hence committed) everything below it; adopt its
+        # snapshot + frontier so the merge never NOOPs an executed slot
+        front, snap = max(self.p1b_meta.values(),
+                          key=lambda fs: fs[0], default=(0, {}))
+        if front > self.execute:
+            # entries the jump skips: uncommitted ones with requests go
+            # back to pending (re-proposed in fresh slots); committed
+            # ones were decided — acks for writes, the snapshot value
+            # for reads (the closest to what in-order _exec would say)
+            snap_n = {int(k): v for k, v in snap.items()}
+            for s in range(self.execute, front):
+                e = self.log.get(s)
+                if e is None or e.request is None:
+                    continue
+                if e.commit:
+                    v = (snap_n.get(e.command.key, b"")
+                         if e.command.is_read() else b"")
+                    e.request.reply(Reply(e.command, value=v))
+                else:
+                    self.pending.append(e.request)
+                e.request = None
+            self.db.restore(snap)
+            self.execute = front
+            self.slot = max(self.slot, front - 1)
         merged: Dict[int, Tuple[int, Command, bool]] = {}
         top = self.slot
         for log in self.p1b_logs.values():

@@ -45,6 +45,10 @@ class WP1b:
     id: str
     # slot -> [ballot, key, value, client_id, command_id, committed]
     log: Dict[int, list] = field(default_factory=dict)
+    # state transfer: sender's execute frontier + its current value for
+    # the key, standing in for the executed prefix the log omits
+    execute: int = 0
+    snap: bytes = b""
 
 
 @register_message
@@ -98,6 +102,7 @@ class KeyObject:
         self.execute = 0
         self.p1_quorum: Optional[Quorum] = None
         self.p1b_logs: Dict[ID, Dict[int, list]] = {}
+        self.p1b_meta: Dict[ID, tuple] = {}   # id -> (execute, snap)
         self.pending: list = []
 
 
@@ -169,11 +174,17 @@ class WPaxosReplica(Node):
         o.p1_quorum = Quorum(self.cfg.ids)
         o.p1_quorum.ack(self.id)
         o.p1b_logs = {self.id: self._log_payload(o)}
+        o.p1b_meta = {self.id: (o.execute, self.db.get(k) or b"")}
         self.steals += 1
         self.socket.broadcast(WP1a(k, o.ballot))
         self._maybe_win(k, o)
 
     def _log_payload(self, o: KeyObject) -> Dict[int, list]:
+        # O(unexecuted window): slots below the sender's execute frontier
+        # are covered by the (execute, snap) state transfer in WP1b —
+        # the winner adopts the max frontier's value instead of needing
+        # every executed committed entry (which would otherwise let a
+        # stealer NOOP over a committed, executed write)
         return {s: [e.ballot, e.command.key, e.command.value,
                     e.command.client_id, e.command.command_id, e.commit]
                 for s, e in o.log.items() if s >= o.execute}
@@ -187,7 +198,8 @@ class WPaxosReplica(Node):
             self._repend(o)
         self.socket.send(ballot_id(m.ballot),
                          WP1b(m.key, o.ballot, str(self.id),
-                              self._log_payload(o)))
+                              self._log_payload(o), o.execute,
+                              self.db.get(m.key) or b""))
 
     def _repend(self, o: KeyObject) -> None:
         for e in o.log.values():
@@ -207,6 +219,7 @@ class WPaxosReplica(Node):
             return
         o.p1_quorum.ack(ID(m.id))
         o.p1b_logs[ID(m.id)] = m.log
+        o.p1b_meta[ID(m.id)] = (m.execute, m.snap)
         self._maybe_win(m.key, o)
 
     def _maybe_win(self, k: int, o: KeyObject) -> None:
@@ -215,6 +228,29 @@ class WPaxosReplica(Node):
         # adopted: merge P1b logs exactly like single-leader recovery
         o.active = True
         o.p1_quorum = None
+        # state transfer first: any acker ahead of our execute frontier
+        # has executed (hence committed) everything below its frontier —
+        # adopt its KV value and jump our frontier there, so the merge
+        # below never NOOP-fills an executed slot
+        front, snap = max(o.p1b_meta.values(), default=(0, b""))
+        if front > o.execute:
+            # same request handling as paxos host's frontier jump:
+            # re-pend skipped uncommitted entries; committed ones get
+            # acks for writes, the snapshot value for reads
+            for s in range(o.execute, front):
+                e = o.log.get(s)
+                if e is None or e.request is None:
+                    continue
+                if e.commit:
+                    v = snap if e.command.is_read() else b""
+                    e.request.reply(Reply(e.command, value=v))
+                else:
+                    o.pending.append(e.request)
+                e.request = None
+            if snap:
+                self.db.put(k, snap)
+            o.execute = front
+            o.slot = max(o.slot, front - 1)
         merged: Dict[int, tuple] = {}
         top = o.slot
         for log in o.p1b_logs.values():

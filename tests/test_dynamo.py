@@ -77,6 +77,37 @@ def test_read_repair_heals_stale_replica():
     run(main())
 
 
+def test_late_read_reply_triggers_repair():
+    """Force the post-quorum ordering: the stale replica's RReadReply
+    arrives AFTER the coordinator already answered the client.  The
+    late reply must still get the read-repair write-back."""
+    async def main():
+        c = Cluster("dynamo", n=3, http=False)
+        await c.start()
+        try:
+            from paxi_tpu.protocols.dynamo.host import RReadReply
+            # seed: 1.1/1.2 hold version (3,0); 1.3 is stale (empty)
+            for i in ("1.1", "1.2"):
+                c[i].store[7] = (3, 0, b"new")
+            # read at 1.2 with 1.3 cut off -> quorum = self + 1.1 only
+            c["1.2"].socket.drop("1.3", 5.0)
+            c["1.3"].socket.drop("1.2", 5.0)
+            assert await do(c["1.2"], 7, cmd_id=1) == b"new"
+            tag = c["1.2"]._seq
+            assert c["1.2"].ops[tag].done      # kept for straggler repair
+            # heal the link, then hand-deliver 1.3's LATE stale reply
+            c["1.2"].socket.drop("1.3", 0.0)
+            c["1.3"].socket.drop("1.2", 0.0)
+            c["1.2"].handle_read_reply(
+                RReadReply("1.3", tag, 7, 0, -1, b""))
+            assert tag not in c["1.2"].ops     # all 3 replies in -> GC'd
+            await asyncio.sleep(0.05)          # deliver the repair RWrite
+            assert c["1.3"].store[7][2] == b"new"
+        finally:
+            await c.stop()
+    run(main())
+
+
 # ---------------------------------------------------------------- sim --
 
 def test_sim_quiescent_convergence():

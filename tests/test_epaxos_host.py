@@ -112,3 +112,91 @@ def test_deps_recorded_for_conflicts():
         finally:
             await c.stop()
     run(main())
+
+
+# ---------------------------------------------------- recovery (Prepare) --
+
+def _fast_timers(c, recovery=0.2, interval=0.05):
+    for i in c.ids:
+        c[i].recovery_timeout = recovery
+        c[i].recovery_interval = interval
+
+
+def test_majority_fallback_with_dead_replica():
+    """ADVICE: N=3 with one replica down must still commit via the
+    slow path once a live majority of PreAcceptReplies is in."""
+    async def main():
+        c = Cluster("epaxos", n=3, http=False)
+        await c.start()
+        try:
+            _fast_timers(c, recovery=5.0)     # isolate the fallback path
+            c["1.3"].socket.crash(30.0)       # its replies never arrive
+            assert await do(c["1.1"], 3, b"v", cmd_id=1, timeout=3.0) == b""
+            assert c["1.1"].slow_commits >= 1
+            assert c["1.1"].fast_commits == 0
+            assert await do(c["1.2"], 3, cmd_id=2, timeout=3.0) == b"v"
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_recovery_leader_crash_mid_preaccept():
+    """VERDICT #4: command leader crashes right after broadcasting
+    PreAccept; a peer must Prepare, take over, and finish the command."""
+    async def main():
+        c = Cluster("epaxos", n=3, http=False)
+        await c.start()
+        try:
+            _fast_timers(c)
+            fut = asyncio.get_running_loop().create_future()
+            c["1.1"].handle_client_request(Request(
+                command=Command(9, b"vrec", "c1", 1), reply_to=fut))
+            # crash the leader before any reply/commit can go out
+            c["1.1"].socket.crash(30.0)
+            # a peer's watchdog takes the instance over and commits it
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while asyncio.get_running_loop().time() < deadline:
+                if all(c[i].db.get(9) == b"vrec" for i in ("1.2", "1.3")):
+                    break
+                await asyncio.sleep(0.05)
+            for i in ("1.2", "1.3"):
+                assert c[i].db.get(9) == b"vrec", i
+            owner = c.ids[0]
+            for i in ("1.2", "1.3"):
+                e = c[i].insts[owner][0]
+                assert e.status >= 3, (i, e.status)   # COMMITTED
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_recovery_preserves_fast_committed_value():
+    """Leader fast-commits locally but its Commit broadcast is lost,
+    then it crashes: recovery must finish with the SAME command (the
+    plurality-preaccept rule), never a NOOP."""
+    async def main():
+        c = Cluster("epaxos", n=3, http=False)
+        await c.start()
+        try:
+            _fast_timers(c)
+            # leader's outgoing Commit is dropped to both peers, but
+            # PreAccept must go out first: drop only after the request
+            fut = asyncio.get_running_loop().create_future()
+            c["1.1"].handle_client_request(Request(
+                command=Command(11, b"keep", "c1", 1), reply_to=fut))
+            c["1.1"].socket.drop("1.2", 30.0)  # kills the upcoming Commit
+            c["1.1"].socket.drop("1.3", 30.0)  # (replies still come IN)
+            await asyncio.wait_for(fut, 3.0)   # leader commits locally
+            c["1.1"].socket.crash(30.0)        # now fully dead
+            assert c["1.1"].db.get(11) == b"keep"
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while asyncio.get_running_loop().time() < deadline:
+                if all(c[i].db.get(11) == b"keep" for i in ("1.2", "1.3")):
+                    break
+                await asyncio.sleep(0.05)
+            # peers recovered the exact value the leader executed
+            for i in ("1.2", "1.3"):
+                assert c[i].db.get(11) == b"keep", i
+        finally:
+            await c.stop()
+    run(main())

@@ -139,3 +139,35 @@ def test_admin_crash_via_http():
             cl.close()
             await c.stop()
     run(main())
+
+
+def test_new_leader_behind_executed_quorum_state_transfer():
+    """A laggard that wins an election after the quorum has executed
+    everything must adopt the frontier + KV snapshot from its P1b acks
+    (never NOOP-fill executed slots and serve empty reads)."""
+    async def main():
+        c = Cluster("paxos", n=3, http=False)
+        await c.start()
+        try:
+            # 1.3 misses everything while 5 writes commit + execute
+            c["1.1"].socket.drop("1.3", 5.0)
+            c["1.2"].socket.drop("1.3", 5.0)
+            for k in range(5):
+                await direct_put(c["1.1"], k, f"v{k}".encode(), cmd_id=k + 1)
+            assert c["1.3"].execute == 0
+            assert c["1.1"].execute >= 5
+            # old leader dies; the laggard runs the next election
+            c["1.1"].socket.crash(30.0)
+            c["1.1"].socket.drop("1.3", 0.0)
+            c["1.2"].socket.drop("1.3", 0.0)
+            c["1.3"].run_phase1()
+            await asyncio.sleep(0.1)
+            assert c["1.3"].is_leader()
+            # frontier + snapshot adopted: reads see the committed writes
+            assert c["1.3"].execute >= 5
+            for k in range(5):
+                assert await direct_get(
+                    c["1.3"], k, cmd_id=10 + k) == f"v{k}".encode()
+        finally:
+            await c.stop()
+    run(main())

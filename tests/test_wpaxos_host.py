@@ -130,3 +130,23 @@ def test_many_keys_distinct_owners():
         finally:
             await c.stop()
     run(main())
+
+
+def test_steal_preserves_executed_write():
+    """ADVICE: with q2=1 a write can commit + execute on the owner's
+    zone alone; a cross-zone steal must adopt the owner's execute
+    frontier + value snapshot, not NOOP over the executed slot."""
+    async def main():
+        c = Cluster("wpaxos", n=3, zones=3, http=False)
+        await c.start()
+        try:
+            ids = c.ids
+            await do(c[ids[0]], 7, b"zonal", cmd_id=1)
+            o = c[ids[0]].objs[7]
+            assert o.execute >= 1          # committed + executed at owner
+            # another zone steals the key, then serves a read
+            assert await do(c[ids[2]], 7, cmd_id=2) == b"zonal"
+            assert c[ids[2]].db.get(7) == b"zonal"
+        finally:
+            await c.stop()
+    run(main())
