@@ -17,7 +17,9 @@ per-replica version monotonicity and eventual convergence.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import asyncio
+import time
+from dataclasses import dataclass, field
 from typing import Dict, Tuple
 
 from paxi_tpu.core.command import Reply, Request
@@ -80,6 +82,7 @@ class _Op:
     best_value: bytes = b""
     reported: Dict[ID, Ver] = None  # per-responder versions (reads)
     done: bool = False              # replied to client; repair-only phase
+    born: float = field(default_factory=time.monotonic)
 
 
 class DynamoReplica(Node):
@@ -92,6 +95,13 @@ class DynamoReplica(Node):
         # W and R: majority each (W + R > N); the knob dynamo exposes
         self.W = cfg.n // 2 + 1
         self.R = cfg.n // 2 + 1
+        # op GC runs on wall-clock age from a periodic timer (like the
+        # epaxos recovery watchdog), not piggybacked on request arrivals
+        # — otherwise ops wedged below quorum by a partition never get
+        # their 'quorum timed out' reply once client traffic stops
+        # (ADVICE r2 low)
+        self.op_timeout = 1.0
+        self.gc_interval = 0.25
         self.register(Request, self.handle_request)
         self.register(RWrite, self.handle_write)
         self.register(RWriteAck, self.handle_write_ack)
@@ -109,20 +119,29 @@ class DynamoReplica(Node):
             self.clock = max(self.clock, counter)
             self.db.put(key, value)
 
-    # ---- coordinator ---------------------------------------------------
-    def handle_request(self, req: Request) -> None:
-        self._seq += 1
-        tag = self._seq
-        # GC by age: answered reads kept only for straggler repair, and
-        # ops wedged below quorum by crashed/partitioned peers would
-        # otherwise leak for the whole outage
-        if not tag % 256:
-            stale = [t for t in self.ops if t <= tag - 1024]
+    async def start(self) -> None:
+        await super().start()
+        self._tasks.append(asyncio.create_task(self._gc_watchdog()))
+
+    async def _gc_watchdog(self) -> None:
+        """Expire aged ops: answered reads are kept only for straggler
+        repair; ops wedged below quorum by crashed/partitioned peers get
+        the 'quorum timed out' error even if client traffic has stopped."""
+        while True:
+            await asyncio.sleep(self.gc_interval)
+            now = time.monotonic()
+            stale = [t for t, op in self.ops.items()
+                     if now - op.born > self.op_timeout]
             for t in stale:
                 op = self.ops.pop(t)
                 if not op.done:
                     op.request.reply(Reply(op.request.command,
                                            err="quorum timed out"))
+
+    # ---- coordinator ---------------------------------------------------
+    def handle_request(self, req: Request) -> None:
+        self._seq += 1
+        tag = self._seq
         key = req.command.key
         if req.command.is_read():
             op = _Op(req, key, True, Quorum(self.cfg.ids), reported={})

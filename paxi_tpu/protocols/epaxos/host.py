@@ -17,11 +17,15 @@ either blocking local execution as deps of committed instances, or
 carrying an unanswered client request — and runs Prepare at a higher
 ballot.  On a majority of PrepareReplies the recoverer finishes the
 instance: seen-committed => re-Commit; seen-accepted => Accept the
-highest-ballot attrs; seen-preaccepted => Accept the attrs reported by
-the most repliers (a surviving fast-path commit is always the
-plurality, since every majority intersects the fast quorum in
->= F+M-N replicas holding identical attrs); seen-nowhere => commit a
-NOOP to unblock the hole.
+highest-ballot attrs; seen-preaccepted => Accept the identical-attr
+group only when it has >= floor(N/2) members excluding the owner's own
+reply (the fast-quorum-intersection bound — any surviving fast-path
+commit leaves that many identical non-owner replies in every prepare
+majority); below that threshold the attrs may be missing interfering
+commands committed on a disjoint slow-path quorum, so recovery
+restarts phase 1 instead (re-PreAccept at the recovery ballot,
+recomputing the dep union over a live majority) before Accepting;
+seen-nowhere => commit a NOOP to unblock the hole.
 
 Liveness fallback (slow path): the command leader schedules an Accept
 round once a MAJORITY of PreAcceptReplies is in but the fast quorum
@@ -61,6 +65,8 @@ class PreAccept:
     deps: Dict[str, int]
     client_id: str = ""
     command_id: int = 0
+    ballot: int = 0       # >0 when a recoverer restarts phase 1
+    src: str = ""         # who runs the round (defaults to owner)
 
 
 @register_message
@@ -71,6 +77,7 @@ class PreAcceptReply:
     seq: int
     deps: Dict[str, int]
     id: str
+    ballot: int = 0       # echoes the round's ballot (0 = owner's)
 
 
 @register_message
@@ -163,10 +170,17 @@ class _Recovery:
 
     ballot: int
     replies: Dict[ID, PrepareReply] = field(default_factory=dict)
-    phase: int = 1             # 1 = prepare round, 2 = accept round
-    accept_acks: int = 0
+    phase: int = 1             # 1 = prepare, 3 = re-preaccept, 2 = accept
+    # distinct acker sets, so retransmit-induced duplicate replies can
+    # never fake a quorum (same rationale as Instance.accept_acked)
+    accept_acked: set = field(default_factory=set)
     decided: bool = False
     born: float = field(default_factory=time.monotonic)
+    # re-preaccept (restarted phase 1) attribute union
+    cmd: Optional[Command] = None
+    seq: int = 0
+    deps: Dict[ID, int] = field(default_factory=dict)
+    pre_acked: set = field(default_factory=set)
 
 
 class EPaxosReplica(Node):
@@ -176,6 +190,7 @@ class EPaxosReplica(Node):
         self.next_inst = 0
         # conflict map: key -> owner -> latest interfering instance
         self.conflicts: Dict[int, Dict[ID, int]] = {}
+        self.n = cfg.n
         self.fast = fast_quorum_size(cfg.n)
         self.maj = majority_size(cfg.n)
         self.fast_commits = 0
@@ -249,15 +264,24 @@ class EPaxosReplica(Node):
         for k, v in mdeps.items():
             deps[k] = max(deps.get(k, -1), v)
         prev = self.insts[owner].get(m.inst)
-        if prev is not None and prev.ballot > 0:
-            return                 # promised a recoverer; owner is stale
+        if prev is not None and m.ballot < prev.ballot:
+            return    # promised a higher-ballot recoverer; sender is stale
         if prev is None or prev.status < ACCEPTED:
-            self._record(owner, m.inst, Instance(cmd, seq, dict(deps)))
-        self.socket.send(owner, PreAcceptReply(
+            self._record(owner, m.inst,
+                         Instance(cmd, seq, dict(deps), ballot=m.ballot,
+                                  request=prev.request if prev else None))
+        self.socket.send(ID(m.src) if m.src else owner, PreAcceptReply(
             m.owner, m.inst, seq, {str(k): v for k, v in deps.items()},
-            str(self.id)))
+            str(self.id), m.ballot))
 
     def handle_preaccept_reply(self, m: PreAcceptReply) -> None:
+        if m.ballot > 0:
+            # reply to a recoverer's restarted phase 1 (see _repreaccept)
+            owner = ID(m.owner)
+            r = self.recoveries.get((owner, m.inst))
+            if r is not None and r.phase == 3 and m.ballot == r.ballot:
+                self._recovery_preaccept_ack(owner, m.inst, r, m)
+            return
         e = self.insts[self.id].get(m.inst)
         if e is None or e.status != PREACCEPTED or e.request is None:
             return
@@ -324,7 +348,7 @@ class EPaxosReplica(Node):
         owner = ID(m.owner)
         r = self.recoveries.get((owner, m.inst))
         if r is not None and r.phase == 2 and m.ballot == r.ballot:
-            self._recovery_accept_ack(owner, m.inst, r)
+            self._recovery_accept_ack(owner, m.inst, r, ID(m.id))
             return
         if owner != self.id:
             return
@@ -499,15 +523,29 @@ class EPaxosReplica(Node):
             p = max(accepted, key=lambda p: p.accepted_ballot)
             self._finish_recovery(owner, inst, r, p, commit=False)
         elif preaccepted:
-            # plurality attrs: a surviving fast-path commit implies
-            # >= F+M-N identical replies in any prepare majority, which
-            # is always the largest group; Accept (slow path) fixes them
+            # A surviving fast-path commit implies >= floor(N/2)
+            # identical replies from acceptors OTHER than the owner in
+            # any prepare majority (fast-quorum intersection).  Only
+            # that condition licenses jumping straight to Accept; a
+            # bare plurality — e.g. the owner's initial attrs echoed by
+            # one acceptor — says nothing about dependency completeness
+            # (an interfering command may have committed on a disjoint
+            # slow-path quorum that never saw this one).  Below the
+            # threshold, restart phase 1 at the recovery ballot to
+            # recompute the dep union from live conflict maps.
             groups: Dict[tuple, List[PrepareReply]] = {}
             for p in preaccepted:
                 sig = (p.seq, tuple(sorted(p.deps.items())), p.key, p.value)
                 groups.setdefault(sig, []).append(p)
-            best = max(groups.values(), key=len)
-            self._finish_recovery(owner, inst, r, best[0], commit=False)
+
+            def support(g: List[PrepareReply]) -> int:
+                return sum(1 for p in g if ID(p.id) != owner)
+
+            best = max(groups.values(), key=support)
+            if support(best) >= self.n // 2:
+                self._finish_recovery(owner, inst, r, best[0], commit=False)
+            else:
+                self._repreaccept(owner, inst, r, best[0])
         else:
             # nobody saw the command: commit a NOOP to unblock the hole
             noop = PrepareReply(str(owner), inst, r.ballot, NONE, 0,
@@ -531,18 +569,60 @@ class EPaxosReplica(Node):
             e.status = ACCEPTED
             self._record(owner, inst, e)
             r.phase = 2
-            r.accept_acks = 1
+            r.accept_acked = {self.id}
             self.socket.broadcast(Accept(
                 str(owner), inst, cmd.key, cmd.value, e.seq,
                 {str(k): v for k, v in e.deps.items()},
                 cmd.client_id, cmd.command_id, r.ballot, str(self.id)))
-            self._recovery_accept_ack(owner, inst, r, initial=True)
+            self._recovery_accept_ack(owner, inst, r, None)
+
+    def _repreaccept(self, owner: ID, inst: int, r: _Recovery,
+                     p: PrepareReply) -> None:
+        """Restarted phase 1 (epaxos explicit-prepare's TryPreAccept
+        analog): re-PreAccept the command at the recovery ballot,
+        recomputing seq/deps as the union over a majority of acceptors'
+        live conflict maps, then Accept — never the fast path."""
+        cmd = Command(p.key, p.value, p.client_id, p.command_id)
+        r.phase = 3
+        r.cmd = cmd
+        mseq, mdeps = self._attrs(cmd.key, (owner, inst))
+        r.seq = max(p.seq, mseq)
+        r.deps = {ID(k): v for k, v in p.deps.items()}
+        for k, v in mdeps.items():
+            r.deps[k] = max(r.deps.get(k, -1), v)
+        r.pre_acked = {self.id}
+        prev = self.insts[owner].get(inst)
+        self._record(owner, inst, Instance(
+            cmd, r.seq, dict(r.deps),
+            request=prev.request if prev else None, ballot=r.ballot))
+        self.socket.broadcast(PreAccept(
+            str(owner), inst, cmd.key, cmd.value, r.seq,
+            {str(k): v for k, v in r.deps.items()},
+            cmd.client_id, cmd.command_id, r.ballot, str(self.id)))
+        self._recovery_preaccept_ack(owner, inst, r, None)
+
+    def _recovery_preaccept_ack(self, owner: ID, inst: int, r: _Recovery,
+                                m: Optional[PreAcceptReply]) -> None:
+        if m is not None:
+            r.pre_acked.add(ID(m.id))
+            r.seq = max(r.seq, m.seq)
+            for k, v in m.deps.items():
+                kid = ID(k)
+                r.deps[kid] = max(r.deps.get(kid, -1), v)
+        if len(r.pre_acked) < self.maj:
+            return
+        merged = PrepareReply(
+            str(owner), inst, r.ballot, PREACCEPTED, r.ballot,
+            r.cmd.key, r.cmd.value, r.seq,
+            {str(k): v for k, v in r.deps.items()}, str(self.id),
+            r.cmd.client_id, r.cmd.command_id)
+        self._finish_recovery(owner, inst, r, merged, commit=False)
 
     def _recovery_accept_ack(self, owner: ID, inst: int, r: _Recovery,
-                             initial: bool = False) -> None:
-        if not initial:
-            r.accept_acks += 1
-        if r.accept_acks >= self.maj:
+                             acker: Optional[ID]) -> None:
+        if acker is not None:
+            r.accept_acked.add(acker)
+        if len(r.accept_acked) >= self.maj:
             e = self.insts[owner].get(inst)
             if e is None or e.status >= COMMITTED:
                 self.recoveries.pop((owner, inst), None)

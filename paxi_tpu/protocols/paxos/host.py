@@ -51,6 +51,10 @@ class P1b:
     # executed slots and diverge
     execute: int = 0
     snap: Dict[int, bytes] = field(default_factory=dict)
+    # at-most-once session table riding the snapshot: client_id ->
+    # [command_id, value] of its highest executed command, so a frontier
+    # jump can never re-execute a command whose slot was compacted away
+    ctab: Dict[str, list] = field(default_factory=dict)
 
 
 @register_message
@@ -106,8 +110,17 @@ class PaxosReplica(Node):
         self.execute = 0        # next slot to execute
         self.p1_quorum = Quorum(cfg.ids)
         self.p1b_logs: Dict[ID, Dict[int, list]] = {}
-        self.p1b_meta: Dict[ID, tuple] = {}   # id -> (execute, snapshot)
+        self.p1b_meta: Dict[ID, tuple] = {}   # id -> (execute, snap, ctab)
         self.pending: list = []  # requests queued while electing
+        # at-most-once filter (ADVICE r2 medium): client_id -> (highest
+        # executed command_id, its value).  Clients issue command_ids
+        # monotonically (host/client.py), so a re-proposal of an
+        # already-executed command — e.g. one re-pended across a P1b
+        # frontier jump whose true outcome was compacted away, or one
+        # both committed under an old ballot and forwarded to the new
+        # leader — is recognized and skipped deterministically at every
+        # replica instead of mutating the DB twice.
+        self.ctab: Dict[str, Tuple[int, bytes]] = {}
         self.register(Request, self.handle_request)
         self.register(P1a, self.handle_p1a)
         self.register(P1b, self.handle_p1b)
@@ -130,7 +143,7 @@ class PaxosReplica(Node):
         self.p1_quorum = Quorum(self.cfg.ids)
         self.p1_quorum.ack(self.id)
         self.p1b_logs = {self.id: self._log_payload()}
-        self.p1b_meta = {self.id: (self.execute, {})}  # own db is local
+        self.p1b_meta = {self.id: (self.execute, {}, {})}  # own db is local
         self.socket.broadcast(P1a(self.ballot, self.execute))
 
     def _log_payload(self) -> Dict[int, list]:
@@ -177,12 +190,13 @@ class PaxosReplica(Node):
             self.ballot = m.ballot
             self.active = False
             self._repend_inflight()
-        snap = (self.db.snapshot()
-                if self.execute > m.execute and m.ballot >= self.ballot
-                else {})   # stale candidates discard the P1b anyway
+        ahead = self.execute > m.execute and m.ballot >= self.ballot
+        snap = self.db.snapshot() if ahead else {}
+        ctab = ({c: [i, v] for c, (i, v) in self.ctab.items()}
+                if ahead else {})  # stale candidates discard the P1b anyway
         self.socket.send(ballot_id(m.ballot),
                          P1b(self.ballot, str(self.id), self._log_payload(),
-                             self.execute, snap))
+                             self.execute, snap, ctab))
 
     def _repend_inflight(self) -> None:
         """Losing leadership: uncommitted proposals carrying client
@@ -201,7 +215,7 @@ class PaxosReplica(Node):
             return
         self.p1_quorum.ack(ID(m.id))
         self.p1b_logs[ID(m.id)] = m.log
-        self.p1b_meta[ID(m.id)] = (m.execute, m.snap)
+        self.p1b_meta[ID(m.id)] = (m.execute, m.snap, m.ctab)
         if self.p1_quorum.majority() and ballot_id(self.ballot) == self.id:
             self._become_leader()
 
@@ -213,9 +227,15 @@ class PaxosReplica(Node):
         # state transfer first: an acker ahead of our execute frontier
         # has executed (hence committed) everything below it; adopt its
         # snapshot + frontier so the merge never NOOPs an executed slot
-        front, snap = max(self.p1b_meta.values(),
-                          key=lambda fs: fs[0], default=(0, {}))
+        front, snap, ctab = max(self.p1b_meta.values(),
+                                key=lambda fs: fs[0], default=(0, {}, {}))
         if front > self.execute:
+            # adopt the acker's session table first: re-pended requests
+            # whose command already executed in a compacted slot must be
+            # filtered by _exec, not applied a second time
+            for c, (i, v) in ctab.items():
+                if c not in self.ctab or self.ctab[c][0] < int(i):
+                    self.ctab[c] = (int(i), v)
             # entries the jump skips: uncommitted ones with requests go
             # back to pending (re-proposed in fresh slots); committed
             # ones were decided — acks for writes, the snapshot value
@@ -328,13 +348,23 @@ class PaxosReplica(Node):
         self._drain_pending()
 
     def _exec(self) -> None:
-        """paxos.go exec(): apply the committed prefix in slot order."""
+        """paxos.go exec(): apply the committed prefix in slot order,
+        with per-client at-most-once filtering (see self.ctab)."""
         while True:
             e = self.log.get(self.execute)
             if e is None or not e.commit:
                 break
             if e.command.key >= 0:  # skip NOOP
-                value = self.db.execute(e.command)
+                cmd = e.command
+                last = self.ctab.get(cmd.client_id) if cmd.client_id else None
+                if last is not None and cmd.command_id <= last[0]:
+                    # duplicate of an already-executed command: reply
+                    # with the recorded outcome, never re-apply
+                    value = last[1] if cmd.command_id == last[0] else b""
+                else:
+                    value = self.db.execute(cmd)
+                    if cmd.client_id:
+                        self.ctab[cmd.client_id] = (cmd.command_id, value)
                 if e.request is not None:
                     e.request.reply(Reply(e.command, value=value))
                     e.request = None

@@ -35,6 +35,10 @@ NOOP = Command(key=-1, value=b"\x00noop")
 class WP1a:
     key: int
     ballot: int
+    # stealer's execute frontier for the key: ackers ship their session
+    # table only when ahead of it, so steady-state steals (equal
+    # frontiers) pay no per-client wire cost
+    execute: int = 0
 
 
 @register_message
@@ -49,6 +53,9 @@ class WP1b:
     # the key, standing in for the executed prefix the log omits
     execute: int = 0
     snap: bytes = b""
+    # at-most-once session table for this key (ADVICE r2 medium):
+    # client_id -> [command_id, value] of its highest executed command
+    ctab: Dict[str, list] = field(default_factory=dict)
 
 
 @register_message
@@ -102,8 +109,16 @@ class KeyObject:
         self.execute = 0
         self.p1_quorum: Optional[Quorum] = None
         self.p1b_logs: Dict[ID, Dict[int, list]] = {}
-        self.p1b_meta: Dict[ID, tuple] = {}   # id -> (execute, snap)
+        self.p1b_meta: Dict[ID, tuple] = {}   # id -> (execute, snap, ctab)
         self.pending: list = []
+        # per-key at-most-once filter: a steal's frontier jump re-pends
+        # uncommitted entries whose true outcome was compacted away; if
+        # the old quorum in fact executed them, _exec must skip the
+        # re-proposal instead of re-applying an old write over newer
+        # ones.  client_id -> (highest executed command_id, value);
+        # command_ids are client-monotonic, so per-key subsequences are
+        # monotonic too.
+        self.ctab: Dict[str, tuple] = {}
 
 
 class WPaxosReplica(Node):
@@ -174,9 +189,9 @@ class WPaxosReplica(Node):
         o.p1_quorum = Quorum(self.cfg.ids)
         o.p1_quorum.ack(self.id)
         o.p1b_logs = {self.id: self._log_payload(o)}
-        o.p1b_meta = {self.id: (o.execute, self.db.get(k) or b"")}
+        o.p1b_meta = {self.id: (o.execute, self.db.get(k) or b"", {})}
         self.steals += 1
-        self.socket.broadcast(WP1a(k, o.ballot))
+        self.socket.broadcast(WP1a(k, o.ballot, o.execute))
         self._maybe_win(k, o)
 
     def _log_payload(self, o: KeyObject) -> Dict[int, list]:
@@ -196,10 +211,12 @@ class WPaxosReplica(Node):
             o.ballot = m.ballot
             o.active = False
             self._repend(o)
+        ctab = ({c: [i, v] for c, (i, v) in o.ctab.items()}
+                if o.execute > m.execute else {})  # receiver drops it else
         self.socket.send(ballot_id(m.ballot),
                          WP1b(m.key, o.ballot, str(self.id),
                               self._log_payload(o), o.execute,
-                              self.db.get(m.key) or b""))
+                              self.db.get(m.key) or b"", ctab))
 
     def _repend(self, o: KeyObject) -> None:
         for e in o.log.values():
@@ -219,7 +236,7 @@ class WPaxosReplica(Node):
             return
         o.p1_quorum.ack(ID(m.id))
         o.p1b_logs[ID(m.id)] = m.log
-        o.p1b_meta[ID(m.id)] = (m.execute, m.snap)
+        o.p1b_meta[ID(m.id)] = (m.execute, m.snap, m.ctab)
         self._maybe_win(m.key, o)
 
     def _maybe_win(self, k: int, o: KeyObject) -> None:
@@ -232,8 +249,15 @@ class WPaxosReplica(Node):
         # has executed (hence committed) everything below its frontier —
         # adopt its KV value and jump our frontier there, so the merge
         # below never NOOP-fills an executed slot
-        front, snap = max(o.p1b_meta.values(), default=(0, b""))
+        front, snap, ctab = max(o.p1b_meta.values(),
+                                key=lambda t: t[0], default=(0, b"", {}))
         if front > o.execute:
+            # adopt the acker's session table before re-pending, so a
+            # skipped command the old quorum already executed is
+            # filtered by _exec rather than applied a second time
+            for c, (i, v) in ctab.items():
+                if c not in o.ctab or o.ctab[c][0] < int(i):
+                    o.ctab[c] = (int(i), v)
             # same request handling as paxos host's frontier jump:
             # re-pend skipped uncommitted entries; committed ones get
             # acks for writes, the snapshot value for reads
@@ -358,7 +382,16 @@ class WPaxosReplica(Node):
             if e is None or not e.commit:
                 break
             if e.command.key >= 0:
-                value = self.db.execute(e.command)
+                cmd = e.command
+                last = o.ctab.get(cmd.client_id) if cmd.client_id else None
+                if last is not None and cmd.command_id <= last[0]:
+                    # at-most-once: already executed (possibly in a
+                    # compacted slot under a previous owner)
+                    value = last[1] if cmd.command_id == last[0] else b""
+                else:
+                    value = self.db.execute(cmd)
+                    if cmd.client_id:
+                        o.ctab[cmd.client_id] = (cmd.command_id, value)
                 if e.request is not None:
                     e.request.reply(Reply(e.command, value=value))
                     e.request = None

@@ -77,6 +77,29 @@ def test_read_repair_heals_stale_replica():
     run(main())
 
 
+def test_wedged_op_times_out_without_further_traffic():
+    """ADVICE r2 (low): an op wedged below quorum by a partition must
+    get its 'quorum timed out' reply from the timer-driven GC even when
+    no further client requests ever arrive to piggyback the sweep on."""
+    async def main():
+        c = Cluster("dynamo", n=3, http=False)
+        await c.start()
+        try:
+            coord = c["1.1"]
+            coord.op_timeout = 0.3
+            coord.gc_interval = 0.05
+            coord.socket.crash(30.0)     # no replication can reach peers
+            fut = asyncio.get_running_loop().create_future()
+            coord.handle_client_request(Request(
+                command=Command(5, b"wedged", "c1", 1), reply_to=fut))
+            rep: Reply = await asyncio.wait_for(fut, 3.0)
+            assert rep.err == "quorum timed out"
+            assert not coord.ops          # swept, no leak
+        finally:
+            await c.stop()
+    run(main())
+
+
 def test_late_read_reply_triggers_repair():
     """Force the post-quorum ordering: the stale replica's RReadReply
     arrives AFTER the coordinator already answered the client.  The

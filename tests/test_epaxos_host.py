@@ -170,6 +170,54 @@ def test_recovery_leader_crash_mid_preaccept():
     run(main())
 
 
+def test_recovery_single_preaccept_reply_repreaccepts_deps():
+    """ADVICE r2 (high): N=5 — owner A preaccepts gamma seen only by B;
+    interfering delta slow-commits on the disjoint quorum {C,D,E}.  B's
+    recovery prepare-majority holds a single PREACCEPTED reply (its
+    own, missing the delta dep).  Recovery must NOT Accept those attrs
+    (1 non-owner reply < floor(N/2)=2); it must restart phase 1, pick
+    up delta from C/D's live conflict maps, and commit gamma WITH the
+    delta dep — so every live replica converges on gamma-last."""
+    async def main():
+        c = Cluster("epaxos", n=5, http=False)
+        await c.start()
+        try:
+            A, B, C_, D, E = c.ids
+            _fast_timers(c, recovery=0.6, interval=0.05)
+            # gamma: A -> B only, then A goes dark (stalled, uncommitted)
+            for dst in ("1.3", "1.4", "1.5"):
+                c["1.1"].socket.drop(dst, 30.0)
+            c["1.1"].handle_client_request(Request(
+                command=Command(7, b"gamma", "cg", 1),
+                reply_to=asyncio.get_running_loop().create_future()))
+            await asyncio.sleep(0.05)          # PreAccept reaches B
+            c["1.1"].socket.crash(30.0)
+            # delta: C -> {D,E} only; fast quorum (4) can't form, the
+            # majority fallback slow-commits on {C,D,E}
+            c["1.3"].socket.drop("1.1", 30.0)
+            c["1.3"].socket.drop("1.2", 30.0)
+            assert await do(c["1.3"], 7, b"delta", cid="cd",
+                            cmd_id=1, timeout=3.0) == b""
+            # B's watchdog now recovers gamma; re-preaccept must import
+            # the delta dep from C/D's conflict maps
+            deadline = asyncio.get_running_loop().time() + 6.0
+            live = ("1.2", "1.3", "1.4", "1.5")
+            while asyncio.get_running_loop().time() < deadline:
+                if all(c[i].db.get(7) == b"gamma" for i in live):
+                    break
+                await asyncio.sleep(0.05)
+            for i in live:
+                assert c[i].db.get(7) == b"gamma", (i, c[i].db.get(7))
+            # the recovered gamma instance carries the delta dep
+            for i in ("1.3", "1.4"):
+                e = c[i].insts[A][0]
+                assert e.status >= 3, (i, e.status)
+                assert e.deps.get(C_) == 0, (i, e.deps)
+        finally:
+            await c.stop()
+    run(main())
+
+
 def test_recovery_preserves_fast_committed_value():
     """Leader fast-commits locally but its Commit broadcast is lost,
     then it crashes: recovery must finish with the SAME command (the
