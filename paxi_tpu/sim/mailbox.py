@@ -79,7 +79,8 @@ def fault_state_refresh(fs, rng, t, fuzz: FuzzConfig, n: int):
     and receives are suppressed (socket.go Crash — the node keeps its
     state, matching the reference where Crash only stops the transport).
     """
-    if not (fuzz.p_partition > 0 or fuzz.p_crash > 0):
+    if not (fuzz.p_partition > 0 or fuzz.p_crash > 0
+            or fuzz.perm_crash >= 0):
         return fs
     k1, k2, k3 = jr.split(rng, 3)
     side = jr.bernoulli(k1, 0.5, (n,))
@@ -88,10 +89,16 @@ def fault_state_refresh(fs, rng, t, fuzz: FuzzConfig, n: int):
                      jnp.ones((n, n), bool))
     crashed = jr.bernoulli(k3, fuzz.p_crash, (n,))
     fresh = (t % fuzz.window) == 0
-    return {
+    new = {
         "conn": jnp.where(fresh, conn, fs["conn"]),
         "crashed": jnp.where(fresh, crashed, fs["crashed"]),
     }
+    if fuzz.perm_crash >= 0:
+        # held, never resampled: a permanently dead replica stays dead
+        forced = ((jnp.arange(n) == fuzz.perm_crash)
+                  & (t >= fuzz.perm_crash_at))
+        new["crashed"] = new["crashed"] | forced
+    return new
 
 
 def wheel_insert(wheel: Mailboxes, outbox: Mailboxes, fs, rng,

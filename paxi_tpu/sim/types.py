@@ -78,6 +78,12 @@ class FuzzConfig:
     p_crash: float = 0.0       # per-replica comms-crash prob per window
     p_partition: float = 0.0   # prob a window has a random bipartition
     window: int = 16           # steps between fault-schedule resamples
+    # permanent failure (never heals, unlike the resampled p_crash
+    # windows): replica ``perm_crash`` goes comms-dead at step
+    # ``perm_crash_at`` and stays dead — the schedule that forces
+    # protocols to exercise real recovery/takeover, not just retries
+    perm_crash: int = -1
+    perm_crash_at: int = 0
 
     @property
     def wheel(self) -> int:
@@ -86,7 +92,8 @@ class FuzzConfig:
     @property
     def faulty(self) -> bool:
         return (self.p_drop > 0 or self.p_dup > 0 or self.p_crash > 0
-                or self.p_partition > 0 or self.max_delay > 1)
+                or self.p_partition > 0 or self.max_delay > 1
+                or self.perm_crash >= 0)
 
 
 FAULT_FREE = FuzzConfig()
