@@ -24,7 +24,7 @@ import jax.random as jr
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from paxi_tpu.sim.runner import init_carry, make_scan_body
+from paxi_tpu.sim.runner import _finish, init_carry, make_scan_body
 from paxi_tpu.sim.types import FAULT_FREE, FuzzConfig, SimConfig, SimProtocol
 
 
@@ -67,11 +67,11 @@ def make_sharded_run(proto: SimProtocol, cfg: SimConfig,
                 return jax.lax.pcast(x, (axis,), to="varying")
             carry = jax.tree.map(_vary, carry)
             carry, viols = jax.lax.scan(body, carry, jnp.arange(n_steps))
-            state = carry[0]
-            per_group = jax.vmap(lambda s: proto.metrics(s, cfg))(state)
-            metrics = {k: jax.lax.psum(jnp.sum(v), axis)
-                       for k, v in per_group.items()}
-            viol = jax.lax.psum(jnp.sum(viols), axis)
+            # the shared aggregation tail (group-major public state for
+            # either layout), then reduce across shards
+            state, metrics, viol = _finish(proto, cfg, carry, viols)
+            metrics = {k: jax.lax.psum(v, axis) for k, v in metrics.items()}
+            viol = jax.lax.psum(viol, axis)
             return state, metrics, viol
 
         return sharded(jr.split(rng, n_dev))

@@ -3,7 +3,8 @@
 The reference has no checkpointing (SURVEY §5: in-memory store, no
 snapshots — a conscious gap).  The TPU sim runtime makes it trivial:
 the entire simulation state is one pytree carry (protocol state, the
-in-flight message wheel, fault masks, per-group PRNG keys), so a
+in-flight message wheel, fault masks, and the PRNG key(s) — one run key
+for lane-major kernels, per-group keys for vmapped ones), so a
 checkpoint is an exact bit-for-bit resume point — ``run(60 steps)``
 equals ``run(30); save; load; run(30)``.
 

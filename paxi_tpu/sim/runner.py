@@ -1,10 +1,17 @@
-"""The sim driver: vmap over groups, lax.scan over steps, jit the whole run.
+"""The sim driver: lax.scan over steps, jit the whole run.
 
 This lifts the reference's per-replica message loop (node.go Node.Run ->
 handler dispatch -> Quorum.ACK [driver]) into a single fused kernel over an
 (instance x replica) batch: every step, every group delivers its in-flight
 messages, applies the protocol's pure transition, refreshes its fault
-schedule, and checks safety invariants.  Group axis first on every array.
+schedule, and checks safety invariants.
+
+Two kernel layouts (see sim/lanes.py): lane-major protocols
+(``proto.batched``) carry the group axis as the LAST dimension of every
+array and run the whole batch natively with one PRNG key; legacy
+per-group kernels are vmapped over a leading group axis with per-group
+keys.  The public ``SimResult.state`` is group-major (G leading) either
+way.
 """
 
 from __future__ import annotations
@@ -17,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
+from paxi_tpu.sim import lanes
 from paxi_tpu.sim import mailbox as mb
 from paxi_tpu.sim.types import (FAULT_FREE, FuzzConfig, SimConfig,
                                 SimProtocol, StepCtx)
@@ -35,6 +43,12 @@ def init_carry(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
                n_groups: int, rng: jax.Array):
     spec = proto.mailbox_spec(cfg)
     k_state, k_run = jr.split(rng)
+    if proto.batched:
+        # lane-major: state (.., G), wheel (d, src, dst, G), one run key
+        state = proto.init_state(cfg, k_state, n_groups)
+        wheel = lanes.empty_wheel(spec, cfg.n_replicas, n_groups, fuzz)
+        fs = lanes.fault_state_init(cfg.n_replicas, n_groups)
+        return (state, wheel, fs, k_run)
     state = jax.vmap(lambda k: proto.init_state(cfg, k))(
         jr.split(k_state, n_groups))
     wheel = jax.tree.map(
@@ -49,23 +63,34 @@ def init_carry(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
 
 def _group_step(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
                 carry_g, t):
-    """One lock-step round for a single group (vmapped by the caller)."""
+    """One lock-step round: deliver -> step -> refresh faults -> insert
+    -> check invariants.  ONE implementation for both layouts — only the
+    exchange module differs (lane-major vs per-group planes); the caller
+    vmaps this over a leading group axis for non-batched protocols."""
+    ops = lanes if proto.batched else mb
     state, wheel, fs, rng = carry_g
     rng, k_step, k_fault, k_ins = jr.split(rng, 4)
-    inbox, wheel = mb.wheel_deliver(wheel)
+    inbox, wheel = ops.wheel_deliver(wheel)
     new_state, outbox = proto.step(state, inbox, StepCtx(k_step, t, cfg))
-    fs = mb.fault_state_refresh(fs, k_fault, t, fuzz, cfg.n_replicas)
-    wheel = mb.wheel_insert(wheel, outbox, fs, k_ins, fuzz)
+    fs = ops.fault_state_refresh(fs, k_fault, t, fuzz, cfg.n_replicas)
+    wheel = ops.wheel_insert(wheel, outbox, fs, k_ins, fuzz)
     viol = proto.invariants(state, new_state, cfg)
     return (new_state, wheel, fs, rng), viol
 
 
 def make_scan_body(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig):
-    """The vmapped per-step transition shared by make_run, the sharded
-    runner (parallel/mesh.py) and the driver entry point."""
+    """The per-step transition shared by make_run, the sharded runner
+    (parallel/mesh.py) and the driver entry point.  Lane-major kernels
+    (proto.batched) run the whole batch natively; per-group kernels are
+    vmapped over a leading group axis."""
+    step1 = functools.partial(_group_step, proto, cfg, fuzz)
+    if proto.batched:
+        def body(carry, t):
+            return step1(carry, t)
+
+        return body
 
     def body(carry, t):
-        step1 = functools.partial(_group_step, proto, cfg, fuzz)
         carry, viol = jax.vmap(step1, in_axes=(0, None))(carry, t)
         return carry, jnp.sum(viol)
 
@@ -75,8 +100,15 @@ def make_scan_body(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig):
 def _finish(proto: SimProtocol, cfg: SimConfig, carry, viols):
     """Shared aggregation tail: per-group metrics summed over groups.
     One implementation for both the straight and the resumed path, so
-    checkpointed runs can never diverge from uninterrupted ones."""
+    checkpointed runs can never diverge from uninterrupted ones.
+    Lane-major kernels aggregate internally; their final state is
+    transposed back to the public group-major layout (one cheap
+    transpose per run, outside the hot loop)."""
     state = carry[0]
+    if proto.batched:
+        metrics = proto.metrics(state, cfg)
+        state = jax.tree.map(lambda x: jnp.moveaxis(x, -1, 0), state)
+        return state, metrics, jnp.sum(viols)
     per_group = jax.vmap(lambda s: proto.metrics(s, cfg))(state)
     metrics = {k: jnp.sum(v) for k, v in per_group.items()}
     return state, metrics, jnp.sum(viols)

@@ -109,11 +109,26 @@ class StepCtx(NamedTuple):
 
 @dataclass(frozen=True)
 class SimProtocol:
-    """A protocol plugin for the TPU sim runtime (see module docstring)."""
+    """A protocol plugin for the TPU sim runtime (see module docstring).
+
+    Two kernel layouts are supported (see sim/lanes.py for why):
+
+    - ``batched=False`` (legacy): per-group functions — ``init_state``
+      builds one group's state, ``step`` sees (R, ...) state and
+      (src, dst) mailbox planes; the runner vmaps over a leading group
+      axis.  Group-major arrays starve the TPU vector lanes.
+    - ``batched=True`` (lane-major): the kernel IS the batch — state
+      arrays carry the group axis as their **last** dimension
+      ((R, G), (R, S, G), ...), mailbox planes are (src, dst, G),
+      ``init_state(cfg, rng, n_groups)`` takes the group count,
+      ``metrics``/``invariants`` return already-aggregated scalars.
+      This is the layout that actually feeds the 8x128 vector unit.
+    """
 
     name: str
     mailbox_spec: Callable[[SimConfig], Dict[str, Tuple[str, ...]]]
-    init_state: Callable[[SimConfig, Array], State]
+    init_state: Callable[..., State]
     step: Callable[[State, Mailboxes, StepCtx], Tuple[State, Mailboxes]]
     metrics: Callable[[State, SimConfig], Dict[str, Array]]
     invariants: Callable[[State, State, SimConfig], Array]
+    batched: bool = False
