@@ -72,7 +72,10 @@ def worker() -> int:
     # memory is O(G*R*64), not O(G*R*steps).
     n_slots = int(os.environ.get("BENCH_RING", 64))
 
-    proto = sim_protocol("paxos")
+    # layout by backend: lane-major (G-last) feeds the TPU vector lanes;
+    # the per-group kernel vmapped over a leading G axis is ~6x faster
+    # on XLA:CPU (VERDICT r4 weak #1)
+    proto = sim_protocol("paxos_pg" if on_cpu else "paxos")
     cfg = SimConfig(n_replicas=n_replicas, n_slots=n_slots)
     run = make_run(proto, cfg)
 

@@ -15,6 +15,7 @@ from paxi_tpu.sim.types import SimProtocol
 
 _SIM_MODULES = {
     "paxos": "paxi_tpu.protocols.paxos.sim",
+    "paxos_pg": "paxi_tpu.protocols.paxos.sim_pg",
     "abd": "paxi_tpu.protocols.abd.sim",
     "chain": "paxi_tpu.protocols.chain.sim",
     "wpaxos": "paxi_tpu.protocols.wpaxos.sim",

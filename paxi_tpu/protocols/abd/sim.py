@@ -7,12 +7,17 @@ queries a majority for the current timestamp and writes ts+1 (writer id
 as tiebreak) to a majority [driver: "crash-only linearizable register"].
 Two ``paxi.Quorum`` rounds per op (abd/abd.go Get/Set phases).
 
-TPU re-design:
+TPU re-design (lane-major layout — see sim/lanes.py):
+- The kernel operates on the whole group batch with the group axis LAST
+  (state ``(R, G)`` / ``(R, K, G)``, mailbox planes ``(src, dst, G)``)
+  so the group axis feeds the 8x128 vector lanes.
 - Every replica is also a closed-loop client issuing alternating
   read/write ops on hashed keys (benchmark.go's generator collapsed into
   the kernel, as in the paxos kernel).
 - Per-op state machine is fully masked: ``phase`` in {0 idle, 1 query
-  round, 2 store round}; quorum = popcount over an ack row.
+  round, 2 store round}; ``Quorum.ACK`` is a bit-packed int32 ack mask
+  per replica with ``lax.population_count`` for ``Majority()``
+  (quorum.go [driver]).
 - Timestamps encode the writer: ``ts = round * stride + writer`` (the
   (n, id) lexicographic pair of the paper packed into one int32).
 - Values are a deterministic function of ts, so "register holds
@@ -57,28 +62,32 @@ def op_key_for(ridx, seq, n_keys):
     return fib_key(seq * jnp.int32(31) + ridx, n_keys)
 
 
-def init_state(cfg: SimConfig, rng: jax.Array):
-    R, K = cfg.n_replicas, cfg.n_keys
+def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
+    R, K, G = cfg.n_replicas, cfg.n_keys, n_groups
     del rng
+    if R > 31:
+        raise ValueError(f"n_replicas={R} > 31: packed int32 ack masks "
+                         "support at most 31 replicas per group")
+    i32 = jnp.int32
     return dict(
-        store_ts=jnp.zeros((R, K), jnp.int32),
-        store_val=jnp.zeros((R, K), jnp.int32),
-        phase=jnp.zeros((R,), jnp.int32),
-        op_read=jnp.zeros((R,), bool),
-        op_key=jnp.zeros((R,), jnp.int32),
-        op_tag=jnp.zeros((R,), jnp.int32),
-        op_ts=jnp.zeros((R,), jnp.int32),
-        op_val=jnp.zeros((R,), jnp.int32),
-        op_snap=jnp.zeros((R,), jnp.int32),   # oracle snapshot at op start
-        op_age=jnp.zeros((R,), jnp.int32),    # steps in current phase (retry)
-        acks=jnp.zeros((R, R), bool),
-        best_ts=jnp.zeros((R,), jnp.int32),
-        best_val=jnp.zeros((R,), jnp.int32),
-        seq=jnp.zeros((R,), jnp.int32),       # per-replica op counter
-        reads_done=jnp.zeros((R,), jnp.int32),
-        writes_done=jnp.zeros((R,), jnp.int32),
-        done_max_ts=jnp.zeros((K,), jnp.int32),  # oracle: max completed ts/key
-        atomic_viol=jnp.zeros((), jnp.int32),
+        store_ts=jnp.zeros((R, K, G), i32),
+        store_val=jnp.zeros((R, K, G), i32),
+        phase=jnp.zeros((R, G), i32),
+        op_read=jnp.zeros((R, G), bool),
+        op_key=jnp.zeros((R, G), i32),
+        op_tag=jnp.zeros((R, G), i32),
+        op_ts=jnp.zeros((R, G), i32),
+        op_val=jnp.zeros((R, G), i32),
+        op_snap=jnp.zeros((R, G), i32),    # oracle snapshot at op start
+        op_age=jnp.zeros((R, G), i32),     # steps in current phase (retry)
+        acks=jnp.zeros((R, G), i32),       # bit-packed ack mask
+        best_ts=jnp.zeros((R, G), i32),
+        best_val=jnp.zeros((R, G), i32),
+        seq=jnp.zeros((R, G), i32),        # per-replica op counter
+        reads_done=jnp.zeros((R, G), i32),
+        writes_done=jnp.zeros((R, G), i32),
+        done_max_ts=jnp.zeros((K, G), i32),  # oracle: max completed ts/key
+        atomic_viol=jnp.zeros((G,), i32),
     )
 
 
@@ -88,59 +97,81 @@ def step(state, inbox, ctx: StepCtx):
     MAJ, STRIDE = cfg.majority, cfg.ballot_stride
     ridx = jnp.arange(R, dtype=jnp.int32)
     kidx = jnp.arange(K, dtype=jnp.int32)
+    self_bit = (jnp.int32(1) << ridx)[:, None]        # (R, 1) for (R, G)
+    src_bit = (jnp.int32(1) << ridx)[:, None, None]   # (src, 1, 1)
+
+    def T(x):  # mailbox (src, dst, G) -> (me=dst, src, G)
+        return jnp.swapaxes(x, 0, 1)
+
+    def key_read(plane, key):
+        """out[r, g] = plane[r, key[r, g], g] as a one-hot masked max."""
+        oh = kidx[None, :, None] == key[:, None, :]   # (R, K, G)
+        return jnp.sum(jnp.where(oh, plane, 0), axis=1)
 
     store_ts, store_val = state["store_ts"], state["store_val"]
     phase = state["phase"]
     acks = state["acks"]
     best_ts, best_val = state["best_ts"], state["best_val"]
+    G = phase.shape[-1]
 
     # ------------- serve "query": reply with local (ts, val) -------------
     m = inbox["query"]
-    qv = m["valid"].T                       # (dst_me, src)
-    qkey = jnp.clip(m["key"].T, 0, K - 1)
+    qv = T(m["valid"])                      # (me, src, G)
+    qkey = jnp.clip(T(m["key"]), 0, K - 1)
+    qoh = kidx[None, None, :, None] == qkey[:, :, None, :]   # (me,src,K,G)
     out_query_r = {
         "valid": qv,
-        "tag": m["tag"].T,
-        "ts": jnp.take_along_axis(store_ts, qkey, axis=1),
-        "val": jnp.take_along_axis(store_val, qkey, axis=1),
+        "tag": T(m["tag"]),
+        "ts": jnp.sum(jnp.where(qoh, store_ts[:, None], 0), axis=2),
+        "val": jnp.sum(jnp.where(qoh, store_val[:, None], 0), axis=2),
     }
 
     # ------------- serve "store": apply max-ts write per key, ack --------
     m = inbox["store"]
-    sv = m["valid"].T                       # (me, src)
-    skey, sts, sval = m["key"].T, m["ts"].T, m["val"].T
-    hit = sv[:, :, None] & (kidx[None, None, :] == skey[:, :, None])  # (me,src,K)
-    cand_ts = jnp.max(jnp.where(hit, sts[:, :, None], -1), axis=1)    # (me,K)
-    cand_src = jnp.argmax(jnp.where(hit, sts[:, :, None], -1), axis=1)
-    cand_val = sval[ridx[:, None], cand_src]
+    sv = T(m["valid"])                      # (me, src, G)
+    skey, sts, sval = T(m["key"]), T(m["ts"]), T(m["val"])
+    hit = sv[:, :, None] & (kidx[None, None, :, None]
+                            == skey[:, :, None, :])          # (me,src,K,G)
+    sts_h = jnp.where(hit, sts[:, :, None, :], -1)
+    cand_ts = jnp.max(sts_h, axis=1)                         # (me, K, G)
+    # the max-ts sender's value, unrolled over the tiny src axis
+    cand_val = jnp.zeros_like(cand_ts)
+    for s in range(R):
+        cand_val = jnp.where(sts_h[:, s] == cand_ts,
+                             sval[:, s, None, :], cand_val)
     newer = cand_ts > store_ts
     store_ts = jnp.where(newer, cand_ts, store_ts)
     store_val = jnp.where(newer, cand_val, store_val)
-    out_store_r = {"valid": sv, "tag": m["tag"].T}
+    out_store_r = {"valid": sv, "tag": T(m["tag"])}
 
     # ------------- collect replies for my in-flight op -------------------
     m = inbox["query_r"]
-    ok = (m["valid"].T & (m["tag"].T == state["op_tag"][:, None])
-          & (phase == QUERY)[:, None])
-    r_ts = jnp.where(ok, m["ts"].T, -1)
-    in_best = jnp.max(r_ts, axis=1)
-    in_src = jnp.argmax(r_ts, axis=1)
-    in_val = m["val"].T[ridx, in_src]
+    ok = (T(m["valid"]) & (T(m["tag"]) == state["op_tag"][:, None, :])
+          & (phase == QUERY)[:, None, :])                    # (me, src, G)
+    r_ts = jnp.where(ok, T(m["ts"]), -1)
+    in_best = jnp.max(r_ts, axis=1)                          # (me, G)
+    in_val = jnp.zeros_like(in_best)
+    rv = T(m["val"])
+    for s in range(R):
+        in_val = jnp.where((r_ts[:, s] == in_best) & (in_best >= 0),
+                           rv[:, s], in_val)
     better = in_best > best_ts
     best_val = jnp.where(better, in_val, best_val)
     best_ts = jnp.maximum(best_ts, in_best)
-    acks = acks | ok
+    acks = acks | jnp.sum(jnp.where(jnp.swapaxes(ok, 0, 1), src_bit, 0),
+                          axis=0)
 
     m = inbox["store_r"]
-    ok2 = (m["valid"].T & (m["tag"].T == state["op_tag"][:, None])
-           & (phase == STORE)[:, None])
-    acks = acks | ok2
+    ok2 = (T(m["valid"]) & (T(m["tag"]) == state["op_tag"][:, None, :])
+           & (phase == STORE)[:, None, :])
+    acks = acks | jnp.sum(jnp.where(jnp.swapaxes(ok2, 0, 1), src_bit, 0),
+                          axis=0)
 
-    n_acks = jnp.sum(acks, axis=1)
+    n_acks = jax.lax.population_count(acks)
 
     # ------------- phase 1 -> 2: choose (ts, val), broadcast store -------
     q_done = (phase == QUERY) & (n_acks >= MAJ)
-    w_ts = (best_ts // STRIDE + 1) * STRIDE + ridx   # write: bump round
+    w_ts = (best_ts // STRIDE + 1) * STRIDE + ridx[:, None]  # write: bump
     op_ts = jnp.where(q_done,
                       jnp.where(state["op_read"], best_ts, w_ts),
                       state["op_ts"])
@@ -149,46 +180,49 @@ def step(state, inbox, ctx: StepCtx):
                                  encode_val(w_ts)),
                        state["op_val"])
     # write-back / write applies to own store immediately (self-ack)
-    oh = q_done[:, None] & (kidx[None, :] == state["op_key"][:, None])
-    upd = oh & (op_ts[:, None] > store_ts)
-    store_ts = jnp.where(upd, op_ts[:, None], store_ts)
-    store_val = jnp.where(upd, op_val[:, None], store_val)
+    oh = q_done[:, None, :] & (kidx[None, :, None]
+                               == state["op_key"][:, None, :])
+    upd = oh & (op_ts[:, None, :] > store_ts)
+    store_ts = jnp.where(upd, op_ts[:, None, :], store_ts)
+    store_val = jnp.where(upd, op_val[:, None, :], store_val)
     phase = jnp.where(q_done, STORE, phase)
-    acks = jnp.where(q_done[:, None], ridx[None, :] == ridx[:, None], acks)
-    n_acks = jnp.sum(acks, axis=1)
+    acks = jnp.where(q_done, self_bit, acks)
+    n_acks = jax.lax.population_count(acks)
 
     # ------------- phase 2 done: op completes, oracle check --------------
     s_done = (phase == STORE) & (n_acks >= MAJ) & ~q_done
     # atomicity: completing op must not carry ts older than any op that
     # completed before it started
-    viol = jnp.sum(s_done & (op_ts < state["op_snap"]))
+    viol = jnp.sum(s_done & (op_ts < state["op_snap"]), axis=0)   # (G,)
     atomic_viol = state["atomic_viol"] + viol
     reads_done = state["reads_done"] + (s_done & state["op_read"])
     writes_done = state["writes_done"] + (s_done & ~state["op_read"])
-    dhit = s_done[:, None] & (kidx[None, :] == state["op_key"][:, None])
+    dhit = s_done[:, None, :] & (kidx[None, :, None]
+                                 == state["op_key"][:, None, :])
     done_max_ts = jnp.maximum(
         state["done_max_ts"],
-        jnp.max(jnp.where(dhit, op_ts[:, None], -1), axis=0))
+        jnp.max(jnp.where(dhit, op_ts[:, None, :], -1), axis=0))
     phase = jnp.where(s_done, IDLE, phase)
 
     # ------------- idle: start next op (alternate write/read) ------------
     start = phase == IDLE
     seq = state["seq"] + start
     new_read = (seq % 2) == 0
-    new_key = op_key_for(ridx, seq, K)
-    new_tag = seq * R + ridx  # globally unique per op
+    new_key = op_key_for(ridx[:, None], seq, K)
+    new_tag = seq * R + ridx[:, None]  # globally unique per op
     op_read = jnp.where(start, new_read, state["op_read"])
     op_keyv = jnp.where(start, new_key, state["op_key"])
     op_tag = jnp.where(start, new_tag, state["op_tag"])
-    op_snap = jnp.where(
-        start, state["done_max_ts"][jnp.clip(new_key, 0, K - 1)],
-        state["op_snap"])
+    snap_at_key = jnp.sum(
+        jnp.where(kidx[None, :, None] == new_key[:, None, :],
+                  state["done_max_ts"][None], 0), axis=1)     # (R, G)
+    op_snap = jnp.where(start, snap_at_key, state["op_snap"])
     # local contribution to the query round
-    self_ts = jnp.take_along_axis(store_ts, op_keyv[:, None], axis=1)[:, 0]
-    self_val = jnp.take_along_axis(store_val, op_keyv[:, None], axis=1)[:, 0]
+    self_ts = key_read(store_ts, op_keyv)
+    self_val = key_read(store_val, op_keyv)
     best_ts = jnp.where(start, self_ts, best_ts)
     best_val = jnp.where(start, self_val, best_val)
-    acks = jnp.where(start[:, None], ridx[None, :] == ridx[:, None], acks)
+    acks = jnp.where(start, self_bit, acks)
     phase = jnp.where(start, QUERY, phase)
     op_ts = jnp.where(start, 0, op_ts)
     op_val = jnp.where(start, 0, op_val)
@@ -200,16 +234,16 @@ def step(state, inbox, ctx: StepCtx):
     send_q = (phase == QUERY) & (start | resend)
     send_s = (phase == STORE) & (q_done | resend)
     out_query = {
-        "valid": jnp.broadcast_to(send_q[:, None], (R, R)),
-        "key": jnp.broadcast_to(op_keyv[:, None], (R, R)),
-        "tag": jnp.broadcast_to(op_tag[:, None], (R, R)),
+        "valid": jnp.broadcast_to(send_q[:, None, :], (R, R, G)),
+        "key": jnp.broadcast_to(op_keyv[:, None, :], (R, R, G)),
+        "tag": jnp.broadcast_to(op_tag[:, None, :], (R, R, G)),
     }
     out_store = {
-        "valid": jnp.broadcast_to(send_s[:, None], (R, R)),
-        "key": jnp.broadcast_to(op_keyv[:, None], (R, R)),
-        "tag": jnp.broadcast_to(op_tag[:, None], (R, R)),
-        "ts": jnp.broadcast_to(op_ts[:, None], (R, R)),
-        "val": jnp.broadcast_to(op_val[:, None], (R, R)),
+        "valid": jnp.broadcast_to(send_s[:, None, :], (R, R, G)),
+        "key": jnp.broadcast_to(op_keyv[:, None, :], (R, R, G)),
+        "tag": jnp.broadcast_to(op_tag[:, None, :], (R, R, G)),
+        "ts": jnp.broadcast_to(op_ts[:, None, :], (R, R, G)),
+        "val": jnp.broadcast_to(op_val[:, None, :], (R, R, G)),
     }
 
     new_state = dict(
@@ -226,13 +260,13 @@ def step(state, inbox, ctx: StepCtx):
 
 
 def metrics(state, cfg: SimConfig):
+    done = state["reads_done"] + state["writes_done"]
     return {
-        "ops_done": jnp.sum(state["reads_done"] + state["writes_done"]),
+        "ops_done": jnp.sum(done),
         "reads_done": jnp.sum(state["reads_done"]),
         "writes_done": jnp.sum(state["writes_done"]),
         # committed_slots keeps the runner/bench metric name uniform
-        "committed_slots": jnp.sum(state["reads_done"]
-                                   + state["writes_done"]),
+        "committed_slots": jnp.sum(done),
     }
 
 
@@ -240,7 +274,7 @@ def invariants(old, new, cfg: SimConfig) -> jax.Array:
     """1. Atomicity (in-kernel oracle delta).  2. Per-replica register
     timestamps never regress.  3. Register (ts, val) pairs are always
     consistent with the writer encoding."""
-    v_atomic = new["atomic_viol"] - old["atomic_viol"]
+    v_atomic = jnp.sum(new["atomic_viol"] - old["atomic_viol"])
     v_mono = jnp.sum(new["store_ts"] < old["store_ts"])
     held = new["store_ts"] > 0
     v_consist = jnp.sum(held
@@ -255,4 +289,5 @@ PROTOCOL = SimProtocol(
     step=step,
     metrics=metrics,
     invariants=invariants,
+    batched=True,
 )

@@ -26,21 +26,11 @@ TPU re-design (not a translation):
 - The in-kernel safety oracle: commit agreement on (cmd, seq, deps),
   commit/execute stability, and cross-replica agreement of the per-key
   execution hash chain.
-- **In-kernel recovery** (epaxos Prepare/PrepareReply, mirroring
-  host.py's decision rule): a per-instance promised-ballot plane
-  ``bal[R, R, I]`` gates every record; each replica ages the window
-  cells that block its execution frontier (committed instances
-  reachable into an uncommitted dep) and, past a per-replica staggered
-  timeout, runs one masked Prepare round at a higher ballot over the
-  stalled cell.  PrepareReplies carry BOTH the replier's recorded
-  state (status/attrs/accepted-ballot) and its freshly computed
-  conflict attributes for the command — so the reference's
-  restart-phase-1 (TryPreAccept) collapses into the same round: the
-  recoverer decides committed > accepted(max ballot) > preaccepted
-  with >= floor(N/2) identical non-owner replies > attr-union over the
-  prepare majority > NOOP, then drives a ballot-checked Accept and
-  Commit.  A permanently crashed command leader's stalled instances
-  are finished by the survivors (FuzzConfig.perm_crash).
+
+Failure recovery (epaxos Prepare/PrepareReply, TryPreAccept) is
+implemented in the host runtime (`epaxos/host.py`); the sim kernel
+exercises the fast/slow agreement paths and SCC execution under
+drop/dup/delay/partition and transient-crash fuzz.
 """
 
 from __future__ import annotations
@@ -62,23 +52,12 @@ HASH_PRIME = 1000003
 def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
     R = cfg.n_replicas
     dep_fields = tuple(f"d{p}" for p in range(R))
-    cdep_fields = tuple(f"c{p}" for p in range(R))
     return {
         "pa": ("inst", "seq", "cmd") + dep_fields,    # PreAccept
         "par": ("inst", "seq") + dep_fields,          # PreAcceptReply
         "acc": ("inst", "seq", "cmd") + dep_fields,   # Accept
         "accr": ("inst",),                            # AcceptReply
         "cmt": ("inst", "seq", "cmd") + dep_fields,   # Commit
-        # recovery (ballot-carrying) planes, kept separate from the
-        # owner-driven ones so an owner and a recoverer broadcasting in
-        # the same step never collide on a (src, dst) edge
-        "prep": ("owner", "inst", "ballot"),          # Prepare
-        # PrepareReply: recorded state + conflict attrs for the cmd
-        "prepr": ("owner", "inst", "ballot", "stat", "cmdv", "seq",
-                  "abal") + dep_fields + ("cseq",) + cdep_fields,
-        "racc": ("owner", "inst", "ballot", "cmdv", "seq") + dep_fields,
-        "raccr": ("owner", "inst", "ballot"),
-        "rcmt": ("owner", "inst", "cmdv", "seq") + dep_fields,
     }
 
 
@@ -121,34 +100,6 @@ def init_state(cfg: SimConfig, rng: jax.Array):
         mseq=jnp.zeros((R,), jnp.int32),      # merged attrs
         mdeps=jnp.full((R, R), -1, jnp.int32),
         stuck=jnp.zeros((R,), jnp.int32),
-        # recovery planes: promised ballot + ballot attrs were accepted
-        # at, per window cell (0 = the owner's implicit ballot)
-        bal=jnp.zeros((R, R, I), jnp.int32),
-        abal=jnp.zeros((R, R, I), jnp.int32),
-        # steps each cell has been blocking my execution frontier
-        age=jnp.zeros((R, R, I), jnp.int32),
-        # one in-flight recovery per replica (rphase 0 idle, 1 prepare,
-        # 2 accept) over instance (rowner, rinst) at ballot rballot
-        rphase=jnp.zeros((R,), jnp.int32),
-        rowner=jnp.zeros((R,), jnp.int32),
-        rinst=jnp.zeros((R,), jnp.int32),
-        rballot=jnp.zeros((R,), jnp.int32),
-        rstuck=jnp.zeros((R,), jnp.int32),
-        # prepare-round tally: per replier, recorded state + conflict
-        # attrs (the collapsed TryPreAccept round — see module docs)
-        racks=jnp.zeros((R, R), bool),
-        rstat=jnp.zeros((R, R), jnp.int32),
-        rcmd=jnp.full((R, R), NO_CMD, jnp.int32),
-        rseq2=jnp.zeros((R, R), jnp.int32),
-        rdeps2=jnp.full((R, R, R), -1, jnp.int32),
-        rabal=jnp.zeros((R, R), jnp.int32),
-        rcseq=jnp.zeros((R, R), jnp.int32),
-        rcdeps=jnp.full((R, R, R), -1, jnp.int32),
-        # decided attrs being driven through Accept/Commit
-        aacks=jnp.zeros((R, R), bool),
-        rdcmd=jnp.full((R,), NO_CMD, jnp.int32),
-        rdseq=jnp.zeros((R,), jnp.int32),
-        rddeps=jnp.full((R, R), -1, jnp.int32),
         # per-key execution oracle: count + order-sensitive hash chain
         kcount=jnp.zeros((R, K), jnp.int32),
         khash=jnp.zeros((R, K), jnp.int32),
@@ -200,34 +151,6 @@ def step(state, inbox, ctx: StepCtx):
     seq0, deps0 = state["seq0"], state["deps0"]
     mseq, mdeps = state["mseq"], state["mdeps"]
     kcount, khash = state["kcount"], state["khash"]
-    bal, abal, age = state["bal"], state["abal"], state["age"]
-    rphase, rowner = state["rphase"], state["rowner"]
-    rinst, rballot = state["rinst"], state["rballot"]
-    rstuck = state["rstuck"]
-    racks, rstat, rcmd = state["racks"], state["rstat"], state["rcmd"]
-    rseq2, rdeps2, rabal = state["rseq2"], state["rdeps2"], state["rabal"]
-    rcseq, rcdeps = state["rcseq"], state["rcdeps"]
-    aacks = state["aacks"]
-    rdcmd, rdseq, rddeps = state["rdcmd"], state["rdseq"], state["rddeps"]
-
-    def gather_cell(plane, owner, inst):
-        """plane[me, owner[me,s], inst[me,s]] -> (me, s)."""
-        o = jnp.clip(owner, 0, R - 1)
-        j = jnp.clip(inst, 0, I - 1)
-        return plane[ridx[:, None], o, j]
-
-    def cell_mask(v, owner, inst):
-        """(me, src) messages -> (me, src, R, I) one-hot target masks."""
-        return (v[:, :, None, None]
-                & (ridx[None, None, :, None] == owner[:, :, None, None])
-                & (iidx[None, None, None, :] == inst[:, :, None, None]))
-
-    def write_my_cell(plane, owner, inst, value, mask):
-        """Masked write of a per-me scalar at [me, owner[me], inst[me]]."""
-        oh = (mask[:, None, None]
-              & (ridx[None, :, None] == owner[:, None, None])
-              & (iidx[None, None, :] == inst[:, None, None]))
-        return jnp.where(oh, value[:, None, None], plane)
 
     def record(cmd_a, seq_a, deps_a, status_a, v, owner, inst, c, s, d, st):
         """Masked write of (c, s, d, st) at [me, owner(me), inst(me)].
@@ -272,10 +195,6 @@ def step(state, inbox, ctx: StepCtx):
     pa_deps = jnp.stack([jnp.transpose(m[f"d{p}"]) for p in range(R)],
                         axis=-1)                           # (me, src, R)
     own_src = jnp.broadcast_to(ridx[None, :], (R, R))      # owner == src
-    # the owner's implicit ballot is 0: once any recoverer's Prepare
-    # touched the cell (bal > 0), its PreAccepts are stale — no record,
-    # no reply (host handle_preaccept's ballot gate)
-    v = v & (gather_cell(bal, own_src, pa_inst) == 0)
     a_seq, a_dep = _conflict_attrs(
         cmd[:, None], seq[:, None], status[:, None],
         pa_cmd, own_src, pa_inst, cfg)                     # (me, src[,R])

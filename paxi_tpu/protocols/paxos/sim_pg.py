@@ -1,0 +1,483 @@
+"""Multi-Paxos, per-group (group-major) kernel layout — the CPU path.
+
+The lane-major kernel in ``paxos/sim.py`` puts the group axis on the
+TPU vector lanes; on the CPU backend that layout measured ~6x slower
+than this per-group kernel (the runner vmaps it over a leading group
+axis, which XLA:CPU vectorizes well).  ``bench.py`` and callers that
+may land on CPU select this variant by backend; semantics and the
+safety oracle are identical to the lane-major kernel.
+
+Reference: paxi paxos/paxos.go — single stable leader, phase-1 ballot
+election with log recovery from P1b payloads, per-slot phase-2 acceptance
+under a majority quorum, P3 commit broadcast, in-order execution
+(HandleRequest/HandleP1a/HandleP1b/HandleP2a/HandleP2b/HandleP3) [driver].
+
+TPU re-design (not a translation):
+- Per-replica state is a struct-of-arrays over a fixed **ring** of S
+  slots: ring position ``i`` holds absolute slot ``base + i``; the
+  window slides forward as the execute frontier advances, retaining the
+  last ``S//2`` executed slots for laggard healing (the reference's
+  unbounded ``log map[int]*entry`` becomes O(window), the SURVEY §7
+  slot-recycling requirement — 10M slots run in a 64-slot ring).
+- All handlers run every step on every replica as fully *masked*
+  updates (leader/follower divergence is `where`-selected).
+- Ballots are ``round * ballot_stride + replica_idx`` int32s
+  (paxos ballot.go packs n<<16|id the same way).
+- ``Quorum.ACK`` becomes a boolean ack-matrix OR + popcount
+  (p1_acks (R,R); log_acks (R,S,R)) [driver].
+- Messages carry ABSOLUTE slot numbers; receivers mask them against
+  their own window (out-of-window = silently ignored, like a TCP
+  segment for a closed connection).
+- P1b log payloads are passed *by reference*: on winning phase-1 the
+  new leader merges the current logs of its ackers, base-aligned via a
+  per-(leader, acker) gather.  A laggard winner first adopts the most
+  advanced acker's (kv, execute, base) — the state-transfer/log-
+  compaction analog of the host runtime's P1b snapshot.
+- P3 carries (slot, cmd) plus a commit frontier ``upto``: a follower
+  commits any in-window slot < upto accepted at the leader's exact
+  ballot.  A follower whose frontier fell below the leader's window
+  base adopts the leader's (kv, execute, base) wholesale (snapshot
+  catch-up) and keeps any of its own still-in-window commits.
+- Client load: the leader proposes one new command per step while the
+  window has room (closed-loop stream with window flow control);
+  commands encode (ballot, slot) so the agreement oracle can detect
+  any two-leaders-two-values divergence.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+
+NO_CMD = -1    # empty log entry
+NOOP = -2      # hole filled by a recovering leader
+
+
+def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
+    return {
+        "p1a": ("bal",),
+        "p1b": ("bal",),
+        "p2a": ("bal", "slot", "cmd"),
+        "p2b": ("bal", "slot"),
+        "p3": ("bal", "slot", "cmd", "upto"),
+    }
+
+
+def encode_cmd(bal, slot):
+    """Unique-ish command id per (ballot, slot) — lets the agreement
+    oracle catch divergent decisions. Doubles as the KV write payload."""
+    return ((bal & 0x7FFF) << 16) | (slot & 0xFFFF)
+
+
+def cmd_key(cmd, n_keys):
+    """Hash the command id onto the KV key space."""
+    return fib_key(cmd, n_keys)
+
+
+def _shift(arr, adv, fill):
+    """Slide rows of ``arr`` (R, S, ...) forward along the slot axis by
+    per-row ``adv`` >= 0: out[r, i] = arr[r, i + adv[r]] (or ``fill``
+    past the end).  The ring-recycling / base-alignment primitive."""
+    S = arr.shape[1]
+    idx = jnp.arange(S, dtype=jnp.int32)[None, :] + adv[:, None]
+    valid = (idx >= 0) & (idx < S)
+    idxc = jnp.clip(idx, 0, S - 1)
+    if arr.ndim == 2:
+        return jnp.where(valid, jnp.take_along_axis(arr, idxc, axis=1), fill)
+    return jnp.where(valid[:, :, None],
+                     jnp.take_along_axis(arr, idxc[:, :, None], axis=1),
+                     fill)
+
+
+def init_state(cfg: SimConfig, rng: jax.Array):
+    R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
+    del rng
+    return dict(
+        ballot=jnp.zeros((R,), jnp.int32),        # highest ballot seen/promised
+        active=jnp.zeros((R,), bool),             # leader with phase-1 done
+        p1_acks=jnp.zeros((R, R), bool),          # [ldr, src] phase-1 acks
+        base=jnp.zeros((R,), jnp.int32),          # abs slot of ring pos 0
+        log_bal=jnp.zeros((R, S), jnp.int32),     # accepted ballot per slot
+        log_cmd=jnp.full((R, S), NO_CMD, jnp.int32),
+        log_commit=jnp.zeros((R, S), bool),
+        log_acks=jnp.zeros((R, S, R), bool),      # [ldr, slot, src] P2b acks
+        proposed=jnp.zeros((R, S), bool),         # P2a sent under my ballot
+        next_slot=jnp.zeros((R,), jnp.int32),     # absolute
+        execute=jnp.zeros((R,), jnp.int32),       # absolute frontier
+        kv=jnp.zeros((R, K), jnp.int32),
+        # replica 0's timer fires at step 0 => immediate first election
+        timer=jnp.arange(R, dtype=jnp.int32) * cfg.election_timeout,
+        stuck=jnp.zeros((R,), jnp.int32),         # frontier-stall counter
+    )
+
+
+def step(state, inbox, ctx: StepCtx):
+    cfg = ctx.cfg
+    R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
+    MAJ, STRIDE = cfg.majority, cfg.ballot_stride
+    RETAIN = max(S // 2, 1)
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    sidx = jnp.arange(S, dtype=jnp.int32)
+
+    ballot = state["ballot"]
+    active = state["active"]
+    p1_acks = state["p1_acks"]
+    base = state["base"]
+    log_bal = state["log_bal"]
+    log_cmd = state["log_cmd"]
+    log_commit = state["log_commit"]
+    log_acks = state["log_acks"]
+    proposed = state["proposed"]
+    next_slot = state["next_slot"]
+    execute = state["execute"]
+    kv = state["kv"]
+
+    # ---------------- P1a: promise to the highest proposer --------------
+    m = inbox["p1a"]
+    b_in = jnp.where(m["valid"], m["bal"], 0)            # (src, dst)
+    p1a_bal = jnp.max(b_in, axis=0)                      # per dst
+    p1a_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)
+    promote = p1a_bal > ballot
+    ballot = jnp.maximum(ballot, p1a_bal)
+    active = active & ~promote
+    p1_acks = jnp.where(promote[:, None], False, p1_acks)  # my old round died
+    # P1b out (log payload by reference; see module docstring)
+    p1b_valid = promote[:, None] & (ridx[None, :] == p1a_src[:, None])
+    out_p1b = {"valid": p1b_valid,
+               "bal": jnp.broadcast_to(ballot[:, None], (R, R))}
+
+    own_bal = (ballot > 0) & (ballot % STRIDE == ridx)
+
+    # ---------------- P1b: collect phase-1 acks -------------------------
+    m = inbox["p1b"]
+    ack = m["valid"].T & (m["bal"].T == ballot[:, None]) & own_bal[:, None]
+    p1_acks = p1_acks | ack                               # (ldr, src)
+    p1_win = own_bal & ~active & (jnp.sum(p1_acks, axis=1) >= MAJ)
+    amask = p1_acks                                       # includes self
+
+    # ---------------- phase-1 win: state transfer from best acker -------
+    # A laggard winner's window may sit below its ackers' windows; adopt
+    # the most advanced acker's (kv, execute, base) first — by-reference
+    # equivalent of the host runtime's P1b (execute, snapshot) transfer.
+    exec_am = jnp.where(amask, execute[None, :], -1)      # (ldr, src)
+    f_src = jnp.argmax(exec_am, axis=1).astype(jnp.int32)
+    front = jnp.max(exec_am, axis=1)
+    el_ad = p1_win & (front > execute)
+    kv = jnp.where(el_ad[:, None], kv[f_src], kv)
+    execute = jnp.where(el_ad, front, execute)
+    next_slot = jnp.where(el_ad, jnp.maximum(next_slot, front), next_slot)
+    adv_el = jnp.where(el_ad, base[f_src] - base, 0)
+    base = jnp.where(el_ad, base[f_src], base)
+    log_bal = _shift(log_bal, adv_el, 0)
+    log_cmd = _shift(log_cmd, adv_el, NO_CMD)
+    log_commit = _shift(log_commit, adv_el, False)
+    proposed = _shift(proposed, adv_el, False)
+    log_acks = _shift(log_acks, adv_el, False)
+
+    # ---------------- phase-1 win: merge ackers' logs (base-aligned) ----
+    # leader ring pos j <-> abs base[ldr]+j <-> acker ring pos j+off
+    off = base[:, None] - base[None, :]                   # (ldr, src)
+    idx3 = sidx[None, None, :] + off[:, :, None]          # (ldr, src, S)
+    valid3 = (idx3 >= 0) & (idx3 < S)
+    idx3c = jnp.clip(idx3, 0, S - 1)
+    lb_src = jnp.take_along_axis(
+        jnp.broadcast_to(log_bal[None], (R, R, S)), idx3c, axis=2)
+    lc_src = jnp.take_along_axis(
+        jnp.broadcast_to(log_cmd[None], (R, R, S)), idx3c, axis=2)
+    lm_src = jnp.take_along_axis(
+        jnp.broadcast_to(log_commit[None], (R, R, S)), idx3c, axis=2)
+    sel = amask[:, :, None] & valid3
+    lb = jnp.where(sel, lb_src, -1)
+    src_best = jnp.argmax(lb, axis=1)                     # (ldr, S)
+    best_bal = jnp.max(lb, axis=1)
+    merged_cmd = jnp.take_along_axis(
+        lc_src, src_best[:, None, :], axis=1)[:, 0, :]
+    cmask = sel & lm_src
+    merged_commit = jnp.any(cmask, axis=1)                # (ldr, S)
+    csrc = jnp.argmax(cmask, axis=1)
+    committed_cmd = jnp.take_along_axis(
+        lc_src, csrc[:, None, :], axis=1)[:, 0, :]
+    abs_ = base[:, None] + sidx[None, :]                  # (R, S)
+    has_acc = (best_bal > 0) | merged_commit
+    top = jnp.max(jnp.where(has_acc, abs_ + 1, 0), axis=1)  # (ldr,) absolute
+    new_next = jnp.maximum(next_slot, top)
+    in_win = abs_ < new_next[:, None]                     # slots to own
+    w = p1_win[:, None]
+    # committed slots adopt the committed value; accepted adopt merged;
+    # holes below the frontier become NOOP re-proposals.
+    adopt_cmd = jnp.where(merged_commit, committed_cmd,
+                          jnp.where(best_bal > 0, merged_cmd, NOOP))
+    log_cmd = jnp.where(w & in_win, adopt_cmd, log_cmd)
+    log_bal = jnp.where(w & in_win, ballot[:, None], log_bal)
+    log_commit = jnp.where(w & in_win, merged_commit | log_commit, log_commit)
+    proposed = jnp.where(w, in_win & (merged_commit | log_commit), proposed)
+    self_only = (ridx[None, None, :] == ridx[:, None, None])  # (R,1->S,R)
+    log_acks = jnp.where(w[:, :, None],
+                         in_win[:, :, None] & self_only, log_acks)
+    next_slot = jnp.where(p1_win, new_next, next_slot)
+    active = active | p1_win
+
+    # ---------------- P2a: accept from the highest-ballot leader --------
+    m = inbox["p2a"]
+    b_in = jnp.where(m["valid"], m["bal"], -1)
+    a_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)    # per dst
+    a_bal = jnp.max(b_in, axis=0)
+    a_has = a_bal > 0
+    a_slot = m["slot"][a_src, ridx]                       # absolute
+    a_cmd = m["cmd"][a_src, ridx]
+    acc_ok = a_has & (a_bal >= ballot)
+    demote = acc_ok & (a_bal > ballot)                    # someone else leads
+    ballot = jnp.where(acc_ok, a_bal, ballot)
+    active = active & ~demote
+    p1_acks = jnp.where(demote[:, None], False, p1_acks)
+    a_rel = a_slot - base                                 # ring position
+    a_inw = (a_rel >= 0) & (a_rel < S)
+    oh = acc_ok[:, None] & (sidx[None, :] == a_rel[:, None])
+    writable = oh & (log_bal <= a_bal[:, None]) & ~log_commit
+    log_bal = jnp.where(writable, a_bal[:, None], log_bal)
+    log_cmd = jnp.where(writable, a_cmd[:, None], log_cmd)
+    # ack ONLY what we durably stored: a slot outside our window was
+    # dropped, and acking it would let the leader commit an entry no
+    # majority actually holds (lost acceptance after a leader change)
+    out_p2b = {
+        "valid": (acc_ok & a_inw)[:, None] & (ridx[None, :] == a_src[:, None]),
+        "bal": jnp.broadcast_to(a_bal[:, None], (R, R)),
+        "slot": jnp.broadcast_to(a_slot[:, None], (R, R)),
+    }
+
+    own_bal = (ballot > 0) & (ballot % STRIDE == ridx)
+
+    # ---------------- P2b: leader tallies acks, commits -----------------
+    m = inbox["p2b"]
+    okb = m["valid"].T & (m["bal"].T == ballot[:, None]) & \
+        (active & own_bal)[:, None]                       # (ldr, src)
+    brel = m["slot"].T - base[:, None]                    # (ldr, src) ring
+    add = okb[:, :, None] & (sidx[None, None, :] == brel[:, :, None])
+    log_acks = log_acks | jnp.transpose(add, (0, 2, 1))   # (ldr, slot, src)
+    acks_n = jnp.sum(log_acks, axis=2)                    # (ldr, slot)
+    newly = ((active & own_bal)[:, None] & (acks_n >= MAJ)
+             & ~log_commit & (log_cmd != NO_CMD) & proposed)
+    log_commit = log_commit | newly
+
+    # ---------------- P3: commit notifications --------------------------
+    m = inbox["p3"]
+    b_in = jnp.where(m["valid"], m["bal"], -1)
+    c_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)
+    c_bal = jnp.max(b_in, axis=0)
+    c_has = c_bal > 0
+    c_slot = m["slot"][c_src, ridx]                       # absolute
+    c_cmd = m["cmd"][c_src, ridx]
+    c_upto = m["upto"][c_src, ridx]
+    abs_ = base[:, None] + sidx[None, :]
+    c_rel = c_slot - base
+    oh = c_has[:, None] & (sidx[None, :] == c_rel[:, None])
+    log_cmd = jnp.where(oh, c_cmd[:, None], log_cmd)
+    log_bal = jnp.where(oh, jnp.maximum(log_bal, c_bal[:, None]), log_bal)
+    log_commit = log_commit | oh
+    # frontier commit: slots < upto accepted at the leader's exact ballot
+    ohu = (c_has[:, None] & (abs_ < c_upto[:, None])
+           & (log_bal == c_bal[:, None]) & (log_cmd != NO_CMD))
+    log_commit = log_commit | ohu
+
+    # ---------------- P3: snapshot catch-up for deep laggards -----------
+    # My frontier fell below the sender's window base: the slots I still
+    # need were recycled everywhere ahead of me.  Adopt the sender's
+    # (kv, execute, base) by reference and keep my own in-window commits.
+    src_base = base[c_src]
+    adopt = c_has & (execute < src_base)
+    adv_a = jnp.where(adopt, src_base - base, 0)
+    my_bal = _shift(log_bal, adv_a, 0)
+    my_cmd = _shift(log_cmd, adv_a, NO_CMD)
+    my_com = _shift(log_commit, adv_a, False)
+    s_bal, s_cmd, s_com = log_bal[c_src], log_cmd[c_src], log_commit[c_src]
+    a2 = adopt[:, None]
+    log_bal = jnp.where(a2, jnp.where(s_com, s_bal, my_bal), log_bal)
+    log_cmd = jnp.where(a2, jnp.where(s_com, s_cmd, my_cmd), log_cmd)
+    log_commit = jnp.where(a2, s_com | my_com, log_commit)
+    proposed = jnp.where(a2, False, proposed)
+    log_acks = jnp.where(adopt[:, None, None], False, log_acks)
+    kv = jnp.where(a2, kv[c_src], kv)
+    execute = jnp.where(adopt, execute[c_src], execute)
+    next_slot = jnp.where(adopt, jnp.maximum(next_slot, execute), next_slot)
+    base = jnp.where(adopt, src_base, base)
+    abs_ = base[:, None] + sidx[None, :]
+
+    # ---------------- leader proposes (new cmd or re-proposal) ----------
+    is_leader = active & own_bal
+    mask_re = (~log_commit) & (~proposed) & (abs_ < next_slot[:, None])
+    first_re = jnp.argmin(jnp.where(mask_re, sidx[None, :], S), axis=1)
+    has_re = jnp.any(mask_re, axis=1)
+    can_new = (next_slot - base) < S                      # window flow control
+    rel_next = jnp.clip(next_slot - base, 0, S - 1)
+    prop_rel = jnp.where(has_re, first_re, rel_next).astype(jnp.int32)
+    prop_slot = base + prop_rel                           # absolute
+    is_new = ~has_re & can_new
+    new_cmd = encode_cmd(ballot, prop_slot)
+    re_cmd = jnp.take_along_axis(log_cmd, prop_rel[:, None], axis=1)[:, 0]
+    re_cmd = jnp.where(re_cmd == NO_CMD, NOOP, re_cmd)
+    prop_cmd = jnp.where(is_new, new_cmd, re_cmd)
+    do = is_leader & (has_re | can_new)
+    oh = do[:, None] & (sidx[None, :] == prop_rel[:, None])
+    log_bal = jnp.where(oh, ballot[:, None], log_bal)
+    log_cmd = jnp.where(oh & ~log_commit, prop_cmd[:, None], log_cmd)
+    proposed = proposed | oh
+    log_acks = log_acks | (oh[:, :, None] & self_only)
+    next_slot = next_slot + (is_new & do)
+    out_p2a = {
+        "valid": jnp.broadcast_to(do[:, None], (R, R)),
+        "bal": jnp.broadcast_to(ballot[:, None], (R, R)),
+        "slot": jnp.broadcast_to(prop_slot[:, None], (R, R)),
+        "cmd": jnp.broadcast_to(prop_cmd[:, None], (R, R)),
+    }
+
+    # ---------------- execute committed prefix, apply to KV -------------
+    advanced = jnp.zeros((R,), jnp.int32)
+    running = jnp.ones((R,), bool)
+    for e in range(cfg.exec_window):
+        rel = execute + e - base                          # ring position
+        inb = rel < S
+        idx = jnp.clip(rel, 0, S - 1)
+        com = jnp.take_along_axis(log_commit, idx[:, None], axis=1)[:, 0]
+        running = running & com & inb
+        cmd_e = jnp.take_along_axis(log_cmd, idx[:, None], axis=1)[:, 0]
+        key_e = cmd_key(cmd_e, K)
+        wr = running & (cmd_e >= 0)
+        ohk = wr[:, None] & (jnp.arange(K)[None, :] == key_e[:, None])
+        kv = jnp.where(ohk, cmd_e[:, None], kv)
+        advanced = advanced + running
+    new_execute = execute + advanced
+
+    # ---------------- P3 out: newly committed + frontier retransmit -----
+    low_new = jnp.argmin(jnp.where(newly, sidx[None, :], S), axis=1)
+    any_new = jnp.any(newly, axis=1)
+    # otherwise cycle retransmits through my in-window committed prefix
+    # (laggards behind the window are healed by snapshot adoption)
+    span = jnp.maximum(new_execute - base, 1)
+    rr = ctx.t % span
+    p3_rel = jnp.where(any_new, low_new, rr).astype(jnp.int32)
+    p3_rel = jnp.clip(p3_rel, 0, S - 1)
+    p3_committed = jnp.take_along_axis(
+        log_commit, p3_rel[:, None], axis=1)[:, 0]
+    p3_cmd = jnp.take_along_axis(log_cmd, p3_rel[:, None], axis=1)[:, 0]
+    p3_do = is_leader & p3_committed
+    out_p3 = {
+        "valid": jnp.broadcast_to(p3_do[:, None], (R, R)),
+        "bal": jnp.broadcast_to(ballot[:, None], (R, R)),
+        "slot": jnp.broadcast_to((base + p3_rel)[:, None], (R, R)),
+        "cmd": jnp.broadcast_to(p3_cmd[:, None], (R, R)),
+        "upto": jnp.broadcast_to(new_execute[:, None], (R, R)),
+    }
+
+    # ---------------- stuck-frontier retry (lost P2a/P2b) ---------------
+    stalled = is_leader & (new_execute == execute) & (next_slot > new_execute)
+    stuck = jnp.where(stalled, state["stuck"] + 1, 0)
+    retry = stuck >= cfg.retry_timeout
+    rel_e = jnp.clip(new_execute - base, 0, S - 1)
+    ohr = retry[:, None] & (sidx[None, :] == rel_e[:, None])
+    proposed = proposed & ~ohr
+    stuck = jnp.where(retry, 0, stuck)
+
+    # ---------------- election timer ------------------------------------
+    heard = promote | acc_ok | (c_has & (c_bal >= ballot))
+    k_jit = jr.fold_in(ctx.rng, 17)
+    jitter = jr.randint(k_jit, (R,), 0, cfg.backoff + 1)
+    timer = jnp.where(heard | active,
+                      cfg.election_timeout + jitter,
+                      state["timer"] - 1)
+    fire = ~active & (timer <= 0)
+    new_bal = (jnp.max(ballot) // STRIDE + 1) * STRIDE + ridx
+    ballot = jnp.where(fire, new_bal, ballot)
+    p1_acks = jnp.where(fire[:, None], ridx[None, :] == ridx[:, None], p1_acks)
+    timer = jnp.where(fire, cfg.election_timeout + jitter, timer)
+    out_p1a = {
+        "valid": jnp.broadcast_to(fire[:, None], (R, R)),
+        "bal": jnp.broadcast_to(ballot[:, None], (R, R)),
+    }
+
+    # ---------------- slide the ring window (slot recycling) ------------
+    # keep the last RETAIN executed slots resident for P3 retransmits;
+    # anything older is only reachable via snapshot adoption
+    new_base = jnp.maximum(base, new_execute - RETAIN)
+    adv = new_base - base
+    log_bal = _shift(log_bal, adv, 0)
+    log_cmd = _shift(log_cmd, adv, NO_CMD)
+    log_commit = _shift(log_commit, adv, False)
+    proposed = _shift(proposed, adv, False)
+    log_acks = _shift(log_acks, adv, False)
+
+    new_state = dict(
+        ballot=ballot, active=active, p1_acks=p1_acks, base=new_base,
+        log_bal=log_bal, log_cmd=log_cmd, log_commit=log_commit,
+        log_acks=log_acks, proposed=proposed, next_slot=next_slot,
+        execute=new_execute, kv=kv, timer=timer, stuck=stuck,
+    )
+    outbox = {"p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
+              "p2b": out_p2b, "p3": out_p3}
+    return new_state, outbox
+
+
+def metrics(state, cfg: SimConfig):
+    """Committed slots = executed prefix at the most advanced replica
+    (executed implies committed and agreement-checked)."""
+    return {
+        "committed_slots": jnp.max(state["execute"]),
+        "min_execute": jnp.min(state["execute"]),
+        "has_leader": jnp.any(state["active"]).astype(jnp.int32),
+    }
+
+
+def invariants(old, new, cfg: SimConfig) -> jax.Array:
+    """Per-step safety oracle (generalizes history.go's checker):
+    1. Agreement: all committed commands for a slot are equal — checked
+       on the base-aligned common window across replicas.
+    2. Stability: a committed (slot, cmd) never changes or un-commits
+       while it remains in the window; slots recycled out must have
+       been executed (execute >= base always).
+    3. Ballot monotonicity per replica.
+    4. Executed prefix is committed (within the window)."""
+    BIG = jnp.int32(2**30)
+    S = cfg.n_slots
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    base, c, cmd = new["base"], new["log_commit"], new["log_cmd"]
+
+    # 1. agreement on the aligned window [max(base), max(base)+S)
+    align = jnp.max(base) - base
+    a_c = _shift(c, align, False)
+    a_cmd = _shift(cmd, align, NO_CMD)
+    mx = jnp.max(jnp.where(a_c, a_cmd, -BIG), axis=0)
+    mn = jnp.min(jnp.where(a_c, a_cmd, BIG), axis=0)
+    n_c = jnp.sum(a_c, axis=0)
+    v_agree = jnp.sum((n_c >= 1) & (mx != mn))
+
+    # 2. stability: old commits still in-window must match; the window
+    # may only recycle executed slots (base <= execute)
+    adv = base - old["base"]
+    o_c = _shift(old["log_commit"], adv, False)
+    o_cmd = _shift(old["log_cmd"], adv, NO_CMD)
+    v_stable = jnp.sum(o_c & (~c | (cmd != o_cmd)))
+    v_stable = v_stable + jnp.sum(new["execute"] < base)
+
+    # 3. ballot monotonicity
+    v_bal = jnp.sum(new["ballot"] < old["ballot"])
+
+    # 4. executed prefix committed (ring positions below the frontier)
+    abs_ = base[:, None] + sidx[None, :]
+    v_exec = jnp.sum((abs_ < new["execute"][:, None]) & ~c)
+
+    return (v_agree + v_stable + v_bal + v_exec).astype(jnp.int32)
+
+
+PROTOCOL = SimProtocol(
+    name="paxos_pg",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=step,
+    metrics=metrics,
+    invariants=invariants,
+)
