@@ -1,4 +1,4 @@
-"""Chain replication as a pure TPU kernel.
+"""Chain replication as a pure TPU kernel (lane-major layout).
 
 Reference: paxi chain/ — a static chain (successor/predecessor from the
 sorted ID list): writes enter the head, propagate down the chain, the
@@ -6,15 +6,23 @@ tail acknowledges, and reads are served at the tail [driver].  The
 throughput-baseline protocol of the suite.
 
 TPU re-design:
+- **Lane-major batch layout** (see sim/lanes.py): state ``(R, G)`` /
+  ``(R, S, G)``, mailbox planes ``(src, dst, G)`` — the group axis
+  feeds the vector lanes.
 - Replica index IS the chain position (0 = head, R-1 = tail); the dense
   (src, dst) mailbox is used only on the two chain edges per replica.
 - The head is the closed-loop client: it appends one deterministic write
   per step (val = f(seq)), so the whole pipeline sustains 1 write/step.
+- The log is a **ring over absolute sequence numbers** (seq % S): the
+  head applies window flow control (applied - committed < S), so every
+  entry still in flight anywhere on the chain is ring-resident and the
+  horizon is unbounded (SURVEY §7 slot recycling; sim/ring.py).
 - Forwarding uses an optimistic go-back-N pointer per replica with
   **cumulative acks**: ``ack`` carries the sender's applied count and the
   tail-applied count (the commit frontier) — a stalled successor resets
   the pointer, so drops/dups/delays from the fuzz schedule are repaired
-  without per-message bookkeeping.
+  without per-message bookkeeping.  (A successor's applied count never
+  trails my commit frontier, so go-back-N targets are always resident.)
 - Commit = tail-applied, learned upstream via the same acks (the
   reference's tail-ack propagated to the head).
 """
@@ -33,6 +41,14 @@ from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
     return {
         "prop": ("seq", "key", "val"),
+        # go-back-N repair channel: every step the sender retransmits the
+        # oldest entry its successor has not cumulatively acked.  Under a
+        # drop/delay schedule this refills the successor's next hole
+        # within ~1 RTT instead of a stall-timeout rewind; fault-free it
+        # is an ignored duplicate (pseq < applied).  A separate plane so
+        # it never collides with the pipeline's new-entry sends in the
+        # same wheel slot.
+        "rep": ("seq", "key", "val"),
         "ack": ("applied", "tail_n"),
     }
 
@@ -47,19 +63,20 @@ def key_for(seq, n_keys):
     return fib_key(seq, n_keys)
 
 
-def init_state(cfg: SimConfig, rng: jax.Array):
-    R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
+def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
+    R, S, K, G = cfg.n_replicas, cfg.n_slots, cfg.n_keys, n_groups
     del rng
+    i32 = jnp.int32
     return dict(
-        log_key=jnp.zeros((R, S), jnp.int32),
-        log_val=jnp.zeros((R, S), jnp.int32),
-        applied=jnp.zeros((R,), jnp.int32),     # in-order applied prefix
-        committed=jnp.zeros((R,), jnp.int32),   # known tail-applied
-        known_succ=jnp.zeros((R,), jnp.int32),  # optimistic succ progress
-        seen_succ=jnp.zeros((R,), jnp.int32),   # last acked succ applied
-        stall=jnp.zeros((R,), jnp.int32),
-        kv=jnp.zeros((R, K), jnp.int32),
-        reads_done=jnp.zeros((R,), jnp.int32),
+        log_key=jnp.zeros((R, S, G), i32),
+        log_val=jnp.zeros((R, S, G), i32),
+        applied=jnp.zeros((R, G), i32),     # in-order applied prefix (abs)
+        committed=jnp.zeros((R, G), i32),   # known tail-applied
+        known_succ=jnp.zeros((R, G), i32),  # optimistic succ progress
+        seen_succ=jnp.zeros((R, G), i32),   # last acked succ applied
+        stall=jnp.zeros((R, G), i32),
+        kv=jnp.zeros((R, K, G), i32),
+        reads_done=jnp.zeros((R, G), i32),
     )
 
 
@@ -68,45 +85,63 @@ def step(state, inbox, ctx: StepCtx):
     R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
     ridx = jnp.arange(R, dtype=jnp.int32)
     sidx = jnp.arange(S, dtype=jnp.int32)
-    is_head = ridx == 0
-    is_tail = ridx == R - 1
+    kidx = jnp.arange(K, dtype=jnp.int32)
+    is_head = (ridx == 0)[:, None]
+    is_tail = (ridx == R - 1)[:, None]
 
     applied = state["applied"]
     log_key, log_val = state["log_key"], state["log_val"]
     kv = state["kv"]
+    G = applied.shape[-1]
 
-    # ------------- receive prop from predecessor -------------------------
-    m = inbox["prop"]
+    def edge(plane, src):
+        """plane[src[r], r, :] — read the (src -> me) mailbox edge,
+        unrolled over the tiny R axis (no gather on the hot path)."""
+        acc = jnp.zeros(plane.shape[1:], plane.dtype)
+        for s in range(R):
+            acc = jnp.where((src == s)[:, None], plane[s], acc)
+        return acc
+
+    def write_ring(plane, do, seq, value):
+        """Masked write of ``value (R, G)`` at ring position seq % S."""
+        oh = do[:, None, :] & (sidx[None, :, None]
+                               == (seq % S)[:, None, :])
+        return jnp.where(oh, value[:, None, :], plane)
+
+    # ------------- receive prop/repair from predecessor ------------------
     pred = jnp.clip(ridx - 1, 0, R - 1)
-    pv = m["valid"][pred, ridx] & ~is_head          # only the chain edge
-    pseq = m["seq"][pred, ridx]
-    pkey = m["key"][pred, ridx]
-    pval = m["val"][pred, ridx]
-    take = pv & (pseq == applied) & (applied < S)   # next expected, in order
-    oh = take[:, None] & (sidx[None, :] == pseq[:, None])
-    log_key = jnp.where(oh, pkey[:, None], log_key)
-    log_val = jnp.where(oh, pval[:, None], log_val)
-    ohk = take[:, None] & (jnp.arange(K)[None, :] == pkey[:, None])
-    kv = jnp.where(ohk, pval[:, None], kv)
-    applied = applied + take
+    for box in ("prop", "rep"):
+        m = inbox[box]
+        pv = edge(m["valid"], pred) & ~is_head      # only the chain edge
+        pseq = edge(m["seq"], pred)
+        pkey = edge(m["key"], pred)
+        pval = edge(m["val"], pred)
+        # next expected, in order; ring has room because my applied can
+        # never run more than S ahead of the commit frontier (head flow
+        # control)
+        take = pv & (pseq == applied)
+        log_key = write_ring(log_key, take, pseq, pkey)
+        log_val = write_ring(log_val, take, pseq, pval)
+        ohk = take[:, None, :] & (kidx[None, :, None] == pkey[:, None, :])
+        kv = jnp.where(ohk, pval[:, None, :], kv)
+        applied = applied + take
 
-    # ------------- head appends one write per step -----------------------
+    # ------------- head appends one write per step (flow control) --------
     h_seq = applied * is_head
-    h_do = is_head & (applied < S)
+    h_do = is_head & (applied - state["committed"] < S)
     h_key, h_val = key_for(h_seq, K), encode_val(h_seq)
-    oh = h_do[:, None] & (sidx[None, :] == h_seq[:, None])
-    log_key = jnp.where(oh, h_key[:, None], log_key)
-    log_val = jnp.where(oh, h_val[:, None], log_val)
-    ohk = h_do[:, None] & (jnp.arange(K)[None, :] == h_key[:, None])
-    kv = jnp.where(ohk, h_val[:, None], kv)
+    log_key = write_ring(log_key, h_do, h_seq, h_key)
+    log_val = write_ring(log_val, h_do, h_seq, h_val)
+    ohk = h_do[:, None, :] & (kidx[None, :, None] == h_key[:, None, :])
+    kv = jnp.where(ohk, h_val[:, None, :], kv)
     applied = applied + h_do
 
     # ------------- receive cumulative ack from successor -----------------
     m = inbox["ack"]
     succ = jnp.clip(ridx + 1, 0, R - 1)
-    av = m["valid"][succ, ridx] & ~is_tail
-    a_applied = jnp.where(av, m["applied"][succ, ridx], -1)
-    a_tail = jnp.where(av, m["tail_n"][succ, ridx], 0)
+    av = edge(m["valid"], succ) & ~is_tail
+    a_applied = jnp.where(av, edge(m["applied"], succ), -1)
+    a_tail = jnp.where(av, edge(m["tail_n"], succ), 0)
     progress = a_applied > state["seen_succ"]
     seen_succ = jnp.maximum(state["seen_succ"], a_applied)
     committed = jnp.maximum(state["committed"], a_tail)
@@ -120,24 +155,40 @@ def step(state, inbox, ctx: StepCtx):
 
     # ------------- forward next entry to successor -----------------------
     send = (~is_tail) & (applied > known_succ)
-    s_seq = jnp.clip(known_succ, 0, S - 1)
-    s_key = jnp.take_along_axis(log_key, s_seq[:, None], axis=1)[:, 0]
-    s_val = jnp.take_along_axis(log_val, s_seq[:, None], axis=1)[:, 0]
-    to_succ = ridx[None, :] == succ[:, None]
+    s_seq = known_succ                               # absolute
+    oh_s = sidx[None, :, None] == (s_seq % S)[:, None, :]
+    s_key = jnp.sum(jnp.where(oh_s, log_key, 0), axis=1)
+    s_val = jnp.sum(jnp.where(oh_s, log_val, 0), axis=1)
+    to_succ = (ridx[None, :] == succ[:, None])[:, :, None]
     out_prop = {
-        "valid": send[:, None] & to_succ,
-        "seq": jnp.broadcast_to(s_seq[:, None], (R, R)),
-        "key": jnp.broadcast_to(s_key[:, None], (R, R)),
-        "val": jnp.broadcast_to(s_val[:, None], (R, R)),
+        "valid": send[:, None, :] & to_succ,
+        "seq": jnp.broadcast_to(s_seq[:, None, :], (R, R, G)),
+        "key": jnp.broadcast_to(s_key[:, None, :], (R, R, G)),
+        "val": jnp.broadcast_to(s_val[:, None, :], (R, R, G)),
     }
     known_succ = known_succ + send
 
+    # ------------- repair: retransmit the oldest unacked entry -----------
+    r_send = (~is_tail) & (applied > seen_succ) & (seen_succ >= 0)
+    r_seq = jnp.maximum(seen_succ, 0)
+    oh_r2 = sidx[None, :, None] == (r_seq % S)[:, None, :]
+    out_rep = {
+        "valid": r_send[:, None, :] & to_succ,
+        "seq": jnp.broadcast_to(r_seq[:, None, :], (R, R, G)),
+        "key": jnp.broadcast_to(
+            jnp.sum(jnp.where(oh_r2, log_key, 0), axis=1)[:, None, :],
+            (R, R, G)),
+        "val": jnp.broadcast_to(
+            jnp.sum(jnp.where(oh_r2, log_val, 0), axis=1)[:, None, :],
+            (R, R, G)),
+    }
+
     # ------------- ack upstream every step (cumulative) ------------------
-    to_pred = ridx[None, :] == pred[:, None]
+    to_pred = (ridx[None, :] == pred[:, None])[:, :, None]
     out_ack = {
-        "valid": (~is_head)[:, None] & to_pred,
-        "applied": jnp.broadcast_to(applied[:, None], (R, R)),
-        "tail_n": jnp.broadcast_to(committed[:, None], (R, R)),
+        "valid": (~is_head)[:, :, None] & to_pred,
+        "applied": jnp.broadcast_to(applied[:, None, :], (R, R, G)),
+        "tail_n": jnp.broadcast_to(committed[:, None, :], (R, R, G)),
     }
 
     # ------------- reads are served at the tail --------------------------
@@ -145,7 +196,8 @@ def step(state, inbox, ctx: StepCtx):
     # counted only once the register holds data (reference: reads at
     # tail are lease-free local reads)
     r_key = key_for(jnp.maximum(applied - 1, 0), K)
-    r_val = jnp.take_along_axis(kv, r_key[:, None], axis=1)[:, 0]
+    oh_r = kidx[None, :, None] == r_key[:, None, :]
+    r_val = jnp.sum(jnp.where(oh_r, kv, 0), axis=1)
     served = is_tail & (applied > 0) & (r_val != 0)
     reads_done = state["reads_done"] + served
 
@@ -154,33 +206,36 @@ def step(state, inbox, ctx: StepCtx):
         committed=committed, known_succ=known_succ, seen_succ=seen_succ,
         stall=stall, kv=kv, reads_done=reads_done,
     )
-    return new_state, {"prop": out_prop, "ack": out_ack}
+    return new_state, {"prop": out_prop, "rep": out_rep, "ack": out_ack}
 
 
 def metrics(state, cfg: SimConfig):
     return {
-        "committed_slots": state["committed"][0],   # head's commit frontier
-        "tail_applied": state["applied"][cfg.n_replicas - 1],
+        "committed_slots": jnp.sum(state["committed"][0]),  # head frontier
+        "tail_applied": jnp.sum(state["applied"][cfg.n_replicas - 1]),
         "reads_done": jnp.sum(state["reads_done"]),
     }
 
 
 def invariants(old, new, cfg: SimConfig) -> jax.Array:
-    """1. Every applied entry matches the head's deterministic write
-    (catches out-of-order / corrupted applies).  2. applied/committed
+    """1. Every ring-resident applied entry matches the head's
+    deterministic write (catches out-of-order / corrupted applies): for
+    a replica with applied = a, ring position p holds absolute seq
+    a-1 - ((a-1-p) mod S) when that is >= 0.  2. applied/committed
     monotone.  3. applied is nonincreasing down the chain.  4. No commit
     beyond the tail's applied prefix."""
     S = cfg.n_slots
     sidx = jnp.arange(S, dtype=jnp.int32)
-    ap = new["applied"]
-    in_pref = sidx[None, :] < ap[:, None]
-    v_det = jnp.sum(in_pref & (new["log_val"] != encode_val(sidx)[None, :]))
-    v_det += jnp.sum(in_pref
-                     & (new["log_key"] != key_for(sidx, cfg.n_keys)[None, :]))
+    ap = new["applied"]                               # (R, G)
+    last = ap[:, None, :] - 1                         # (R, 1, G)
+    seq_at = last - ((last - sidx[None, :, None]) % S)
+    live = seq_at >= 0
+    v_det = jnp.sum(live & (new["log_val"] != encode_val(seq_at)))
+    v_det += jnp.sum(live & (new["log_key"] != key_for(seq_at, cfg.n_keys)))
     v_mono = jnp.sum(ap < old["applied"])
     v_mono += jnp.sum(new["committed"] < old["committed"])
     v_chain = jnp.sum(ap[:-1] < ap[1:])
-    v_commit = jnp.sum(new["committed"] > ap[cfg.n_replicas - 1])
+    v_commit = jnp.sum(new["committed"] > ap[cfg.n_replicas - 1][None])
     return (v_det + v_mono + v_chain + v_commit).astype(jnp.int32)
 
 
@@ -191,4 +246,5 @@ PROTOCOL = SimProtocol(
     step=step,
     metrics=metrics,
     invariants=invariants,
+    batched=True,
 )

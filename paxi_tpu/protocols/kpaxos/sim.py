@@ -11,10 +11,26 @@ TPU re-design — the multi-leader structure is a *vectorization win*:
 partition index == leader index, so a replica's inbox holds up to R
 concurrent P2a messages (one per partition/source) and all of them are
 applied in one masked scatter — no argmax winner-pick like the
-single-leader paxos kernel needs.  Per-replica state carries an
-(R partitions x S slots) log replica-of-record; commit = majority
-popcount over the leader's per-slot ack matrix; execution advances an
-independent frontier per partition.
+single-leader paxos kernel needs.
+
+- **Lane-major batch layout** (see sim/lanes.py): state ``(R, G)`` /
+  ``(R, P, S, G)``, mailbox planes ``(src, dst, G)``; ``Quorum.ACK``
+  is a bit-packed int32 mask per (leader, slot) with
+  ``lax.population_count`` for ``Majority()`` (quorum.go [driver]).
+- Per-replica state carries an (R partitions x S slots) **ring** per
+  partition: position i holds absolute slot base + i; each (replica,
+  partition) window slides with its execute frontier, retaining the
+  last S//2 executed slots (SURVEY §7 slot recycling — the horizon is
+  unbounded).  Messages carry absolute slots; out-of-window slots are
+  silently ignored and an acceptor acks only what it durably stored.
+- P3 carries a commit frontier ``upto`` plus the leader's window base
+  ``lowslot``: a replica whose frontier for that partition fell below
+  ``lowslot`` adopts the leader's partition row (log, base, execute)
+  and KV stripe by reference — snapshot catch-up for deep laggards,
+  the state-transfer analog of the host runtime.
+- Keys are partition-striped (key = part + R * hash, collision-free
+  for n_keys >= n_replicas) so applies never conflict across
+  partitions.
 """
 
 from __future__ import annotations
@@ -25,6 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim.ring import shift_window
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 NO_CMD = -1
@@ -35,7 +52,7 @@ def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
     return {
         "p2a": ("slot", "cmd"),
         "p2b": ("slot",),
-        "p3": ("slot", "cmd", "upto"),
+        "p3": ("slot", "cmd", "upto", "lowslot"),
     }
 
 
@@ -44,20 +61,26 @@ def encode_cmd(part, slot):
     return ((part & 0x7FFF) << 16) | (slot & 0xFFFF)
 
 
-def init_state(cfg: SimConfig, rng: jax.Array):
-    R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
+def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
+    R, S, K, G = cfg.n_replicas, cfg.n_slots, cfg.n_keys, n_groups
     del rng
+    if R > 31:
+        raise ValueError(f"n_replicas={R} > 31: packed int32 ack masks "
+                         "support at most 31 replicas per group")
+    i32 = jnp.int32
     return dict(
-        # replica-of-record logs: [replica, partition, slot]
-        log_cmd=jnp.full((R, R, S), NO_CMD, jnp.int32),
-        log_commit=jnp.zeros((R, R, S), bool),
-        # leader-side state for my own partition
-        acks=jnp.zeros((R, S, R), bool),   # [ldr, slot, src]
-        next_slot=jnp.zeros((R,), jnp.int32),
-        # execution frontier per partition at each replica
-        execute=jnp.zeros((R, R), jnp.int32),
-        kv=jnp.zeros((R, K), jnp.int32),
-        stuck=jnp.zeros((R,), jnp.int32),
+        # replica-of-record ring logs: [replica, partition, slot, G]
+        log_cmd=jnp.full((R, R, S, G), NO_CMD, i32),
+        log_commit=jnp.zeros((R, R, S, G), bool),
+        base=jnp.zeros((R, R, G), i32),     # abs slot of ring pos 0
+        # leader-side ack bitmask for my own partition, base-aligned to
+        # base[ldr, ldr]
+        acks=jnp.zeros((R, S, G), i32),
+        next_slot=jnp.zeros((R, G), i32),   # absolute
+        # execution frontier per partition at each replica (absolute)
+        execute=jnp.zeros((R, R, G), i32),
+        kv=jnp.zeros((R, K, G), i32),
+        stuck=jnp.zeros((R, G), i32),
     )
 
 
@@ -65,128 +88,187 @@ def step(state, inbox, ctx: StepCtx):
     cfg = ctx.cfg
     R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
     MAJ = cfg.majority
+    RETAIN = max(S // 2, 1)
     ridx = jnp.arange(R, dtype=jnp.int32)
     sidx = jnp.arange(S, dtype=jnp.int32)
+    kidx = jnp.arange(K, dtype=jnp.int32)
 
-    log_cmd = state["log_cmd"]
+    log_cmd = state["log_cmd"]            # (R, P, S, G)
     log_commit = state["log_commit"]
-    acks = state["acks"]
+    base = state["base"]                  # (R, P, G)
+    acks = state["acks"]                  # (R, S, G) bitmask
     next_slot = state["next_slot"]
-    execute = state["execute"]
+    execute = state["execute"]            # (R, P, G)
     kv = state["kv"]
+    G = next_slot.shape[-1]
+
+    def T(x):  # mailbox (src, dst, G) -> (me=dst, src=partition, G)
+        return jnp.swapaxes(x, 0, 1)
+
+    def diag(x):  # (R, P, ...) -> (R, ...) at part == replica
+        return jnp.stack([x[p, p] for p in range(R)], axis=0)
 
     # ---------------- P2a: accept for partition == src ------------------
     m = inbox["p2a"]
-    # scatter (src, dst) messages into [dst(replica), src(partition), slot]
-    v = jnp.transpose(m["valid"])                  # (dst, src)
-    slot = jnp.transpose(m["slot"])
-    cmd = jnp.transpose(m["cmd"])
-    oh = v[:, :, None] & (sidx[None, None, :] == slot[:, :, None])
+    v = T(m["valid"])                              # (me, part, G)
+    slot = T(m["slot"])                            # absolute
+    cmd = T(m["cmd"])
+    rel = slot - base                              # (me, part, G) ring pos
+    inw = (rel >= 0) & (rel < S)
+    oh = (v & inw)[:, :, None, :] & (sidx[None, None, :, None]
+                                     == rel[:, :, None, :])
     wr = oh & ~log_commit                          # committed entries frozen
-    log_cmd = jnp.where(wr, cmd[:, :, None], log_cmd)
-    # reply to the leader: outbox planes are [sender, recipient]; the
-    # sender is this acceptor (our dst axis), the recipient the p2a's src
-    out_p2b = {"valid": v, "slot": slot}
+    log_cmd = jnp.where(wr, cmd[:, :, None, :], log_cmd)
+    # ack ONLY what we durably stored (a slot outside our window was
+    # dropped; acking it would let the leader commit an entry no
+    # majority holds).  Reply planes are [sender=me, recipient=part].
+    out_p2b = {"valid": v & inw, "slot": slot}
 
     # ---------------- P2b: leader tallies, commits own partition --------
     m = inbox["p2b"]
-    okb = jnp.transpose(m["valid"])                # (ldr, src)
-    bslot = jnp.transpose(m["slot"])
-    add = okb[:, :, None] & (sidx[None, None, :] == bslot[:, :, None])
-    acks = acks | jnp.transpose(add, (0, 2, 1))    # (ldr, slot, src)
-    mine = log_cmd[ridx, ridx]                     # (ldr, S) my partition log
-    newly = ((jnp.sum(acks, axis=2) >= MAJ) & (mine != NO_CMD)
-             & ~log_commit[ridx, ridx])
-    self_part = ridx[:, None, None] == ridx[None, :, None]  # (rep,part,1)
-    log_commit = log_commit | (self_part & newly[:, None, :])
+    okb = T(m["valid"])                            # (ldr, src, G)
+    bslot = T(m["slot"])
+    base_own = diag(base)                          # (ldr, G)
+    brel = bslot - base_own[:, None, :]            # (ldr, src, G)
+    for s in range(R):
+        oh_s = okb[:, s][:, None, :] & (sidx[None, :, None]
+                                        == brel[:, s][:, None, :])
+        acks = acks | jnp.where(oh_s, jnp.int32(1) << s, 0)
+    mine = diag(log_cmd)                           # (ldr, S, G)
+    mine_com = diag(log_commit)
+    newly = ((jax.lax.population_count(acks) >= MAJ)
+             & (mine != NO_CMD) & ~mine_com)
+    part_oh = (ridx[:, None] == ridx[None, :])[:, :, None, None]  # (R,P,1,1)
+    log_commit = log_commit | (part_oh & newly[:, None])
 
     # ---------------- P3: commit notifications for partition == src -----
     m = inbox["p3"]
-    v = jnp.transpose(m["valid"])                  # (dst, src)
-    slot = jnp.transpose(m["slot"])
-    cmd = jnp.transpose(m["cmd"])
-    upto = jnp.transpose(m["upto"])
-    oh = v[:, :, None] & (sidx[None, None, :] == slot[:, :, None])
-    log_cmd = jnp.where(oh, cmd[:, :, None], log_cmd)
+    v = T(m["valid"])                              # (me, part, G)
+    slot = T(m["slot"])
+    cmd = T(m["cmd"])
+    upto = T(m["upto"])
+    lowslot = T(m["lowslot"])
+    rel = slot - base
+    inw = (rel >= 0) & (rel < S)
+    oh = (v & inw)[:, :, None, :] & (sidx[None, None, :, None]
+                                     == rel[:, :, None, :])
+    log_cmd = jnp.where(oh, cmd[:, :, None, :], log_cmd)
     log_commit = log_commit | oh
     # frontier rule: a static leader proposes exactly one command per
     # slot, so any locally-accepted slot < upto is safe to commit
-    ohu = (v[:, :, None] & (sidx[None, None, :] < upto[:, :, None])
+    abs_ = base[:, :, None, :] + sidx[None, None, :, None]
+    ohu = (v[:, :, None, :] & (abs_ < upto[:, :, None, :])
            & (log_cmd != NO_CMD))
     log_commit = log_commit | ohu
+
+    # ---------------- P3: snapshot catch-up for deep laggards -----------
+    # my frontier for this partition fell below the leader's window base:
+    # the slots I need were recycled at the leader.  Adopt the leader's
+    # partition row (log, base, execute) and KV stripe by reference.
+    adopt = v & (execute < lowslot) & ~part_oh[:, :, 0, 0][..., None]
+    new_rows_cmd, new_rows_com = [], []
+    new_base_p, new_exec_p = [], []
+    for p in range(R):
+        mp = adopt[:, p]                           # (me, G)
+        new_rows_cmd.append(jnp.where(
+            mp[:, None, :], log_cmd[p, p][None], log_cmd[:, p]))
+        new_rows_com.append(jnp.where(
+            mp[:, None, :], log_commit[p, p][None], log_commit[:, p]))
+        new_base_p.append(jnp.where(mp, base[p, p][None], base[:, p]))
+        new_exec_p.append(jnp.where(mp, execute[p, p][None],
+                                    execute[:, p]))
+        stripe = (kidx % R == p)[None, :, None]
+        kv = jnp.where(mp[:, None, :] & stripe, kv[p][None], kv)
+    log_cmd = jnp.stack(new_rows_cmd, axis=1)
+    log_commit = jnp.stack(new_rows_com, axis=1)
+    base = jnp.stack(new_base_p, axis=1)
+    execute = jnp.stack(new_exec_p, axis=1)
+    base_own = diag(base)
 
     # ---------------- leader proposes in its own partition --------------
     # new slot while the pipe is healthy; retransmit the frontier slot
     # when it has stalled for retry_timeout steps (lost p2a/p2b)
-    my_exec = execute[ridx, ridx]                  # (ldr,)
+    my_exec = diag(execute)                        # (ldr, G)
     retry = state["stuck"] >= cfg.retry_timeout
-    can_new = next_slot < S
-    prop_slot = jnp.where(retry, jnp.clip(my_exec, 0, S - 1),
-                          next_slot).astype(jnp.int32)
+    can_new = next_slot - base_own < S             # window flow control
+    prop_slot = jnp.where(retry, my_exec, next_slot)   # absolute
     do = can_new | retry
-    new_cmd = encode_cmd(ridx, prop_slot)
-    re_cmd = mine[ridx, jnp.clip(prop_slot, 0, S - 1)]
+    prop_rel = jnp.clip(prop_slot - base_own, 0, S - 1)
+    oh_p = sidx[None, :, None] == prop_rel[:, None, :]   # (ldr, S, G)
+    new_cmd = encode_cmd(ridx[:, None], prop_slot)
+    re_cmd = jnp.sum(jnp.where(oh_p, mine, 0), axis=1)
     prop_cmd = jnp.where(retry & (re_cmd != NO_CMD), re_cmd, new_cmd)
     # self-accept + self-ack
-    ohp = do[:, None] & (sidx[None, :] == prop_slot[:, None])
-    self_row = self_part & ohp[:, None, :]
-    log_cmd = jnp.where(self_row & ~log_commit, prop_cmd[:, None, None],
-                        log_cmd)
-    acks = acks | (ohp[:, :, None] & (ridx[None, None, :] == ridx[:, None, None]))
+    wr_self = (do[:, None, :] & oh_p)[:, None] & part_oh  # (R, P, S, G)
+    log_cmd = jnp.where(wr_self & ~log_commit,
+                        prop_cmd[:, None, None, :], log_cmd)
+    acks = acks | jnp.where(do[:, None, :] & oh_p,
+                            (jnp.int32(1) << ridx)[:, None, None], 0)
     next_slot = next_slot + (do & ~retry & can_new)
     out_p2a = {
-        "valid": jnp.broadcast_to(do[:, None], (R, R)),
-        "slot": jnp.broadcast_to(prop_slot[:, None], (R, R)),
-        "cmd": jnp.broadcast_to(prop_cmd[:, None], (R, R)),
+        "valid": jnp.broadcast_to(do[:, None, :], (R, R, G)),
+        "slot": jnp.broadcast_to(prop_slot[:, None, :], (R, R, G)),
+        "cmd": jnp.broadcast_to(prop_cmd[:, None, :], (R, R, G)),
     }
 
     # ---------------- execute committed prefixes, apply to KV -----------
     # each replica advances R independent frontiers; keys are partition-
     # striped (key = part + R * hash) so applies never conflict
-    advanced = jnp.zeros((R, R), jnp.int32)
-    running = jnp.ones((R, R), bool)
+    advanced = jnp.zeros((R, R, G), jnp.int32)
+    running = jnp.ones((R, R, G), bool)
+    kspace = max(K // R, 1)
     for e in range(cfg.exec_window):
-        idx = jnp.clip(execute + e, 0, S - 1)      # (rep, part)
-        inb = (execute + e) < S
-        com = jnp.take_along_axis(log_commit, idx[:, :, None], axis=2)[..., 0]
-        running = running & com & inb
-        cmd_e = jnp.take_along_axis(log_cmd, idx[:, :, None], axis=2)[..., 0]
-        key_e = (ridx[None, :] + R * fib_key(cmd_e, max(K // R, 1))) % K
+        rel_e = execute + e - base                  # (rep, part, G)
+        oh_e = sidx[None, None, :, None] == rel_e[:, :, None, :]
+        com = jnp.any(oh_e & log_commit, axis=2)
+        running = running & com
+        cmd_e = jnp.sum(jnp.where(oh_e, log_cmd, 0), axis=2)
+        key_e = (ridx[None, :, None] + R * fib_key(cmd_e, kspace)) % K
         wr = running & (cmd_e >= 0)
-        ohk = wr[:, :, None] & (jnp.arange(K)[None, None, :] == key_e[:, :, None])
+        ohk = wr[:, :, None, :] & (kidx[None, None, :, None]
+                                   == key_e[:, :, None, :])
         kv = jnp.where(jnp.any(ohk, axis=1),
-                       jnp.max(jnp.where(ohk, cmd_e[:, :, None], -1), axis=1),
+                       jnp.max(jnp.where(ohk, cmd_e[:, :, None, :], -1),
+                               axis=1),
                        kv)
         advanced = advanced + running
     new_execute = execute + advanced
 
     # ---------------- stuck-frontier counter (drives retransmits) -------
-    my_exec_new = new_execute[ridx, ridx]
+    my_exec_new = diag(new_execute)
     stalled = (my_exec_new == my_exec) & (next_slot > my_exec_new)
     stuck = jnp.where(retry, 0, jnp.where(stalled, state["stuck"] + 1, 0))
 
     # ---------------- P3 out: newly committed or frontier retransmit ----
-    low_new = jnp.argmin(jnp.where(newly, sidx[None, :], S), axis=1)
+    low_new = jnp.argmin(jnp.where(newly, sidx[None, :, None], S), axis=1)
     any_new = jnp.any(newly, axis=1)
-    # otherwise cycle retransmits through my committed prefix (leader-
-    # local knowledge only: laggards' holes are all < my frontier, so a
-    # round-robin over it eventually re-covers every hole)
-    rr = ctx.t % jnp.maximum(my_exec_new, 1)
-    p3_slot = jnp.where(any_new, low_new,
-                        jnp.clip(rr, 0, S - 1)).astype(jnp.int32)
-    p3_committed = log_commit[ridx, ridx, p3_slot]
-    p3_cmd = mine[ridx, p3_slot]
-    p3_do = p3_committed
-    my_upto = new_execute[ridx, ridx]
+    # otherwise cycle retransmits through my in-window committed prefix
+    # (deep laggards are healed by snapshot adoption instead)
+    span = jnp.maximum(my_exec_new - base_own, 1)
+    rr = ctx.t % span
+    p3_rel = jnp.where(any_new, low_new, rr).astype(jnp.int32)
+    p3_rel = jnp.clip(p3_rel, 0, S - 1)
+    oh_3 = sidx[None, :, None] == p3_rel[:, None, :]
+    p3_committed = jnp.any(oh_3 & diag(log_commit), axis=1)
+    p3_cmd = jnp.sum(jnp.where(oh_3, diag(log_cmd), 0), axis=1)
     out_p3 = {
-        "valid": jnp.broadcast_to(p3_do[:, None], (R, R)),
-        "slot": jnp.broadcast_to(p3_slot[:, None], (R, R)),
-        "cmd": jnp.broadcast_to(p3_cmd[:, None], (R, R)),
-        "upto": jnp.broadcast_to(my_upto[:, None], (R, R)),
+        "valid": jnp.broadcast_to(p3_committed[:, None, :], (R, R, G)),
+        "slot": jnp.broadcast_to((base_own + p3_rel)[:, None, :],
+                                 (R, R, G)),
+        "cmd": jnp.broadcast_to(p3_cmd[:, None, :], (R, R, G)),
+        "upto": jnp.broadcast_to(my_exec_new[:, None, :], (R, R, G)),
+        "lowslot": jnp.broadcast_to(base_own[:, None, :], (R, R, G)),
     }
 
+    # ---------------- slide the ring windows (slot recycling) -----------
+    new_base = jnp.maximum(base, new_execute - RETAIN)
+    adv = new_base - base                           # (rep, part, G)
+    log_cmd = shift_window(log_cmd, adv, NO_CMD)
+    log_commit = shift_window(log_commit, adv, False)
+    acks = shift_window(acks, diag(adv), 0)
+
     new_state = dict(
-        log_cmd=log_cmd, log_commit=log_commit, acks=acks,
+        log_cmd=log_cmd, log_commit=log_commit, base=new_base, acks=acks,
         next_slot=next_slot, execute=new_execute, kv=kv, stuck=stuck,
     )
     outbox = {"p2a": out_p2a, "p2b": out_p2b, "p3": out_p3}
@@ -197,26 +279,40 @@ def metrics(state, cfg: SimConfig):
     """Committed slots summed over all partitions (most advanced copy)."""
     return {
         "committed_slots": jnp.sum(jnp.max(state["execute"], axis=0)),
-        "min_execute": jnp.min(state["execute"]),
+        "min_execute": jnp.sum(jnp.min(state["execute"], axis=(0, 1))),
     }
 
 
 def invariants(old, new, cfg: SimConfig) -> jax.Array:
-    """1. Agreement: committed commands for a (partition, slot) agree.
-    2. Stability: committed entries never change or un-commit.
-    3. Executed prefix is committed."""
+    """1. Agreement: committed commands for a (partition, slot) agree —
+    checked on the base-aligned common window.  2. Stability: committed
+    entries never change or un-commit while ring-resident; the window
+    only recycles executed slots.  3. Executed prefix is committed
+    (within the window)."""
     BIG = jnp.int32(2**30)
-    c, cmd = new["log_commit"], new["log_cmd"]
-    mx = jnp.max(jnp.where(c, cmd, -BIG), axis=0)   # (part, slot)
-    mn = jnp.min(jnp.where(c, cmd, BIG), axis=0)
-    n_c = jnp.sum(c, axis=0)
+    S = cfg.n_slots
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    base, c, cmd = new["base"], new["log_commit"], new["log_cmd"]
+
+    # 1. agreement on the aligned window per partition
+    align = jnp.max(base, axis=0)[None] - base      # (rep, part, G)
+    a_c = shift_window(c, align, False)
+    a_cmd = shift_window(cmd, align, NO_CMD)
+    mx = jnp.max(jnp.where(a_c, a_cmd, -BIG), axis=0)   # (part, S, G)
+    mn = jnp.min(jnp.where(a_c, a_cmd, BIG), axis=0)
+    n_c = jnp.sum(a_c, axis=0)
     v_agree = jnp.sum((n_c >= 1) & (mx != mn))
 
-    was = old["log_commit"]
-    v_stable = jnp.sum(was & (~c | (cmd != old["log_cmd"])))
+    # 2. stability + only-executed-recycled
+    adv = base - old["base"]
+    o_c = shift_window(old["log_commit"], adv, False)
+    o_cmd = shift_window(old["log_cmd"], adv, NO_CMD)
+    v_stable = jnp.sum(o_c & (~c | (cmd != o_cmd)))
+    v_stable = v_stable + jnp.sum(new["execute"] < base)
 
-    prefix_len = jnp.sum(jnp.cumprod(c.astype(jnp.int32), axis=2), axis=2)
-    v_exec = jnp.sum(new["execute"] > prefix_len)
+    # 3. executed prefix committed (ring positions below the frontier)
+    abs_ = base[:, :, None, :] + sidx[None, None, :, None]
+    v_exec = jnp.sum((abs_ < new["execute"][:, :, None, :]) & ~c)
 
     return (v_agree + v_stable + v_exec).astype(jnp.int32)
 
@@ -228,4 +324,5 @@ PROTOCOL = SimProtocol(
     step=step,
     metrics=metrics,
     invariants=invariants,
+    batched=True,
 )

@@ -59,6 +59,9 @@ import jax.numpy as jnp
 import jax.random as jr
 
 from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim.ring import shift_row as _shift_row
+from paxi_tpu.sim.ring import shift_window as _shift
+from paxi_tpu.sim.ring import take_replica as _take_replica
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 NO_CMD = -1    # empty log entry
@@ -86,30 +89,6 @@ def cmd_key(cmd, n_keys):
     return fib_key(cmd, n_keys)
 
 
-def _shift(arr, adv, fill):
-    """Slide ``arr`` (R, S, G) forward along the slot axis by per-(r, g)
-    ``adv`` >= 0: out[r, i, g] = arr[r, i + adv[r, g], g] (or ``fill``
-    past the end).  The ring-recycling / base-alignment primitive."""
-    S = arr.shape[1]
-    idx = jnp.arange(S, dtype=jnp.int32)[None, :, None] + adv[:, None, :]
-    valid = (idx >= 0) & (idx < S)
-    idxc = jnp.clip(idx, 0, S - 1)
-    return jnp.where(valid, jnp.take_along_axis(arr, idxc, axis=1), fill)
-
-
-def _shift_row(row, adv, fill):
-    """Like :func:`_shift` but from a single source replica's plane:
-    row (S, G) viewed by R readers with per-(r, g) offsets ``adv`` —
-    out[r, i, g] = row[i + adv[r, g], g]."""
-    R = adv.shape[0]
-    S, G = row.shape
-    idx = jnp.arange(S, dtype=jnp.int32)[None, :, None] + adv[:, None, :]
-    valid = (idx >= 0) & (idx < S)
-    idxc = jnp.clip(idx, 0, S - 1)
-    src = jnp.broadcast_to(row[None], (R, S, G))
-    return jnp.where(valid, jnp.take_along_axis(src, idxc, axis=1), fill)
-
-
 def _pick_src(field, src_idx):
     """out[d, g] = field[src_idx[d, g], d, g] — select each destination's
     chosen sender's message, unrolled over the tiny src axis (masked
@@ -117,19 +96,6 @@ def _pick_src(field, src_idx):
     acc = jnp.zeros_like(field[0])
     for s in range(field.shape[0]):
         acc = jnp.where(src_idx == s, field[s], acc)
-    return acc
-
-
-def _take_replica(x, idx):
-    """out[r, ..., g] = x[idx[r, g], ..., g] — adopt another replica's
-    row of a (R, ..., G) state array, unrolled over R."""
-    R = x.shape[0]
-    mid = x.ndim - 2
-    mshape = (idx.shape[0],) + (1,) * mid + (idx.shape[-1],)
-    acc = jnp.zeros(mshape[:1] + x.shape[1:], x.dtype)
-    for s in range(R):
-        m = (idx == s).reshape(mshape)
-        acc = jnp.where(m, x[s][None], acc)
     return acc
 
 
@@ -225,8 +191,12 @@ def step(state, inbox, ctx: StepCtx):
     kv = jnp.where(el_ad[:, None, :], _take_replica(kv, f_src), kv)
     execute = jnp.where(el_ad, front, execute)
     next_slot = jnp.where(el_ad, jnp.maximum(next_slot, front), next_slot)
-    adv_el = jnp.where(el_ad, _take_replica(base, f_src) - base, 0)
-    base = jnp.where(el_ad, _take_replica(base, f_src), base)
+    # never adopt a LOWER base: a negative self-shift would drop my own
+    # top-of-window entries (possibly committed via P3).  The merge below
+    # tolerates ackers whose base is below mine (front-fill only).
+    f_base = _take_replica(base, f_src)
+    adv_el = jnp.where(el_ad, jnp.maximum(f_base - base, 0), 0)
+    base = jnp.where(el_ad, jnp.maximum(f_base, base), base)
     log_bal = _shift(log_bal, adv_el, 0)
     log_cmd = _shift(log_cmd, adv_el, NO_CMD)
     log_commit = _shift(log_commit, adv_el, False)

@@ -52,32 +52,12 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
-from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.ops.hashing import fib_key  # noqa: F401 (re-export parity)
+# one definition of the wire/command encoding for both layouts — a tweak
+# to either must reach the parity test and the bench backend switch
+from paxi_tpu.protocols.paxos.sim import (NO_CMD, NOOP, cmd_key,
+                                          encode_cmd, mailbox_spec)
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
-
-NO_CMD = -1    # empty log entry
-NOOP = -2      # hole filled by a recovering leader
-
-
-def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
-    return {
-        "p1a": ("bal",),
-        "p1b": ("bal",),
-        "p2a": ("bal", "slot", "cmd"),
-        "p2b": ("bal", "slot"),
-        "p3": ("bal", "slot", "cmd", "upto"),
-    }
-
-
-def encode_cmd(bal, slot):
-    """Unique-ish command id per (ballot, slot) — lets the agreement
-    oracle catch divergent decisions. Doubles as the KV write payload."""
-    return ((bal & 0x7FFF) << 16) | (slot & 0xFFFF)
-
-
-def cmd_key(cmd, n_keys):
-    """Hash the command id onto the KV key space."""
-    return fib_key(cmd, n_keys)
 
 
 def _shift(arr, adv, fill):
@@ -172,8 +152,11 @@ def step(state, inbox, ctx: StepCtx):
     kv = jnp.where(el_ad[:, None], kv[f_src], kv)
     execute = jnp.where(el_ad, front, execute)
     next_slot = jnp.where(el_ad, jnp.maximum(next_slot, front), next_slot)
-    adv_el = jnp.where(el_ad, base[f_src] - base, 0)
-    base = jnp.where(el_ad, base[f_src], base)
+    # never adopt a LOWER base: a negative self-shift would drop my own
+    # top-of-window entries (possibly committed via P3).  The merge below
+    # tolerates ackers whose base is below mine (front-fill only).
+    adv_el = jnp.where(el_ad, jnp.maximum(base[f_src] - base, 0), 0)
+    base = jnp.where(el_ad, jnp.maximum(base[f_src], base), base)
     log_bal = _shift(log_bal, adv_el, 0)
     log_cmd = _shift(log_cmd, adv_el, NO_CMD)
     log_commit = _shift(log_commit, adv_el, False)

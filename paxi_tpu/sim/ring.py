@@ -1,0 +1,56 @@
+"""Sliding-window ring primitives shared by lane-major sim kernels.
+
+The reference keeps unbounded per-slot maps (``log map[int]*entry``,
+paxos.go [driver]); inside a jitted kernel the log must be a fixed-shape
+ring instead: ring position ``i`` holds absolute slot ``base + i`` and
+the window slides forward as the execute frontier advances (SURVEY §7
+slot-recycling requirement — a 10M-slot horizon runs in a 64-slot ring).
+
+These helpers operate on lane-major arrays (group axis LAST, slot axis
+second-to-last) so every protocol kernel shares one shift
+implementation: paxos (R, S, G), kpaxos (R, P, S, G), wpaxos
+(R, O, S, G), ...
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def shift_window(arr, adv, fill):
+    """Slide ``arr (..., S, G)`` forward along the slot axis by
+    ``adv (..., G)`` >= 0: out[..., i, g] = arr[..., i + adv[..., g], g]
+    (``fill`` past the end).  The ring-recycling / base-alignment
+    primitive."""
+    S = arr.shape[-2]
+    idx = jnp.arange(S, dtype=jnp.int32)[:, None] + adv[..., None, :]
+    valid = (idx >= 0) & (idx < S)
+    idxc = jnp.clip(idx, 0, S - 1)
+    return jnp.where(valid, jnp.take_along_axis(arr, idxc, axis=-2), fill)
+
+
+def shift_row(row, adv, fill):
+    """Like :func:`shift_window` but from a single source plane viewed
+    by R readers with per-(r, g) offsets: row ``(S, G)``, adv ``(R, G)``
+    -> out[r, i, g] = row[i + adv[r, g], g]."""
+    R = adv.shape[0]
+    S, G = row.shape
+    idx = jnp.arange(S, dtype=jnp.int32)[None, :, None] + adv[:, None, :]
+    valid = (idx >= 0) & (idx < S)
+    idxc = jnp.clip(idx, 0, S - 1)
+    src = jnp.broadcast_to(row[None], (R, S, G))
+    return jnp.where(valid, jnp.take_along_axis(src, idxc, axis=1), fill)
+
+
+def take_replica(x, idx):
+    """out[r, ..., g] = x[idx[r, g], ..., g] — adopt another replica's
+    row of a (R, ..., G) state array, unrolled over the tiny R axis
+    (masked selects instead of an XLA gather)."""
+    R = x.shape[0]
+    mid = x.ndim - 2
+    mshape = (idx.shape[0],) + (1,) * mid + (idx.shape[-1],)
+    acc = jnp.zeros(mshape[:1] + x.shape[1:], x.dtype)
+    for s in range(R):
+        m = (idx == s).reshape(mshape)
+        acc = jnp.where(m, x[s][None], acc)
+    return acc
