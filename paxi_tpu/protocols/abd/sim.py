@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim.ring import require_packable
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 IDLE, QUERY, STORE = 0, 1, 2
@@ -65,9 +66,7 @@ def op_key_for(ridx, seq, n_keys):
 def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
     R, K, G = cfg.n_replicas, cfg.n_keys, n_groups
     del rng
-    if R > 31:
-        raise ValueError(f"n_replicas={R} > 31: packed int32 ack masks "
-                         "support at most 31 replicas per group")
+    require_packable(R)
     i32 = jnp.int32
     return dict(
         store_ts=jnp.zeros((R, K, G), i32),

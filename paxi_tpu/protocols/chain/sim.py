@@ -169,8 +169,8 @@ def step(state, inbox, ctx: StepCtx):
     known_succ = known_succ + send
 
     # ------------- repair: retransmit the oldest unacked entry -----------
-    r_send = (~is_tail) & (applied > seen_succ) & (seen_succ >= 0)
-    r_seq = jnp.maximum(seen_succ, 0)
+    r_send = (~is_tail) & (applied > seen_succ)
+    r_seq = seen_succ
     oh_r2 = sidx[None, :, None] == (r_seq % S)[:, None, :]
     out_rep = {
         "valid": r_send[:, None, :] & to_succ,

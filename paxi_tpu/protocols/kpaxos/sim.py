@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from paxi_tpu.ops.hashing import fib_key
-from paxi_tpu.sim.ring import shift_window
+from paxi_tpu.sim.ring import require_packable, shift_window
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 NO_CMD = -1
@@ -64,9 +64,7 @@ def encode_cmd(part, slot):
 def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
     R, S, K, G = cfg.n_replicas, cfg.n_slots, cfg.n_keys, n_groups
     del rng
-    if R > 31:
-        raise ValueError(f"n_replicas={R} > 31: packed int32 ack masks "
-                         "support at most 31 replicas per group")
+    require_packable(R)
     i32 = jnp.int32
     return dict(
         # replica-of-record ring logs: [replica, partition, slot, G]

@@ -59,6 +59,7 @@ import jax.numpy as jnp
 import jax.random as jr
 
 from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim.ring import require_packable
 from paxi_tpu.sim.ring import shift_row as _shift_row
 from paxi_tpu.sim.ring import shift_window as _shift
 from paxi_tpu.sim.ring import take_replica as _take_replica
@@ -104,9 +105,7 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
     del rng
     # ack masks are int32 bitfields; bit 31 is the sign bit — shifts wrap
     # mod 32 in XLA, so replica 32 would silently alias replica 0
-    if R > 31:
-        raise ValueError(f"n_replicas={R} > 31: packed int32 ack masks "
-                         "support at most 31 replicas per group")
+    require_packable(R)
     i32 = jnp.int32
     return dict(
         ballot=jnp.zeros((R, G), i32),        # highest ballot seen/promised

@@ -10,23 +10,35 @@ commits stay inside the owner's zone — the WAN latency win the paper
 dissects).  BASELINE config: 3x3 zone grid, locality-skewed workload.
 
 TPU re-design (not a translation):
+- **Lane-major batch layout** (see sim/lanes.py): state ``(R, O, G)`` /
+  ``(R, O, S, G)``, mailbox planes ``(src, dst, G)`` — the group axis
+  feeds the 8x128 vector lanes.
 - Replicas r in 0..R-1 are arranged in Z zones of R/Z nodes,
   ``zone(r) = r // (R/Z)``.
-- Per-object per-replica log SoA: ``log_{bal,cmd,commit}[R, O, S]`` and
-  a 4-D phase-2 ack matrix ``log_acks[R, O, S, R]``; quorum tests are
-  zone-segmented popcounts (zone-majority per zone, then >= q1 / q2
-  zones).
+- Per-object per-replica log SoA over a sliding **ring** of S slots
+  (sim/ring.py): position i holds absolute slot ``base[r, o] + i``;
+  each (replica, object) window slides with its execute frontier
+  (SURVEY §7 slot recycling — unbounded horizon).  Messages carry
+  absolute slots; acceptors ack only what they durably stored.
+- ``Quorum.ACK`` is a **bit-packed int32 ack mask** per (owner, object,
+  slot); grid-quorum tests are per-zone popcounts over bit ranges
+  (zone-majority per zone, then >= q1 / q2 zones — quorum.go).
 - The workload generator is in-kernel: each replica demands one object
-  per step, drawn home-zone-biased (``cfg.locality``).  Owners propose
-  for the demanded object; non-owners accumulate per-object demand
-  (``hits``) — the requester-side form of policy.go's counters — and
-  fire a phase-1 steal at ``steal_threshold``.
+  per step, drawn home-zone-biased (``cfg.locality``) with one shaped
+  draw per plane from the step key.  Owners propose for the demanded
+  object; non-owners accumulate per-object demand (``hits``) — the
+  requester-side form of policy.go's counters — and fire a phase-1
+  steal at ``steal_threshold``.
 - At most one steal is in flight per replica (``steal_obj``); P1b acks
   are merged with the same by-reference log-merge argument as the
-  paxos kernel (acceptor logs only grow in ballot).
+  paxos kernel (acceptor logs only grow in ballot), base-aligned to
+  the max acker base so no committed entry is ever dropped.
+- P3 carries the owner's window base (``lowslot``): a replica whose
+  frontier fell below it adopts the owner's object row (log, base,
+  execute, register) by reference — snapshot catch-up for laggards.
 - All handlers are fully masked; messages for *different* objects from
   different sources in the same step are all applied via dense
-  (dst, src, O) one-hot scatters, per-(dst, obj) max-ballot selected.
+  (dst, obj) one-hot scatters, per-(dst, obj) max-ballot selected.
 """
 
 from __future__ import annotations
@@ -37,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
+from paxi_tpu.sim.ring import require_packable, shift_window
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 NO_CMD = -1
@@ -49,7 +62,7 @@ def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
         "p1b": ("obj", "bal"),
         "p2a": ("obj", "bal", "slot", "cmd"),
         "p2b": ("obj", "bal", "slot"),
-        "p3": ("obj", "bal", "slot", "cmd", "upto"),
+        "p3": ("obj", "bal", "slot", "cmd", "upto", "lowslot"),
     }
 
 
@@ -57,43 +70,50 @@ def encode_cmd(bal, slot):
     return ((bal & 0x7FFF) << 16) | (slot & 0xFFFF)
 
 
-def _zone_of(ridx, npz):
-    return ridx // npz
-
-
 def _zone_quorums(acks, cfg: SimConfig):
-    """acks: (..., R) boolean -> (...,) count of zones with a
-    zone-majority of acks (the flexible-grid primitive, quorum.go)."""
+    """acks: (...) int32 bit-packed over replicas -> (...) count of
+    zones holding a zone-majority of acks (the flexible-grid primitive,
+    quorum.go)."""
     Z = cfg.n_zones
     npz = cfg.n_replicas // Z
-    per_zone = jnp.sum(acks.reshape(acks.shape[:-1] + (Z, npz)), axis=-1)
-    return jnp.sum(per_zone >= (npz // 2 + 1), axis=-1)
+    zmaj = npz // 2 + 1
+    cnt = jnp.zeros(acks.shape, jnp.int32)
+    for z in range(Z):
+        zmask = jnp.int32(((1 << npz) - 1) << (z * npz))
+        per = jax.lax.population_count(acks & zmask)
+        cnt = cnt + (per >= zmaj)
+    return cnt
 
 
-def init_state(cfg: SimConfig, rng: jax.Array):
-    R, O, S = cfg.n_replicas, cfg.n_objects, cfg.n_slots
+def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
+    R, O, S, G = cfg.n_replicas, cfg.n_objects, cfg.n_slots, n_groups
     del rng
-    ridx = jnp.arange(R, dtype=jnp.int32)
-    oidx = jnp.arange(O, dtype=jnp.int32)
+    require_packable(R)
+    i32 = jnp.int32
+    ridx = jnp.arange(R, dtype=i32)
+    oidx = jnp.arange(O, dtype=i32)
     owner0 = oidx % R                      # initial round-robin ownership
     return dict(
         # per-object ballots: round 1, owner0 (everyone agrees at init)
-        ballot=jnp.broadcast_to(cfg.ballot_stride + owner0[None, :],
-                                (R, O)).astype(jnp.int32),
-        active=(ridx[:, None] == owner0[None, :]),
-        log_bal=jnp.zeros((R, O, S), jnp.int32),
-        log_cmd=jnp.full((R, O, S), NO_CMD, jnp.int32),
-        log_commit=jnp.zeros((R, O, S), bool),
-        log_acks=jnp.zeros((R, O, S, R), bool),
-        proposed=jnp.zeros((R, O, S), bool),
-        next_slot=jnp.zeros((R, O), jnp.int32),
-        execute=jnp.zeros((R, O), jnp.int32),
-        kv=jnp.zeros((R, O), jnp.int32),       # object register (last cmd)
-        hits=jnp.zeros((R, O), jnp.int32),     # policy demand counters
-        steal_obj=jnp.full((R,), -1, jnp.int32),
-        p1_acks=jnp.zeros((R, R), bool),       # for the in-flight steal
-        steal_timer=jnp.zeros((R,), jnp.int32),
-        steals=jnp.zeros((), jnp.int32),       # completed steals (metric)
+        ballot=jnp.broadcast_to(
+            (cfg.ballot_stride + owner0)[None, :, None], (R, O, G)
+        ).astype(i32),
+        active=jnp.broadcast_to(
+            (ridx[:, None] == owner0[None, :])[..., None], (R, O, G)),
+        log_bal=jnp.zeros((R, O, S, G), i32),
+        log_cmd=jnp.full((R, O, S, G), NO_CMD, i32),
+        log_commit=jnp.zeros((R, O, S, G), bool),
+        log_acks=jnp.zeros((R, O, S, G), i32),   # bit-packed over src
+        proposed=jnp.zeros((R, O, S, G), bool),
+        base=jnp.zeros((R, O, G), i32),          # abs slot of ring pos 0
+        next_slot=jnp.zeros((R, O, G), i32),     # absolute
+        execute=jnp.zeros((R, O, G), i32),       # absolute frontier
+        kv=jnp.zeros((R, O, G), i32),      # object register (last cmd)
+        hits=jnp.zeros((R, O, G), i32),    # policy demand counters
+        steal_obj=jnp.full((R, G), -1, i32),
+        p1_acks=jnp.zeros((R, G), i32),    # bit-packed, in-flight steal
+        steal_timer=jnp.zeros((R, G), i32),
+        steals=jnp.zeros((G,), i32),       # completed steals (metric)
     )
 
 
@@ -101,56 +121,75 @@ def step(state, inbox, ctx: StepCtx):
     cfg = ctx.cfg
     R, O, S = cfg.n_replicas, cfg.n_objects, cfg.n_slots
     Z, STRIDE = cfg.n_zones, cfg.ballot_stride
-    npz = R // Z
     Q1 = Z - cfg.grid_q2 + 1
     Q2 = cfg.grid_q2
+    RETAIN = max(S // 2, 1)
     ridx = jnp.arange(R, dtype=jnp.int32)
     oidx = jnp.arange(O, dtype=jnp.int32)
     sidx = jnp.arange(S, dtype=jnp.int32)
+    self_bit2 = (jnp.int32(1) << ridx)[:, None]          # (R, 1)
 
-    ballot = state["ballot"]          # (R, O)
+    ballot = state["ballot"]          # (R, O, G)
     active = state["active"]
-    log_bal = state["log_bal"]        # (R, O, S)
+    log_bal = state["log_bal"]        # (R, O, S, G)
     log_cmd = state["log_cmd"]
     log_commit = state["log_commit"]
-    log_acks = state["log_acks"]      # (R, O, S, R)
+    log_acks = state["log_acks"]      # (R, O, S, G) packed
     proposed = state["proposed"]
-    next_slot = state["next_slot"]    # (R, O)
+    base = state["base"]              # (R, O, G)
+    next_slot = state["next_slot"]
     execute = state["execute"]
     kv = state["kv"]
     hits = state["hits"]
-    steal_obj = state["steal_obj"]    # (R,)
-    p1_acks = state["p1_acks"]        # (R, R)
+    steal_obj = state["steal_obj"]    # (R, G)
+    p1_acks = state["p1_acks"]        # (R, G) packed
     steals = state["steals"]
+    G = steal_obj.shape[-1]
+
+    def T(x):  # mailbox (src, dst, G) -> (me=dst, src, G)
+        return jnp.swapaxes(x, 0, 1)
+
+    def at_obj(plane, obj):
+        """plane (R, O, G) selected at obj (R, G) -> (R, G)."""
+        oh = oidx[None, :, None] == obj[:, None, :]
+        return jnp.sum(jnp.where(oh, plane, 0), axis=1)
+
+    def row_at_obj(plane, obj, zero):
+        """plane (R, O, S, G) selected at obj (R, G) -> (R, S, G)."""
+        oh = (oidx[None, :, None, None] == obj[:, None, None, :])
+        return jnp.sum(jnp.where(oh, plane, zero), axis=1)
 
     def per_obj_best(m, extra=()):
         """Select, per (dst, obj), the max-ballot message among sources.
 
-        Returns (has, bal, *extra_fields) each of shape (R, O)."""
-        v = jnp.transpose(m["valid"])                  # (dst, src)
-        ob = jnp.transpose(m["obj"])
-        bl = jnp.transpose(m["bal"])
-        onehot = v[:, :, None] & (ob[:, :, None] == oidx[None, None, :])
-        b3 = jnp.where(onehot, bl[:, :, None], -1)     # (dst, src, O)
-        src_best = jnp.argmax(b3, axis=1)              # (dst, O)
-        bal_best = jnp.max(b3, axis=1)
+        Returns (has, bal, src_best, [extra...]) each (R, O, G)."""
+        v = T(m["valid"])                              # (me, src, G)
+        ob = T(m["obj"])
+        bl = T(m["bal"])
+        onehot = v[:, :, None, :] & (ob[:, :, None, :]
+                                     == oidx[None, None, :, None])
+        b4 = jnp.where(onehot, bl[:, :, None, :], -1)  # (me, src, O, G)
+        bal_best = jnp.max(b4, axis=1)                 # (me, O, G)
         has = bal_best > 0
-
-        def pick(f):
-            f3 = jnp.broadcast_to(jnp.transpose(m[f])[:, :, None], b3.shape)
-            return jnp.take_along_axis(f3, src_best[:, None, :],
-                                       axis=1)[:, 0, :]
-
-        return has, bal_best, src_best, [pick(f) for f in extra]
+        # first (lowest-index) source achieving the max, unrolled
+        src_best = jnp.zeros((R, O, G), jnp.int32)
+        picks = [jnp.zeros((R, O, G), jnp.int32) for _ in extra]
+        for s in range(R - 1, -1, -1):
+            hit = has & (b4[:, s] == bal_best)
+            src_best = jnp.where(hit, s, src_best)
+            for i, f in enumerate(extra):
+                picks[i] = jnp.where(hit, T(m[f])[:, s][:, None, :],
+                                     picks[i])
+        return has, bal_best, src_best, picks
 
     # ---------------- P1a: promise to higher per-object ballots ---------
     m = inbox["p1a"]
     has1, b1, src1, _ = per_obj_best(m)
-    promote = has1 & (b1 > ballot)                     # (dst, O)
+    promote = has1 & (b1 > ballot)                     # (me, O, G)
     ballot = jnp.where(promote, b1, ballot)
     active = active & ~promote
     # a promoted object kills my own in-flight steal of it
-    my_steal_oh = (steal_obj[:, None] == oidx[None, :])
+    my_steal_oh = (steal_obj[:, None, :] == oidx[None, :, None])
     steal_killed = jnp.any(promote & my_steal_oh, axis=1)
     steal_obj = jnp.where(steal_killed, -1, steal_obj)
     # P1b back to the (single) best stealer per promoted object; a replica
@@ -158,137 +197,226 @@ def step(state, inbox, ctx: StepCtx):
     # p1b per edge — reply for the highest-ballot promoted object
     # (stealers retry via steal_timer, so serializing here is safe)
     pb = jnp.where(promote, b1, -1)
-    best_o = jnp.argmax(pb, axis=1)                    # (dst,)
+    best_o = jnp.argmax(pb, axis=1).astype(jnp.int32)  # (me, G)
     any_p = jnp.any(promote, axis=1)
-    to_src = src1[ridx, best_o]
+    to_src = at_obj(src1, best_o)
     out_p1b = {
-        "valid": any_p[:, None] & (ridx[None, :] == to_src[:, None]),
-        "obj": jnp.broadcast_to(best_o[:, None].astype(jnp.int32), (R, R)),
-        "bal": jnp.broadcast_to(ballot[ridx, best_o][:, None], (R, R)),
+        "valid": any_p[:, None, :] & (ridx[None, :, None]
+                                      == to_src[:, None, :]),
+        "obj": jnp.broadcast_to(best_o[:, None, :], (R, R, G)),
+        "bal": jnp.broadcast_to(at_obj(ballot, best_o)[:, None, :],
+                                (R, R, G)),
     }
 
     # ---------------- P1b: stealer tallies grid-quorum acks -------------
     m = inbox["p1b"]
-    v = jnp.transpose(m["valid"])                      # (me, src)
-    ob = jnp.transpose(m["obj"])
-    bl = jnp.transpose(m["bal"])
-    my_obj = steal_obj[:, None]
-    my_bal = ballot[ridx, jnp.clip(steal_obj, 0, O - 1)][:, None]
-    ack = v & (ob == my_obj) & (bl == my_bal) & (steal_obj >= 0)[:, None]
-    p1_acks = p1_acks | ack
-    zq = _zone_quorums(p1_acks, cfg)                   # (me,)
+    v = T(m["valid"])                                  # (me, src, G)
+    ob = T(m["obj"])
+    bl = T(m["bal"])
+    so = jnp.clip(steal_obj, 0, O - 1)
+    my_bal = at_obj(ballot, so)                        # (me, G)
+    ack = (v & (ob == steal_obj[:, None, :])
+           & (bl == my_bal[:, None, :])
+           & (steal_obj >= 0)[:, None, :])             # (me, src, G)
+    p1_acks = p1_acks | jnp.sum(
+        jnp.where(ack, (jnp.int32(1) << ridx)[None, :, None], 0), axis=1)
+    zq = _zone_quorums(p1_acks, cfg)                   # (me, G)
     p1_win = (steal_obj >= 0) & (zq >= Q1)
 
     # ---------------- steal win: adopt object, merge ackers' logs -------
-    so = jnp.clip(steal_obj, 0, O - 1)
-    win_oh = p1_win[:, None] & (oidx[None, :] == so[:, None])   # (R, O)
-    amask = p1_acks                                    # (me, src)
-    # merge the stolen object's log across ackers (by reference)
-    lb_o = log_bal[:, so, :].transpose(1, 0, 2)        # (me, src, S) ... no:
-    # log_bal[src, so[me], slot] -> build via take: for each me, object so[me]
-    lb = jnp.take(log_bal, so, axis=1)                 # (src, me, S)
-    lb = jnp.transpose(lb, (1, 0, 2))                  # (me, src, S)
-    lc = jnp.transpose(jnp.take(log_cmd, so, axis=1), (1, 0, 2))
-    lk = jnp.transpose(jnp.take(log_commit, so, axis=1), (1, 0, 2))
-    lbm = jnp.where(amask[:, :, None], lb, -1)
-    src_best = jnp.argmax(lbm, axis=1)                 # (me, S)
-    best_bal = jnp.max(lbm, axis=1)
-    merged_cmd = jnp.take_along_axis(lc, src_best[:, None, :], axis=1)[:, 0]
-    cmask = amask[:, :, None] & lk
+    # gather every replica's row for MY stolen object via a one-hot
+    # contraction over the object axis, then base-align all rows (and my
+    # own) to the max acker base so no resident entry is dropped
+    so_oh = (oidx[None, :, None] == so[:, None, :])    # (me, O, G)
+    soF = so_oh.astype(jnp.int32)
+    amask = ((p1_acks[:, None, :] >> ridx[None, :, None]) & 1
+             ).astype(bool)                            # (me, src, G)
+    lb = jnp.einsum("rosg,mog->mrsg", log_bal, soF)
+    lc = jnp.einsum("rosg,mog->mrsg", log_cmd, soF)
+    lk = jnp.einsum("rosg,mog->mrsg", log_commit.astype(jnp.int32),
+                    soF).astype(bool)
+    b_src = jnp.einsum("rog,mog->mrg", base, soF)      # (me, src, G)
+    base_so = at_obj(base, so)                         # (me, G)
+    base_star = jnp.maximum(
+        base_so, jnp.max(jnp.where(amask, b_src, 0), axis=1))
+    adv_s = base_star[:, None, :] - b_src              # (me, src, G) >= 0
+    lb = shift_window(lb, adv_s, 0)
+    lc = shift_window(lc, adv_s, NO_CMD)
+    lk = shift_window(lk, adv_s, False)
+    lbm = jnp.where(amask[:, :, None, :], lb, -1)
+    best_bal = jnp.max(lbm, axis=1)                    # (me, S, G)
+    cmask = amask[:, :, None, :] & lk
     merged_commit = jnp.any(cmask, axis=1)
-    csrc = jnp.argmax(cmask, axis=1)
-    committed_cmd = jnp.take_along_axis(lc, csrc[:, None, :], axis=1)[:, 0]
+    merged_cmd = jnp.full((R, S, G), NO_CMD, jnp.int32)
+    committed_cmd = jnp.full((R, S, G), NO_CMD, jnp.int32)
+    for s in range(R - 1, -1, -1):
+        merged_cmd = jnp.where(lbm[:, s] == best_bal, lc[:, s], merged_cmd)
+        committed_cmd = jnp.where(cmask[:, s], lc[:, s], committed_cmd)
     has_acc = (best_bal > 0) | merged_commit
-    top = jnp.max(jnp.where(has_acc, sidx[None, :] + 1, 0), axis=1)  # (me,)
-    my_next = next_slot[ridx, so]
+    abs_m = base_star[:, None, :] + sidx[None, :, None]
+    top = jnp.max(jnp.where(has_acc, abs_m + 1, 0), axis=1)   # (me, G) abs
+    my_next = at_obj(next_slot, so)
     new_next = jnp.maximum(my_next, top)
-    in_win = sidx[None, :] < new_next[:, None]         # (me, S)
+    in_win = abs_m < new_next[:, None, :]              # (me, S, G)
     adopt_cmd = jnp.where(merged_commit, committed_cmd,
                           jnp.where(best_bal > 0, merged_cmd, NOOP))
-    w3 = win_oh[:, :, None]                            # (R, O, 1)
-    iw3 = in_win[:, None, :]                           # (R, 1, S)
-    my_bal2 = ballot[ridx, so]                         # (me,)
-    log_cmd = jnp.where(w3 & iw3, adopt_cmd[:, None, :], log_cmd)
-    log_bal = jnp.where(w3 & iw3, my_bal2[:, None, None], log_bal)
-    log_commit = jnp.where(w3 & iw3,
-                           merged_commit[:, None, :] | log_commit,
+    win_oh = p1_win[:, None, :] & so_oh                # (me, O, G)
+    # shift my own object row to the base_star frame before overwriting
+    adv_me = jnp.where(win_oh, (base_star - base_so)[:, None, :], 0)
+    log_bal = shift_window(log_bal, adv_me, 0)
+    log_cmd = shift_window(log_cmd, adv_me, NO_CMD)
+    log_commit = shift_window(log_commit, adv_me, False)
+    proposed = shift_window(proposed, adv_me, False)
+    log_acks = shift_window(log_acks, adv_me, 0)
+    w4 = win_oh[:, :, None, :]                         # (me, O, 1, G)
+    iw4 = in_win[:, None, :, :]                        # (me, 1, S, G)
+    my_bal_so = at_obj(ballot, so)                     # (me, G)
+    log_cmd = jnp.where(w4 & iw4, adopt_cmd[:, None], log_cmd)
+    log_bal = jnp.where(w4 & iw4, my_bal_so[:, None, None, :], log_bal)
+    log_commit = jnp.where(w4 & iw4,
+                           merged_commit[:, None] | log_commit,
                            log_commit)
-    keep = merged_commit[:, None, :] | jnp.take_along_axis(
-        log_commit, so[:, None, None] * jnp.ones((1, 1, S), jnp.int32),
-        axis=1)[:, 0][:, None, :]
-    proposed = jnp.where(w3, iw3 & keep, proposed)
-    self_only = ridx[None, None, None, :] == ridx[:, None, None, None]
-    log_acks = jnp.where(w3[..., None], iw3[..., None] & self_only,
+    proposed = jnp.where(w4, iw4 & (merged_commit[:, None] | log_commit),
+                         proposed)
+    log_acks = jnp.where(w4, jnp.where(iw4, self_bit2[:, :, None, None], 0),
                          log_acks)
-    next_slot = jnp.where(win_oh, new_next[:, None], next_slot)
+    base = jnp.where(win_oh, base_star[:, None, :], base)
+    next_slot = jnp.where(win_oh, new_next[:, None, :], next_slot)
+    # adopt execute/register from the max-base acker when it is ahead
+    # (its frontier covers everything its base recycled)
+    e_src = jnp.einsum("rog,mog->mrg", execute, soF)
+    k_src = jnp.einsum("rog,mog->mrg", kv, soF)
+    e_am = jnp.where(amask, e_src, -1)
+    f_exec = jnp.max(e_am, axis=1)                     # (me, G)
+    f_kv = jnp.full((R, G), 0, jnp.int32)
+    for s in range(R - 1, -1, -1):
+        f_kv = jnp.where(e_am[:, s] == f_exec, k_src[:, s], f_kv)
+    my_exec_so = at_obj(execute, so)
+    adv_ex = p1_win & (f_exec > my_exec_so)
+    execute = jnp.where(win_oh & adv_ex[:, None, :],
+                        f_exec[:, None, :], execute)
+    kv = jnp.where(win_oh & adv_ex[:, None, :], f_kv[:, None, :], kv)
     active = active | win_oh
-    steals = steals + jnp.sum(p1_win)
+    steals = steals + jnp.sum(p1_win, axis=0)
     steal_obj = jnp.where(p1_win, -1, steal_obj)
-    p1_acks = p1_acks & ~p1_win[:, None]
+    p1_acks = jnp.where(p1_win, 0, p1_acks)
 
-    own = (ballot % STRIDE) == ridx[:, None]           # (R, O)
+    own = (ballot % STRIDE) == ridx[:, None, None]     # (R, O, G)
 
     # ---------------- P2a: accept from the highest-ballot owner ---------
     m = inbox["p2a"]
     has2, b2, src2, (slot2, cmd2) = per_obj_best(m, ("slot", "cmd"))
-    acc_ok = has2 & (b2 >= ballot)                     # (dst, O)
+    acc_ok = has2 & (b2 >= ballot)                     # (me, O, G)
     demote = acc_ok & (b2 > ballot)
     ballot = jnp.where(acc_ok, b2, ballot)
     active = active & ~demote
     sk = jnp.any(demote & my_steal_oh, axis=1)
     steal_obj = jnp.where(sk, -1, steal_obj)
-    oh = (acc_ok[:, :, None] & (sidx[None, None, :] == slot2[:, :, None]))
-    writable = oh & (log_bal <= b2[:, :, None]) & ~log_commit
-    log_bal = jnp.where(writable, b2[:, :, None], log_bal)
-    log_cmd = jnp.where(writable, cmd2[:, :, None], log_cmd)
-    # p2b back to the accepted object's owner — one per edge; pick the
-    # highest-ballot accepted object per destination owner is overkill:
-    # since each owner proposes one object per step, per (dst, src-owner)
-    # there is at most one accepted p2a => reply on that edge directly
-    v2 = jnp.transpose(m["valid"])                     # (dst, src)
-    ob2 = jnp.transpose(m["obj"])
-    # accepted mask per (dst, src): the p2a on this edge was the winner
-    win_edge = (v2 & (jnp.take_along_axis(acc_ok, ob2, axis=1))
-                & (jnp.take_along_axis(src2, ob2, axis=1)
-                   == ridx[None, :]))
+    rel2 = slot2 - base                                # (me, O, G)
+    inw2 = (rel2 >= 0) & (rel2 < S)
+    oh = ((acc_ok & inw2)[:, :, None, :]
+          & (sidx[None, None, :, None] == rel2[:, :, None, :]))
+    writable = oh & (log_bal <= b2[:, :, None, :]) & ~log_commit
+    log_bal = jnp.where(writable, b2[:, :, None, :], log_bal)
+    log_cmd = jnp.where(writable, cmd2[:, :, None, :], log_cmd)
+    # p2b back to the accepted object's owner — one per edge; each owner
+    # proposes one object per step, so per (dst, src-owner) there is at
+    # most one accepted p2a => reply on that edge directly, and ack ONLY
+    # what we durably stored (in-window)
+    v2 = T(m["valid"])                                 # (me, src, G)
+    ob2 = jnp.clip(T(m["obj"]), 0, O - 1)
+    edge_ok = []
+    for s in range(R):
+        o_s = ob2[:, s]                                # (me, G)
+        acc_s = at_obj((acc_ok & inw2).astype(jnp.int32), o_s) > 0
+        src_s = at_obj(src2, o_s)
+        edge_ok.append(v2[:, s] & acc_s & (src_s == s))
+    win_edge = jnp.stack(edge_ok, axis=1)              # (me, src, G)
     out_p2b = {
         "valid": win_edge,
-        "obj": ob2,
-        "bal": jnp.transpose(m["bal"]),
-        "slot": jnp.transpose(m["slot"]),
+        "obj": T(m["obj"]),
+        "bal": T(m["bal"]),
+        "slot": T(m["slot"]),
     }
 
-    own = (ballot % STRIDE) == ridx[:, None]
+    own = (ballot % STRIDE) == ridx[:, None, None]
 
     # ---------------- P2b: owner tallies zone-grid acks, commits --------
     m = inbox["p2b"]
-    v = jnp.transpose(m["valid"])                      # (own, src)
-    ob = jnp.transpose(m["obj"])
-    bl = jnp.transpose(m["bal"])
-    sl = jnp.transpose(m["slot"])
-    my_b = jnp.take_along_axis(ballot, ob, axis=1)     # (own, src)
-    my_act = jnp.take_along_axis(active & own, ob, axis=1)
-    okb = v & (bl == my_b) & my_act
-    add = (okb[:, :, None, None]
-           & (ob[:, :, None, None] == oidx[None, None, :, None])
-           & (sl[:, :, None, None] == sidx[None, None, None, :]))
-    log_acks = log_acks | jnp.transpose(add, (0, 2, 3, 1))  # (own, O, S, src)
-    zq2 = _zone_quorums(log_acks, cfg)                 # (own, O, S)
-    newly = ((active & own)[:, :, None] & (zq2 >= Q2)
+    v = T(m["valid"])                                  # (own, src, G)
+    ob = jnp.clip(T(m["obj"]), 0, O - 1)
+    bl = T(m["bal"])
+    sl = T(m["slot"])
+    for s in range(R):
+        ob_s, bl_s, sl_s = ob[:, s], bl[:, s], sl[:, s]
+        ok_s = (v[:, s] & (bl_s == at_obj(ballot, ob_s))
+                & (at_obj((active & own).astype(jnp.int32), ob_s) > 0))
+        rel_s = sl_s[:, None, :] - base                # (own, O, G)
+        oh_s = (ok_s[:, None, None, :]
+                & (ob_s[:, None, None, :] == oidx[None, :, None, None])
+                & (rel_s[:, :, None, :] == sidx[None, None, :, None]))
+        log_acks = log_acks | jnp.where(oh_s, jnp.int32(1) << s, 0)
+    zq2 = _zone_quorums(log_acks, cfg)                 # (own, O, S, G)
+    newly = ((active & own)[:, :, None, :] & (zq2 >= Q2)
              & ~log_commit & (log_cmd != NO_CMD) & proposed)
     log_commit = log_commit | newly
 
     # ---------------- P3: commit notifications --------------------------
     m = inbox["p3"]
-    has3, b3_, src3, (slot3, cmd3, upto3) = per_obj_best(
-        m, ("slot", "cmd", "upto"))
-    oh = has3[:, :, None] & (sidx[None, None, :] == slot3[:, :, None])
-    log_cmd = jnp.where(oh, cmd3[:, :, None], log_cmd)
-    log_bal = jnp.where(oh, jnp.maximum(log_bal, b3_[:, :, None]), log_bal)
+    has3, b3_, src3, (slot3, cmd3, upto3, low3) = per_obj_best(
+        m, ("slot", "cmd", "upto", "lowslot"))
+    rel3 = slot3 - base
+    inw3 = (rel3 >= 0) & (rel3 < S)
+    oh = ((has3 & inw3)[:, :, None, :]
+          & (sidx[None, None, :, None] == rel3[:, :, None, :]))
+    log_cmd = jnp.where(oh, cmd3[:, :, None, :], log_cmd)
+    log_bal = jnp.where(oh, jnp.maximum(log_bal, b3_[:, :, None, :]),
+                        log_bal)
     log_commit = log_commit | oh
-    ohu = (has3[:, :, None] & (sidx[None, None, :] < upto3[:, :, None])
-           & (log_bal == b3_[:, :, None]) & (log_cmd != NO_CMD))
+    abs_ = base[:, :, None, :] + sidx[None, None, :, None]
+    ohu = (has3[:, :, None, :] & (abs_ < upto3[:, :, None, :])
+           & (log_bal == b3_[:, :, None, :]) & (log_cmd != NO_CMD))
     log_commit = log_commit | ohu
+
+    # ---------------- P3: snapshot catch-up for deep laggards -----------
+    # my frontier for this object fell below the owner's window base:
+    # the slots I need were recycled at the owner.  Adopt the owner's
+    # object row (log, base, execute, register) by reference, keeping my
+    # own still-in-window commits (as the paxos kernel does) — unrolled
+    # over the owner index
+    adopt = (has3 & (execute < low3)
+             & ~(ridx[:, None, None] == src3))         # (me, O, G)
+    s_cmd = jnp.zeros_like(log_cmd)
+    s_bal = jnp.zeros_like(log_bal)
+    s_com = jnp.zeros_like(log_commit)
+    b_own = jnp.zeros_like(base)
+    e_own = jnp.zeros_like(execute)
+    k_own = jnp.zeros_like(kv)
+    for s in range(R - 1, -1, -1):
+        mp = adopt & (src3 == s)                       # (me, O, G)
+        mp4 = mp[:, :, None, :]
+        s_cmd = jnp.where(mp4, log_cmd[s][None], s_cmd)
+        s_bal = jnp.where(mp4, log_bal[s][None], s_bal)
+        s_com = jnp.where(mp4, log_commit[s][None], s_com)
+        b_own = jnp.where(mp, base[s][None], b_own)
+        e_own = jnp.where(mp, execute[s][None], e_own)
+        k_own = jnp.where(mp, kv[s][None], k_own)
+    # align my row to the owner's frame (adv > 0: adopt requires my
+    # execute — hence my base — below the owner's base)
+    adv_a = jnp.where(adopt, b_own - base, 0)
+    my_bal_s = shift_window(log_bal, adv_a, 0)
+    my_cmd_s = shift_window(log_cmd, adv_a, NO_CMD)
+    my_com_s = shift_window(log_commit, adv_a, False)
+    a4 = adopt[:, :, None, :]
+    log_bal = jnp.where(a4, jnp.where(s_com, s_bal, my_bal_s), log_bal)
+    log_cmd = jnp.where(a4, jnp.where(s_com, s_cmd, my_cmd_s), log_cmd)
+    log_commit = jnp.where(a4, s_com | my_com_s, log_commit)
+    proposed = jnp.where(a4, False, proposed)
+    log_acks = jnp.where(a4, 0, log_acks)
+    base = jnp.where(adopt, b_own, base)
+    execute = jnp.where(adopt, e_own, execute)
+    kv = jnp.where(adopt, k_own, kv)
+    next_slot = jnp.where(adopt, jnp.maximum(next_slot, e_own), next_slot)
 
     # ---------------- workload: demand one object per step --------------
     # locality-skewed demand: each replica mostly touches its own block
@@ -296,45 +424,49 @@ def step(state, inbox, ctx: StepCtx):
     # several replicas share a home object, giving steady contention)
     k_d, k_loc, k_jit = jr.split(ctx.rng, 3)
     blk = max(O // R, 1)
-    home = (ridx * blk + jr.randint(k_d, (R,), 0, blk)) % O
-    anywhere = jr.randint(jr.fold_in(k_d, 1), (R,), 0, O)
-    local = jr.bernoulli(k_loc, cfg.locality, (R,))
-    demand = jnp.where(local, home, anywhere).astype(jnp.int32)
+    home = (ridx[:, None] * blk + jr.randint(k_d, (R, G), 0, blk)) % O
+    anywhere = jr.randint(jr.fold_in(k_d, 1), (R, G), 0, O)
+    local = jr.bernoulli(k_loc, cfg.locality, (R, G))
+    d = jnp.where(local, home, anywhere).astype(jnp.int32)
 
     # ---------------- owner proposes for the demanded object ------------
-    d_oh = oidx[None, :] == demand[:, None]            # (R, O)
-    is_owner_d = jnp.any(d_oh & active & own, axis=1)
-    d = demand
-    d_bal = ballot[ridx, d]
-    d_next = next_slot[ridx, d]
-    # re-propose the first unfinished slot if any, else a new one
-    mask_re = (~jnp.take_along_axis(
-        log_commit, d[:, None, None] * jnp.ones((1, 1, S), jnp.int32),
-        axis=1)[:, 0]) & (~jnp.take_along_axis(
-            proposed, d[:, None, None] * jnp.ones((1, 1, S), jnp.int32),
-            axis=1)[:, 0]) & (sidx[None, :] < d_next[:, None])
-    first_re = jnp.argmin(jnp.where(mask_re, sidx[None, :], S), axis=1)
+    d_oh = oidx[None, :, None] == d[:, None, :]        # (R, O, G)
+    is_owner_d = jnp.any(d_oh & active & own, axis=1)  # (R, G)
+    d_bal = at_obj(ballot, d)
+    d_next = at_obj(next_slot, d)
+    d_base = at_obj(base, d)
+    c_at_d = row_at_obj(log_commit, d, False)          # (R, S, G)
+    p_at_d = row_at_obj(proposed, d, False)
+    abs_d = d_base[:, None, :] + sidx[None, :, None]
+    mask_re = (~c_at_d) & (~p_at_d) & (abs_d < d_next[:, None, :])
+    first_re = jnp.argmin(jnp.where(mask_re, sidx[None, :, None], S),
+                          axis=1)
     has_re = jnp.any(mask_re, axis=1)
-    can_new = d_next < S
-    prop_slot = jnp.where(has_re, first_re, d_next).astype(jnp.int32)
+    can_new = d_next - d_base < S                      # window flow control
+    rel_next = jnp.clip(d_next - d_base, 0, S - 1)
+    prop_rel = jnp.where(has_re, first_re, rel_next).astype(jnp.int32)
+    prop_slot = d_base + prop_rel                      # absolute
     new_cmd = encode_cmd(d_bal, prop_slot)
-    re_cmd = log_cmd[ridx, d, jnp.clip(prop_slot, 0, S - 1)]
+    oh_pr = sidx[None, :, None] == prop_rel[:, None, :]
+    re_cmd = jnp.sum(jnp.where(oh_pr, row_at_obj(log_cmd, d, 0), 0),
+                     axis=1)
     re_cmd = jnp.where(re_cmd == NO_CMD, NOOP, re_cmd)
     prop_cmd = jnp.where(has_re, re_cmd, new_cmd)
     do = is_owner_d & (has_re | can_new)
-    p_oh = (do[:, None, None] & d_oh[:, :, None]
-            & (sidx[None, None, :] == prop_slot[:, None, None]))
-    log_bal = jnp.where(p_oh, d_bal[:, None, None], log_bal)
-    log_cmd = jnp.where(p_oh & ~log_commit, prop_cmd[:, None, None], log_cmd)
+    p_oh = (do[:, None, None, :] & d_oh[:, :, None, :]
+            & oh_pr[:, None, :, :])
+    log_bal = jnp.where(p_oh, d_bal[:, None, None, :], log_bal)
+    log_cmd = jnp.where(p_oh & ~log_commit, prop_cmd[:, None, None, :],
+                        log_cmd)
     proposed = proposed | p_oh
-    log_acks = log_acks | (p_oh[..., None] & self_only)
-    next_slot = next_slot + (do & ~has_re)[:, None] * d_oh
+    log_acks = log_acks | jnp.where(p_oh, self_bit2[..., None, None], 0)
+    next_slot = next_slot + ((do & ~has_re & can_new)[:, None, :] & d_oh)
     out_p2a = {
-        "valid": jnp.broadcast_to(do[:, None], (R, R)),
-        "obj": jnp.broadcast_to(d[:, None], (R, R)),
-        "bal": jnp.broadcast_to(d_bal[:, None], (R, R)),
-        "slot": jnp.broadcast_to(prop_slot[:, None], (R, R)),
-        "cmd": jnp.broadcast_to(prop_cmd[:, None], (R, R)),
+        "valid": jnp.broadcast_to(do[:, None, :], (R, R, G)),
+        "obj": jnp.broadcast_to(d[:, None, :], (R, R, G)),
+        "bal": jnp.broadcast_to(d_bal[:, None, :], (R, R, G)),
+        "slot": jnp.broadcast_to(prop_slot[:, None, :], (R, R, G)),
+        "cmd": jnp.broadcast_to(prop_cmd[:, None, :], (R, R, G)),
     }
 
     # ---------------- policy: count misses, fire steals ------------------
@@ -343,76 +475,82 @@ def step(state, inbox, ctx: StepCtx):
     # the replica keeps demanding the same unowned object
     hits = jnp.where(miss, hits + 1, 0)
     # fire a steal for the hottest over-threshold object when idle
-    can_steal = (steal_obj < 0)
-    hot = jnp.max(hits, axis=1)
+    can_steal = steal_obj < 0
+    hot = jnp.max(hits, axis=1)                        # (R, G)
     hot_obj = jnp.argmax(hits, axis=1).astype(jnp.int32)
     fire = can_steal & (hot >= cfg.steal_threshold)
-    new_bal = (jnp.max(ballot, axis=1) // STRIDE + 1) * STRIDE + ridx
-    f_oh = fire[:, None] & (oidx[None, :] == hot_obj[:, None])
-    ballot = jnp.where(f_oh, new_bal[:, None], ballot)
+    new_bal = ((jnp.max(ballot, axis=1) // STRIDE + 1) * STRIDE
+               + ridx[:, None])
+    f_oh = fire[:, None, :] & (oidx[None, :, None] == hot_obj[:, None, :])
+    ballot = jnp.where(f_oh, new_bal[:, None, :], ballot)
     active = active & ~f_oh
     steal_obj = jnp.where(fire, hot_obj, steal_obj)
-    p1_acks = jnp.where(fire[:, None], ridx[None, :] == ridx[:, None],
-                        p1_acks)
+    p1_acks = jnp.where(fire, self_bit2, p1_acks)
     hits = jnp.where(f_oh, 0, hits)
     out_p1a = {
-        "valid": jnp.broadcast_to(fire[:, None], (R, R)),
-        "obj": jnp.broadcast_to(hot_obj[:, None], (R, R)),
-        "bal": jnp.broadcast_to(new_bal[:, None], (R, R)),
+        "valid": jnp.broadcast_to(fire[:, None, :], (R, R, G)),
+        "obj": jnp.broadcast_to(hot_obj[:, None, :], (R, R, G)),
+        "bal": jnp.broadcast_to(new_bal[:, None, :], (R, R, G)),
     }
     # stalled steal: retry (rebump) after a timeout
-    steal_timer = jnp.where(steal_obj >= 0, state["steal_timer"] + 1,
-                            0)
+    steal_timer = jnp.where(steal_obj >= 0, state["steal_timer"] + 1, 0)
     timeout = steal_timer >= cfg.election_timeout + \
-        jr.randint(k_jit, (R,), 0, cfg.backoff + 1)
-    steal_obj = jnp.where(timeout, -1, steal_obj)      # give up; re-fire later
+        jr.randint(k_jit, (R, G), 0, cfg.backoff + 1)
+    steal_obj = jnp.where(timeout, -1, steal_obj)   # give up; re-fire later
     steal_timer = jnp.where(timeout, 0, steal_timer)
 
     # ---------------- execute committed prefixes ------------------------
-    advanced = jnp.zeros((R, O), jnp.int32)
-    running = jnp.ones((R, O), bool)
+    advanced = jnp.zeros((R, O, G), jnp.int32)
+    running = jnp.ones((R, O, G), bool)
     for e in range(cfg.exec_window):
-        idx = jnp.clip(execute + e, 0, S - 1)
-        inb = (execute + e) < S
-        com = jnp.take_along_axis(log_commit, idx[:, :, None],
-                                  axis=2)[..., 0]
-        running = running & com & inb
-        cmd_e = jnp.take_along_axis(log_cmd, idx[:, :, None],
-                                    axis=2)[..., 0]
+        rel_e = execute + e - base                     # (R, O, G)
+        oh_e = sidx[None, None, :, None] == rel_e[:, :, None, :]
+        com = jnp.any(oh_e & log_commit, axis=2)
+        running = running & com
+        cmd_e = jnp.sum(jnp.where(oh_e, log_cmd, 0), axis=2)
         wr = running & (cmd_e >= 0)
         kv = jnp.where(wr, cmd_e, kv)
         advanced = advanced + running
     new_execute = execute + advanced
 
     # ---------------- P3 out: per owner, its demanded object ------------
-    any_new_d = jnp.take_along_axis(jnp.any(newly, axis=2), d[:, None],
-                                    axis=1)[:, 0]
-    low_new = jnp.argmin(jnp.where(
-        jnp.take_along_axis(newly, d[:, None, None]
-                            * jnp.ones((1, 1, S), jnp.int32),
-                            axis=1)[:, 0], sidx[None, :], S), axis=1)
-    my_exec_d = new_execute[ridx, d]
-    rr = ctx.t % jnp.maximum(my_exec_d, 1)
-    p3_slot = jnp.where(any_new_d, low_new, rr).astype(jnp.int32)
-    p3_slot = jnp.clip(p3_slot, 0, S - 1)
-    p3_committed = log_commit[ridx, d, p3_slot]
-    p3_cmd = log_cmd[ridx, d, p3_slot]
-    p3_do = (active & own)[ridx, d] & p3_committed
+    new_at_d = row_at_obj(newly, d, False)             # (R, S, G)
+    any_new_d = jnp.any(new_at_d, axis=1)
+    low_new = jnp.argmin(jnp.where(new_at_d, sidx[None, :, None], S),
+                         axis=1)
+    my_exec_d = at_obj(new_execute, d)
+    rr = ctx.t % jnp.maximum(my_exec_d - d_base, 1)
+    p3_rel = jnp.where(any_new_d, low_new, rr).astype(jnp.int32)
+    p3_rel = jnp.clip(p3_rel, 0, S - 1)
+    oh_3 = sidx[None, :, None] == p3_rel[:, None, :]
+    p3_committed = jnp.any(oh_3 & row_at_obj(log_commit, d, False), axis=1)
+    p3_cmd = jnp.sum(jnp.where(oh_3, row_at_obj(log_cmd, d, 0), 0), axis=1)
+    p3_do = (at_obj((active & own).astype(jnp.int32), d) > 0) & p3_committed
     out_p3 = {
-        "valid": jnp.broadcast_to(p3_do[:, None], (R, R)),
-        "obj": jnp.broadcast_to(d[:, None], (R, R)),
-        "bal": jnp.broadcast_to(d_bal[:, None], (R, R)),
-        "slot": jnp.broadcast_to(p3_slot[:, None], (R, R)),
-        "cmd": jnp.broadcast_to(p3_cmd[:, None], (R, R)),
-        "upto": jnp.broadcast_to(my_exec_d[:, None], (R, R)),
+        "valid": jnp.broadcast_to(p3_do[:, None, :], (R, R, G)),
+        "obj": jnp.broadcast_to(d[:, None, :], (R, R, G)),
+        "bal": jnp.broadcast_to(d_bal[:, None, :], (R, R, G)),
+        "slot": jnp.broadcast_to((d_base + p3_rel)[:, None, :], (R, R, G)),
+        "cmd": jnp.broadcast_to(p3_cmd[:, None, :], (R, R, G)),
+        "upto": jnp.broadcast_to(my_exec_d[:, None, :], (R, R, G)),
+        "lowslot": jnp.broadcast_to(d_base[:, None, :], (R, R, G)),
     }
+
+    # ---------------- slide the ring windows (slot recycling) -----------
+    new_base = jnp.maximum(base, new_execute - RETAIN)
+    adv = new_base - base                              # (R, O, G)
+    log_bal = shift_window(log_bal, adv, 0)
+    log_cmd = shift_window(log_cmd, adv, NO_CMD)
+    log_commit = shift_window(log_commit, adv, False)
+    proposed = shift_window(proposed, adv, False)
+    log_acks = shift_window(log_acks, adv, 0)
 
     new_state = dict(
         ballot=ballot, active=active, log_bal=log_bal, log_cmd=log_cmd,
         log_commit=log_commit, log_acks=log_acks, proposed=proposed,
-        next_slot=next_slot, execute=new_execute, kv=kv, hits=hits,
-        steal_obj=steal_obj, p1_acks=p1_acks, steal_timer=steal_timer,
-        steals=steals,
+        base=new_base, next_slot=next_slot, execute=new_execute, kv=kv,
+        hits=hits, steal_obj=steal_obj, p1_acks=p1_acks,
+        steal_timer=steal_timer, steals=steals,
     )
     outbox = {"p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
               "p2b": out_p2b, "p3": out_p3}
@@ -422,38 +560,50 @@ def step(state, inbox, ctx: StepCtx):
 def metrics(state, cfg: SimConfig):
     return {
         "committed_slots": jnp.sum(jnp.max(state["execute"], axis=0)),
-        "steals": state["steals"],
+        "steals": jnp.sum(state["steals"]),
         "owned_objects": jnp.sum(state["active"]).astype(jnp.int32),
     }
 
 
 def invariants(old, new, cfg: SimConfig) -> jax.Array:
-    """1. Agreement per (object, slot); 2. commit stability; 3. per-
-    (replica, object) ballot monotonicity; 4. executed prefix committed;
-    5. single ownership: at most one active owner per object."""
+    """1. Agreement per absolute (object, slot) — checked on the
+    base-aligned common window; 2. commit stability under the slide;
+    3. per-(replica, object) ballot monotonicity; 4. executed prefix
+    committed (within the window); 5. single ownership: at most one
+    active owner per object."""
     BIG = jnp.int32(2**30)
-    c, cmd = new["log_commit"], new["log_cmd"]
-    mx = jnp.max(jnp.where(c, cmd, -BIG), axis=0)
-    mn = jnp.min(jnp.where(c, cmd, BIG), axis=0)
-    n_c = jnp.sum(c, axis=0)
+    S = cfg.n_slots
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    base, c, cmd = new["base"], new["log_commit"], new["log_cmd"]
+
+    align = jnp.max(base, axis=0)[None] - base         # (R, O, G)
+    a_c = shift_window(c, align, False)
+    a_cmd = shift_window(cmd, align, NO_CMD)
+    mx = jnp.max(jnp.where(a_c, a_cmd, -BIG), axis=0)
+    mn = jnp.min(jnp.where(a_c, a_cmd, BIG), axis=0)
+    n_c = jnp.sum(a_c, axis=0)
     v_agree = jnp.sum((n_c >= 1) & (mx != mn))
 
-    was = old["log_commit"]
-    v_stable = jnp.sum(was & (~c | (cmd != old["log_cmd"])))
+    adv = base - old["base"]
+    o_c = shift_window(old["log_commit"], adv, False)
+    o_cmd = shift_window(old["log_cmd"], adv, NO_CMD)
+    v_stable = jnp.sum(o_c & (~c | (cmd != o_cmd)))
+    v_stable = v_stable + jnp.sum(new["execute"] < base)
 
     v_bal = jnp.sum(new["ballot"] < old["ballot"])
 
-    prefix_len = jnp.sum(jnp.cumprod(c.astype(jnp.int32), axis=2), axis=2)
-    v_exec = jnp.sum(new["execute"] > prefix_len)
+    abs_ = base[:, :, None, :] + sidx[None, None, :, None]
+    v_exec = jnp.sum((abs_ < new["execute"][:, :, None, :]) & ~c)
 
     # two active replicas owning the same object at the same ballot round
     # would be a stolen-twice bug; different ballots are a transient
     own = new["active"]
     bal = jnp.where(own, new["ballot"], -1)
-    same = (own[:, None, :] & own[None, :, :]
-            & (bal[:, None, :] == bal[None, :, :])
-            & (jnp.arange(cfg.n_replicas)[:, None, None]
-               != jnp.arange(cfg.n_replicas)[None, :, None]))
+    R = cfg.n_replicas
+    same = (own[:, None] & own[None, :]
+            & (bal[:, None] == bal[None, :])
+            & (jnp.arange(R)[:, None, None, None]
+               != jnp.arange(R)[None, :, None, None]))
     v_own = jnp.sum(same) // 2
 
     return (v_agree + v_stable + v_bal + v_exec + v_own).astype(jnp.int32)
@@ -466,4 +616,5 @@ PROTOCOL = SimProtocol(
     step=step,
     metrics=metrics,
     invariants=invariants,
+    batched=True,
 )

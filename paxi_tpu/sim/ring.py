@@ -17,6 +17,21 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def require_packable(n_replicas: int) -> None:
+    """Guard for kernels that bit-pack per-replica acks into int32
+    masks: bit 31 is the sign bit and XLA shifts wrap mod 32, so
+    replica 32 would silently alias replica 0."""
+    if n_replicas > 31:
+        raise ValueError(f"n_replicas={n_replicas} > 31: packed int32 "
+                         "ack masks support at most 31 replicas per group")
+
+
+def dst_major(x):
+    """Mailbox plane (src, dst, G) -> (me=dst, src, G) — the receiver-
+    major view every lane-major handler consumes."""
+    return jnp.swapaxes(x, 0, 1)
+
+
 def shift_window(arr, adv, fill):
     """Slide ``arr (..., S, G)`` forward along the slot axis by
     ``adv (..., G)`` >= 0: out[..., i, g] = arr[..., i + adv[..., g], g]

@@ -1,0 +1,65 @@
+"""Permanent-crash fault schedules: protocols must exercise REAL
+recovery/takeover, not just retransmits (SURVEY §5 fault injection;
+FuzzConfig.perm_crash never heals, unlike the resampled p_crash
+windows).
+"""
+
+import jax.numpy as jnp
+
+from paxi_tpu.protocols import sim_protocol
+from paxi_tpu.sim import FuzzConfig, SimConfig, simulate
+
+
+def test_paxos_leader_kill_reelection():
+    """Replica 0 wins the first election (its timer fires at step 0);
+    killing it permanently must trigger a re-election among the
+    survivors and the commit frontier must keep advancing."""
+    cfg = SimConfig(n_replicas=5, n_slots=64)
+    fuzz = FuzzConfig(perm_crash=0, perm_crash_at=20)
+    res = simulate(sim_protocol("paxos"), cfg, 4, 120, fuzz=fuzz, seed=0)
+    assert int(res.violations) == 0
+    exec_ = res.state["execute"]                      # (G, R)
+    survivors = exec_[:, 1:]
+    # well past anything committable before the kill (~20 slots), with
+    # slack for the election storm: the frontier advanced AFTER the kill
+    assert (survivors.max(axis=1) >= 60).all(), survivors
+    # the new leader is a survivor; the dead replica's state is frozen
+    # (comms-dead: it never learns it was deposed), its frontier stalls
+    active = res.state["active"]                      # (G, R)
+    assert bool(active[:, 1:].any(axis=1).all())
+    assert (exec_[:, 0] <= 25).all(), exec_[:, 0]
+
+
+def test_wpaxos_owner_kill_steal_takeover():
+    """Replica 0 owns objects o % R == 0; killing it permanently must
+    make a survivor steal object 0 (grid phase-1 among survivors) and
+    resume committing on it."""
+    cfg = SimConfig(n_replicas=6, n_zones=2, n_objects=4, n_slots=16,
+                    steal_threshold=3, locality=0.8)
+    fuzz = FuzzConfig(perm_crash=0, perm_crash_at=20)
+    res = simulate(sim_protocol("wpaxos"), cfg, 4, 140, fuzz=fuzz, seed=1)
+    assert int(res.violations) == 0
+    assert int(res.metrics["steals"]) > 0
+    active = res.state["active"]                      # (G, R, O)
+    # object 0 (home of survivor 4: 4 % 4 == 0) is now owned by a
+    # survivor in every group
+    assert bool(active[:, 1:, 0].any(axis=1).all()), active[:, :, 0]
+    # and commits on object 0 advanced beyond the pre-kill frontier
+    exec0 = res.state["execute"][:, 1:, 0].max(axis=1)
+    assert (exec0 >= 30).all(), exec0
+
+
+def test_kpaxos_survivor_partitions_progress():
+    """KPaxos has static leaders by design (the contrast case to
+    WPaxos): a dead leader's partition stalls, but every survivor
+    partition must keep pipelining safely."""
+    cfg = SimConfig(n_replicas=3, n_slots=64)
+    fuzz = FuzzConfig(perm_crash=0, perm_crash_at=10)
+    res = simulate(sim_protocol("kpaxos"), cfg, 4, 80, fuzz=fuzz, seed=2)
+    assert int(res.violations) == 0
+    exec_ = res.state["execute"]                      # (G, R, P)
+    # survivor partitions (1, 2) keep committing at their leaders
+    surv = exec_[:, 1:, 1:]
+    assert (jnp.max(surv, axis=1) >= 50).all(), surv
+    # the dead leader's partition froze near the kill point
+    assert (exec_[:, :, 0].max(axis=1) <= 20).all()
