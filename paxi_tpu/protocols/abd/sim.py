@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from paxi_tpu.ops.hashing import fib_key
-from paxi_tpu.sim.ring import require_packable
+from paxi_tpu.sim.ring import dst_major, require_packable
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 IDLE, QUERY, STORE = 0, 1, 2
@@ -99,8 +99,7 @@ def step(state, inbox, ctx: StepCtx):
     self_bit = (jnp.int32(1) << ridx)[:, None]        # (R, 1) for (R, G)
     src_bit = (jnp.int32(1) << ridx)[:, None, None]   # (src, 1, 1)
 
-    def T(x):  # mailbox (src, dst, G) -> (me=dst, src, G)
-        return jnp.swapaxes(x, 0, 1)
+    T = dst_major          # mailbox (src, dst, G) -> (me=dst, src, G)
 
     def key_read(plane, key):
         """out[r, g] = plane[r, key[r, g], g] as a one-hot masked max."""

@@ -11,26 +11,50 @@ dependency graph by strongly-connected components with seq as tiebreak
 conflicting keys [driver].
 
 TPU re-design (not a translation):
-- The per-replica instance window is a dense SoA: ``cmd/seq/status
-  [R, R, I]`` and ``deps[R, R, I, R]`` — deps in the standard
-  max-conflict-per-owner vector form (one int per owner replica).
+- **Lane-major batch layout** (see sim/lanes.py): state planes are
+  ``(me, owner, I, G)`` / deps ``(me, owner, I, R, G)`` with the group
+  axis LAST; owner-driven mailbox planes ``(src, dst, G)`` scatter
+  directly onto the (me, owner=src) axes — no gather in the hot
+  handlers.  Quorum tallies are bit-packed int32 masks + popcount.
 - Conflict attribute computation (exec.go's conflict map) is a masked
   max over the recorded window, vectorized over all inboxes at once.
 - Execution replaces Tarjan with **boolean transitive closure by
   repeated matrix squaring** over the window graph — log2(R*I) bool
-  matmuls that map straight onto the MXU.  SCCs are ``reach & reach^T``;
-  a committed instance executes when every cross-SCC instance it
-  reaches is executed; same-key executables are always in one SCC (two
+  matmuls that map straight onto the MXU (ops/closure.py keeps the
+  matrix VMEM-resident on TPU).  SCCs are ``reach & reach^T``; a
+  committed instance executes when every cross-SCC instance it reaches
+  is executed; same-key executables are always in one SCC (two
   conflicting commands see each other through quorum intersection), so
   per-step application in global (seq, id) order is linearizable.
 - The in-kernel safety oracle: commit agreement on (cmd, seq, deps),
   commit/execute stability, and cross-replica agreement of the per-key
   execution hash chain.
-
-Failure recovery (epaxos Prepare/PrepareReply, TryPreAccept) is
-implemented in the host runtime (`epaxos/host.py`); the sim kernel
-exercises the fast/slow agreement paths and SCC execution under
-drop/dup/delay/partition and transient-crash fuzz.
+- **In-kernel recovery** (epaxos Prepare/PrepareReply, the analog of
+  host.py's rule): a per-cell promised-ballot plane ``bal`` gates the
+  owner's implicit-ballot-0 PreAccept/Accept; each replica ages the
+  cells blocking its execution frontier (committed-unexecuted work
+  reaching an uncommitted cell) and past a per-replica staggered
+  timeout runs one Prepare round at a higher ballot over the most-aged
+  cell.  PrepareReplies carry the replier's recorded state
+  (status/seq/deps/accepted-ballot) AND its freshly computed conflict
+  attributes for the command (the command id is a pure function of
+  (owner, inst), so repliers need not have seen it) — the reference's
+  restart-phase-1 (TryPreAccept) collapses into the same round.  The
+  decision rule, in order: any committed reply -> commit it; otherwise
+  wait for a FAST-sized prepare quorum, then: any accepted reply ->
+  Accept the max-abal one; >= 2*FAST-R identical ballot-0 preaccepts
+  (reached by every possibly-fast-committed value, and implying the
+  value is visible to every future commit quorum — see THRESH in
+  step() for why a majority-prepare rule is NOT enough) -> Accept
+  those attrs; any preaccept -> Accept the attr-union of recorded +
+  fresh conflict attrs over the quorum (no commit was possible, and
+  the union covers every conflict committed anywhere by quorum
+  intersection); else -> commit NOOP (the prepare quorum's raised
+  ballots make the owner's original fast and slow paths both
+  unreachable).  A
+  permanently crashed leader's stalled instances are finished by the
+  survivors (FuzzConfig.perm_crash); an alive owner whose instance was
+  recovered moves on when it sees the cell committed.
 """
 
 from __future__ import annotations
@@ -42,6 +66,7 @@ import jax.numpy as jnp
 
 from paxi_tpu.ops.closure import transitive_closure
 from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim.ring import dst_major, require_packable
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 NO_CMD = -1
@@ -53,175 +78,235 @@ def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
     R = cfg.n_replicas
     dep_fields = tuple(f"d{p}" for p in range(R))
     return {
-        "pa": ("inst", "seq", "cmd") + dep_fields,    # PreAccept
+        "pa": ("inst", "seq") + dep_fields,           # PreAccept
         "par": ("inst", "seq") + dep_fields,          # PreAcceptReply
-        "acc": ("inst", "seq", "cmd") + dep_fields,   # Accept
+        "acc": ("inst", "seq") + dep_fields,          # Accept
         "accr": ("inst",),                            # AcceptReply
         "cmt": ("inst", "seq", "cmd") + dep_fields,   # Commit
+        # recovery planes (ballot-carrying), separate from the owner-
+        # driven ones so an owner and a recoverer broadcasting in the
+        # same step never collide on a (type, src, dst) wheel edge
+        "prep": ("owner", "inst", "ballot"),          # Prepare
+        # cmdv distinguishes a NOOP-committed/accepted cell (NO_CMD)
+        # from the owner's real command
+        "prepr": ("owner", "inst", "ballot", "stat", "cmdv", "seq",
+                  "abal", "cseq") + dep_fields
+                 + tuple(f"c{p}" for p in range(R)),  # PrepareReply
+        "racc": ("owner", "inst", "ballot", "cmdv", "seq") + dep_fields,
+        "raccr": ("owner", "inst", "ballot"),
+        "rcmt": ("owner", "inst", "cmdv", "seq") + dep_fields,
     }
 
 
 def encode_cmd(owner, inst):
-    return (owner << 8) | inst          # unique per (owner, inst), I <= 256
+    """The command id is a pure function of (owner, inst) — I <= 256 —
+    so recovery repliers can compute conflict attrs for instances they
+    never saw."""
+    return (owner << 8) | inst
 
 
 def cmd_key(cmd, n_keys):
     return fib_key(cmd, n_keys)
 
 
-def _deps_pack(m, R, prefix="d"):
-    """Gather dep fields d0..dR-1 from a mailbox into (..., R)."""
-    return jnp.stack([m[f"{prefix}{p}"] for p in range(R)], axis=-1)
+def _deps_T(m, R, prefix="d"):
+    """Gather dep fields d0..dR-1 of a (src, dst, G) mailbox into the
+    receiver-major (me, src, R, G) stack."""
+    return jnp.stack([jnp.swapaxes(m[f"{prefix}{p}"], 0, 1)
+                      for p in range(R)], axis=2)
 
 
 def _deps_out(deps, R, shape):
-    """Spread (..., R) deps into broadcast per-field planes."""
-    return {f"d{p}": jnp.broadcast_to(deps[..., p], shape)
+    """Spread (..., R, G) deps into broadcast per-field (src, dst, G)
+    planes (deps indexed me-major: (me, R, G) -> broadcast over dst)."""
+    return {f"d{p}": jnp.broadcast_to(deps[:, None, p], shape)
             for p in range(R)}
 
 
-def init_state(cfg: SimConfig, rng: jax.Array):
-    R, I, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
+def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
+    R, I, K, G = cfg.n_replicas, cfg.n_slots, cfg.n_keys, n_groups
     del rng
+    require_packable(R)
+    if I > 256:
+        raise ValueError("epaxos instance window > 256 breaks encode_cmd")
+    i32 = jnp.int32
     return dict(
-        cmd=jnp.full((R, R, I), NO_CMD, jnp.int32),
-        seq=jnp.zeros((R, R, I), jnp.int32),
-        deps=jnp.full((R, R, I, R), -1, jnp.int32),
-        status=jnp.zeros((R, R, I), jnp.int32),
-        executed=jnp.zeros((R, R, I), bool),
-        # command-leader driving state (one in-flight instance per replica)
-        cur=jnp.zeros((R,), jnp.int32),
-        phase=jnp.zeros((R,), jnp.int32),     # 0 idle, 1 preaccept, 2 accept
-        pa_acks=jnp.zeros((R, R), bool),
-        ac_acks=jnp.zeros((R, R), bool),
-        agree=jnp.ones((R,), bool),
-        seq0=jnp.zeros((R,), jnp.int32),      # original proposed attrs
-        deps0=jnp.full((R, R), -1, jnp.int32),
-        mseq=jnp.zeros((R,), jnp.int32),      # merged attrs
-        mdeps=jnp.full((R, R), -1, jnp.int32),
-        stuck=jnp.zeros((R,), jnp.int32),
+        # instance window SoA, (me, owner, I, G); deps (me, owner, I, R, G)
+        cmd=jnp.full((R, R, I, G), NO_CMD, i32),
+        seq=jnp.zeros((R, R, I, G), i32),
+        deps=jnp.full((R, R, I, R, G), -1, i32),
+        status=jnp.zeros((R, R, I, G), i32),
+        executed=jnp.zeros((R, R, I, G), bool),
+        # recovery ballot planes: promised ballot per cell (0 = the
+        # owner's implicit ballot) + the ballot attrs were accepted at
+        bal=jnp.zeros((R, R, I, G), i32),
+        abal=jnp.zeros((R, R, I, G), i32),
+        # steps each cell has been blocking my execution frontier
+        age=jnp.zeros((R, R, I, G), i32),
+        # command-leader driving state (one in-flight instance each)
+        cur=jnp.zeros((R, G), i32),
+        phase=jnp.zeros((R, G), i32),    # 0 idle, 1 preaccept, 2 accept
+        pa_acks=jnp.zeros((R, G), i32),  # bit-packed
+        ac_acks=jnp.zeros((R, G), i32),
+        agree=jnp.ones((R, G), bool),
+        seq0=jnp.zeros((R, G), i32),     # original proposed attrs
+        deps0=jnp.full((R, R, G), -1, i32),
+        mseq=jnp.zeros((R, G), i32),     # merged attrs
+        mdeps=jnp.full((R, R, G), -1, i32),
+        stuck=jnp.zeros((R, G), i32),
+        # one in-flight recovery per replica over cell (rowner, rinst)
+        # at ballot rballot; rphase 0 idle / 1 prepare / 2 accept
+        rphase=jnp.zeros((R, G), i32),
+        rowner=jnp.zeros((R, G), i32),
+        rinst=jnp.zeros((R, G), i32),
+        rballot=jnp.zeros((R, G), i32),
+        rstuck=jnp.zeros((R, G), i32),
+        racks=jnp.zeros((R, G), i32),    # prepare-round ack bitmask
+        # per-replier recorded state + fresh conflict attrs
+        rstat=jnp.zeros((R, R, G), i32),
+        rcmd=jnp.full((R, R, G), NO_CMD, i32),
+        rseq2=jnp.zeros((R, R, G), i32),
+        rabal=jnp.zeros((R, R, G), i32),
+        rdeps2=jnp.full((R, R, R, G), -1, i32),
+        rcseq=jnp.zeros((R, R, G), i32),
+        rcdeps=jnp.full((R, R, R, G), -1, i32),
+        # decided attrs being driven through the recovery Accept
+        rdcmd=jnp.full((R, G), NO_CMD, i32),
+        rdseq=jnp.zeros((R, G), i32),
+        rddeps=jnp.full((R, R, G), -1, i32),
+        aacks=jnp.zeros((R, G), i32),
+        recovered=jnp.zeros((G,), i32),  # completed recoveries (metric)
         # per-key execution oracle: count + order-sensitive hash chain
-        kcount=jnp.zeros((R, K), jnp.int32),
-        khash=jnp.zeros((R, K), jnp.int32),
+        kcount=jnp.zeros((R, K, G), i32),
+        khash=jnp.zeros((R, K, G), i32),
     )
-
-
-def _conflict_attrs(state_cmd, state_seq, state_status, new_cmd, excl_owner,
-                    excl_inst, cfg: SimConfig):
-    """Attributes (seq, deps) a replica derives for ``new_cmd`` from its
-    recorded window, excluding the instance itself.
-
-    state_*: (R_own, I) views of ONE replica's window; new_cmd scalar-ish
-    broadcastable leading dims.  Returns (seq, deps[R]).
-    """
-    R, I, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
-    k_new = cmd_key(new_cmd, K)                              # (...,)
-    k_tab = cmd_key(state_cmd, K)                            # (..., R, I)
-    recorded = state_status >= ST_PRE
-    pidx = jnp.arange(R, dtype=jnp.int32)
-    iidx = jnp.arange(I, dtype=jnp.int32)
-    is_self = ((pidx[:, None] == excl_owner[..., None, None])
-               & (iidx[None, :] == excl_inst[..., None, None]))
-    conflict = (recorded & (k_tab == k_new[..., None, None]) & ~is_self
-                & (state_cmd != NO_CMD))   # recovery NOOPs never interfere
-    cseq = jnp.max(jnp.where(conflict, state_seq, 0), axis=-1)   # (..., R)
-    cseq = jnp.max(cseq, axis=-1)                                # (...,)
-    cdep = jnp.max(jnp.where(conflict, iidx[None, :], -1), axis=-1)  # (...,R)
-    return cseq + 1, cdep
 
 
 def step(state, inbox, ctx: StepCtx):
     cfg = ctx.cfg
     R, I, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
     MAJ, FAST = cfg.majority, cfg.fast_size
-    N = R * I
+    # identical-preaccept threshold over a FAST-sized prepare quorum:
+    # 2*FAST-R both (a) is always reached by a fast-committed value
+    # (|prepare ∩ fast-quorum\owner| >= FAST+FAST-R) and (b) implies the
+    # value is recorded at >= R-MAJ+1 replicas, so EVERY later commit
+    # quorum of a conflicting command sees it — closing the unordered-
+    # conflict recovery hole (a MAJ prepare with MAJ+FAST-R identical
+    # replies satisfies (a) but not (b): a conflicting slow-path commit
+    # can then miss the recovered instance entirely and execution order
+    # diverges across replicas)
+    THRESH = max(2 * FAST - R, 1)
+    NN = R * I
     ridx = jnp.arange(R, dtype=jnp.int32)
     iidx = jnp.arange(I, dtype=jnp.int32)
+    self_bit = (jnp.int32(1) << ridx)[:, None]           # (R, 1)
 
-    cmd = state["cmd"]
+    cmd = state["cmd"]                # (me, owner, I, G)
     seq = state["seq"]
-    deps = state["deps"]
+    deps = state["deps"]              # (me, owner, I, R, G)
     status = state["status"]
     executed = state["executed"]
-    cur = state["cur"]
-    phase = state["phase"]
-    pa_acks = state["pa_acks"]
-    ac_acks = state["ac_acks"]
+    bal, abal, age = state["bal"], state["abal"], state["age"]
+    cur, phase = state["cur"], state["phase"]
+    pa_acks, ac_acks = state["pa_acks"], state["ac_acks"]
     agree = state["agree"]
     seq0, deps0 = state["seq0"], state["deps0"]
     mseq, mdeps = state["mseq"], state["mdeps"]
+    rphase, rowner = state["rphase"], state["rowner"]
+    rinst, rballot = state["rinst"], state["rballot"]
+    rstuck, racks = state["rstuck"], state["racks"]
+    rstat, rcmd = state["rstat"], state["rcmd"]
+    rseq2, rabal = state["rseq2"], state["rabal"]
+    rdeps2, rcseq, rcdeps = state["rdeps2"], state["rcseq"], state["rcdeps"]
+    rdcmd, rdseq, rddeps = state["rdcmd"], state["rdseq"], state["rddeps"]
+    aacks = state["aacks"]
+    recovered = state["recovered"]
     kcount, khash = state["kcount"], state["khash"]
+    G = cur.shape[-1]
 
-    def record(cmd_a, seq_a, deps_a, status_a, v, owner, inst, c, s, d, st):
-        """Masked write of (c, s, d, st) at [me, owner(me), inst(me)].
+    T = dst_major                                    # (me, src, G)
 
-        v/owner/inst/c/s: (R, R) planes (me, src); d: (R, R, R).
-        Writes are status-monotone: a phase only overwrites attributes
-        recorded by a strictly lower phase (late PreAccepts cannot
-        clobber Accept attrs; commits are frozen forever)."""
-        oh = (v[:, :, None, None]
-              & (ridx[None, None, :, None] == owner[:, :, None, None])
-              & (iidx[None, None, None, :] == inst[:, :, None, None]))
-        # each (owner, inst) cell has exactly one driving src (= owner),
-        # so at most one src writes a given cell per step and a flat
-        # any()/argmax() over the src axis is collision-free
-        hit = jnp.any(oh, axis=1)                         # (me, R, I)
-        pick = jnp.argmax(oh, axis=1)                     # (me, R, I) src idx
-        c_w = jnp.take_along_axis(
-            jnp.broadcast_to(c[:, :, None, None], oh.shape),
-            pick[:, None], axis=1)[:, 0]
-        s_w = jnp.take_along_axis(
-            jnp.broadcast_to(s[:, :, None, None], oh.shape),
-            pick[:, None], axis=1)[:, 0]
-        st_i = jnp.int32(st)
-        wr_c = hit & (status_a < st_i)
-        cmd_a = jnp.where(wr_c, c_w, cmd_a)
-        seq_a = jnp.where(wr_c, s_w, seq_a)
-        d_w = jnp.take_along_axis(
-            jnp.broadcast_to(d[:, :, None, None, :],
-                             oh.shape + (R,)),
-            pick[:, None, :, :, None] * jnp.ones(
-                (1, 1, 1, 1, R), jnp.int32), axis=1)[:, 0]
-        deps_a = jnp.where(wr_c[..., None], d_w, deps_a)
-        status_a = jnp.where(hit, jnp.maximum(status_a, st_i), status_a)
-        return cmd_a, seq_a, deps_a, status_a
+    def conflict_attrs(cmd_t, seq_t, status_t, new_cmd, excl_owner,
+                       excl_inst):
+        """Attrs (seq, deps) derived from the given window state for
+        ``new_cmd`` (lead dims (me, X, G)), excluding the instance
+        itself.  Callers pass the CURRENT mid-step table: the reference
+        processes messages one at a time, so of two conflicting
+        commands meeting at a shared replica in the same step, the
+        later-computed attrs MUST see the earlier recording — computing
+        everything from the pre-step snapshot let both commit blind to
+        each other (an unordered conflicting pair whose execution order
+        then diverges across replicas).
+        Returns seq (me, X, G), deps (me, X, R, G)."""
+        k_tab = cmd_key(cmd_t, K)                        # (me, owner, I, G)
+        recorded_tab = (status_t >= ST_PRE) & (cmd_t != NO_CMD)
+        k_new = cmd_key(new_cmd, K)                      # (me, X, G)
+        is_self = ((ridx[None, None, :, None, None]
+                    == excl_owner[:, :, None, None, :])
+                   & (iidx[None, None, None, :, None]
+                      == excl_inst[:, :, None, None, :]))
+        conflict = (recorded_tab[:, None] & ~is_self
+                    & (k_tab[:, None] == k_new[:, :, None, None, :]))
+        # (me, X, owner, I, G)
+        cseq = jnp.max(jnp.where(conflict, seq_t[:, None], 0),
+                       axis=(2, 3))
+        cdep = jnp.max(jnp.where(conflict, iidx[None, None, None, :, None],
+                                 -1), axis=3)            # (me, X, R, G)
+        return cseq + 1, cdep
 
-    # ---------------- PreAccept: merge conflict attrs, reply ------------
+    # ---------------- PreAccept: record, merge conflict attrs, reply ----
     m = inbox["pa"]
-    v = jnp.transpose(m["valid"])                          # (me, src)
-    pa_inst = jnp.transpose(m["inst"])
-    pa_seq = jnp.transpose(m["seq"])
-    pa_cmd = jnp.transpose(m["cmd"])
-    pa_deps = jnp.stack([jnp.transpose(m[f"d{p}"]) for p in range(R)],
-                        axis=-1)                           # (me, src, R)
-    own_src = jnp.broadcast_to(ridx[None, :], (R, R))      # owner == src
-    a_seq, a_dep = _conflict_attrs(
-        cmd[:, None], seq[:, None], status[:, None],
-        pa_cmd, own_src, pa_inst, cfg)                     # (me, src[,R])
-    r_seq = jnp.maximum(pa_seq, a_seq)
-    r_deps = jnp.maximum(pa_deps, a_dep)
-    cmd, seq, deps, status = record(
-        cmd, seq, deps, status, v, own_src, pa_inst,
-        pa_cmd, r_seq, r_deps, ST_PRE)
+    v = T(m["valid"])                                    # (me, src, G)
+    pa_inst = jnp.clip(T(m["inst"]), 0, I - 1)
+    pa_seq = T(m["seq"])
+    pa_deps = _deps_T(m, R)                              # (me, src, R, G)
+    # owner == src: the cell one-hot is directly (me, src, I, G)
+    oh_cell = iidx[None, None, :, None] == pa_inst[:, :, None, :]
+    # the owner's implicit ballot is 0: once a recoverer's Prepare
+    # touched the cell (bal > 0), its PreAccepts are stale — no record,
+    # no reply (host handle_preaccept's ballot gate)
+    cell_free = jnp.sum(jnp.where(oh_cell, bal, 0), axis=2) == 0
+    v = v & cell_free
+    pa_cmd = encode_cmd(ridx[None, :, None], pa_inst)    # (me, src, G)
+    # pass 1: record the proposals' PRESENCE (proposed attrs) so that
+    # two conflicting PreAccepts landing at this replica in the same
+    # step see each other in pass 2 (mutual deps -> one SCC)
+    wr = (v & (jnp.sum(jnp.where(oh_cell, status, 0), axis=2)
+               < ST_PRE))[:, :, None, :] & oh_cell       # status-monotone
+    cmd = jnp.where(wr, pa_cmd[:, :, None, :], cmd)
+    seq = jnp.where(wr, pa_seq[:, :, None, :], seq)
+    deps = jnp.where(wr[:, :, :, None, :],
+                     pa_deps[:, :, None, :, :], deps)
+    status = jnp.where(wr, ST_PRE, status)
+    # pass 2: conflict attrs from the UPDATED table, merge, re-record
+    a_seq, a_dep = conflict_attrs(cmd, seq, status, pa_cmd,
+                                  jnp.broadcast_to(ridx[None, :, None],
+                                                   pa_inst.shape),
+                                  pa_inst)
+    r_seq = jnp.maximum(pa_seq, a_seq)                   # (me, src, G)
+    r_deps = jnp.maximum(pa_deps, a_dep)                 # (me, src, R, G)
+    seq = jnp.where(wr, r_seq[:, :, None, :], seq)
+    deps = jnp.where(wr[:, :, :, None, :],
+                     r_deps[:, :, None, :, :], deps)
     out_par = {"valid": v, "inst": pa_inst, "seq": r_seq,
-               **_deps_out(r_deps, R, (R, R))}
+               **{f"d{p}": r_deps[:, :, p] for p in range(R)}}
 
     # ---------------- PreAcceptReply at the command leader --------------
     m = inbox["par"]
-    v = jnp.transpose(m["valid"])                          # (ldr, src)
-    rp_inst = jnp.transpose(m["inst"])
-    rp_seq = jnp.transpose(m["seq"])
-    rp_deps = jnp.stack([jnp.transpose(m[f"d{p}"]) for p in range(R)],
-                        axis=-1)
-    ok = v & (rp_inst == cur[:, None]) & (phase == 1)[:, None] & ~pa_acks
-    pa_acks = pa_acks | ok
-    same = (rp_seq == seq0[:, None]) & jnp.all(
-        rp_deps == deps0[:, None, :], axis=-1)
+    v = T(m["valid"])
+    rp_inst = T(m["inst"])
+    rp_seq = T(m["seq"])
+    rp_deps = _deps_T(m, R)
+    ok = (v & (rp_inst == cur[:, None, :]) & (phase == 1)[:, None, :])
+    same = (rp_seq == seq0[:, None, :]) & jnp.all(
+        rp_deps == deps0[:, None], axis=2)
     agree = agree & jnp.all(~ok | same, axis=1)
     mseq = jnp.maximum(mseq, jnp.max(jnp.where(ok, rp_seq, 0), axis=1))
     mdeps = jnp.maximum(mdeps, jnp.max(
-        jnp.where(ok[..., None], rp_deps, -1), axis=1))
-    n_pa = jnp.sum(pa_acks, axis=1)
+        jnp.where(ok[:, :, None, :], rp_deps, -1), axis=1))
+    pa_acks = pa_acks | jnp.sum(
+        jnp.where(ok, (jnp.int32(1) << ridx)[None, :, None], 0), axis=1)
+    n_pa = jax.lax.population_count(pa_acks)
     fast_commit = (phase == 1) & agree & (n_pa >= FAST)
     go_accept = (phase == 1) & ~fast_commit & (n_pa >= MAJ) & (
         (~agree & (n_pa >= FAST))
@@ -229,192 +314,586 @@ def step(state, inbox, ctx: StepCtx):
 
     # ---------------- AcceptReply then Accept ---------------------------
     m = inbox["accr"]
-    v = jnp.transpose(m["valid"])
-    ok = v & (jnp.transpose(m["inst"]) == cur[:, None]) & (phase == 2)[:, None]
-    ac_acks = ac_acks | ok
-    slow_commit = (phase == 2) & (jnp.sum(ac_acks, axis=1) >= MAJ)
+    ok = (T(m["valid"]) & (T(m["inst"]) == cur[:, None, :])
+          & (phase == 2)[:, None, :])
+    ac_acks = ac_acks | jnp.sum(
+        jnp.where(ok, (jnp.int32(1) << ridx)[None, :, None], 0), axis=1)
+    slow_commit = (phase == 2) \
+        & (jax.lax.population_count(ac_acks) >= MAJ)
 
     m = inbox["acc"]
-    v = jnp.transpose(m["valid"])
-    ac_inst = jnp.transpose(m["inst"])
-    ac_seq = jnp.transpose(m["seq"])
-    ac_cmd = jnp.transpose(m["cmd"])
-    ac_deps = jnp.stack([jnp.transpose(m[f"d{p}"]) for p in range(R)],
-                        axis=-1)
-    cmd, seq, deps, status = record(
-        cmd, seq, deps, status, v, own_src, ac_inst,
-        ac_cmd, ac_seq, ac_deps, ST_ACC)
+    v = T(m["valid"])
+    ac_inst = jnp.clip(T(m["inst"]), 0, I - 1)
+    ac_seq = T(m["seq"])
+    ac_deps = _deps_T(m, R)
+    oh_cell = iidx[None, None, :, None] == ac_inst[:, :, None, :]
+    cell_free = jnp.sum(jnp.where(oh_cell, bal, 0), axis=2) == 0
+    v = v & cell_free
+    ac_cmd = encode_cmd(ridx[None, :, None], ac_inst)
+    wr = (v & (jnp.sum(jnp.where(oh_cell, status, 0), axis=2)
+               < ST_ACC))[:, :, None, :] & oh_cell
+    cmd = jnp.where(wr, ac_cmd[:, :, None, :], cmd)
+    seq = jnp.where(wr, ac_seq[:, :, None, :], seq)
+    deps = jnp.where(wr[:, :, :, None, :], ac_deps[:, :, None, :, :], deps)
+    status = jnp.where(wr & (status < ST_COMMIT),
+                       jnp.maximum(status, ST_ACC), status)
     out_accr = {"valid": v, "inst": ac_inst}
 
-    # ---------------- Commit delivery -----------------------------------
+    # ---------------- Commit delivery (owner-driven) --------------------
     m = inbox["cmt"]
-    v = jnp.transpose(m["valid"])
-    cm_inst = jnp.transpose(m["inst"])
-    cm_seq = jnp.transpose(m["seq"])
-    cm_cmd = jnp.transpose(m["cmd"])
-    cm_deps = jnp.stack([jnp.transpose(m[f"d{p}"]) for p in range(R)],
-                        axis=-1)
-    cmd, seq, deps, status = record(
-        cmd, seq, deps, status, v, own_src, cm_inst,
-        cm_cmd, cm_seq, cm_deps, ST_COMMIT)
+    v = T(m["valid"])
+    cm_inst = jnp.clip(T(m["inst"]), 0, I - 1)
+    cm_seq = T(m["seq"])
+    cm_cmd = T(m["cmd"])
+    cm_deps = _deps_T(m, R)
+    oh_cell = iidx[None, None, :, None] == cm_inst[:, :, None, :]
+    wr = (v & (jnp.sum(jnp.where(oh_cell, status, 0), axis=2)
+               < ST_COMMIT))[:, :, None, :] & oh_cell
+    cmd = jnp.where(wr, cm_cmd[:, :, None, :], cmd)
+    seq = jnp.where(wr, cm_seq[:, :, None, :], seq)
+    deps = jnp.where(wr[:, :, :, None, :], cm_deps[:, :, None, :, :], deps)
+    status = jnp.where(wr, ST_COMMIT, status)
 
     # ---------------- leader transitions --------------------------------
-    # fast/slow commit: freeze my instance as committed with the decided
-    # attrs (fast: originals == everyone's; slow: merged)
     dec_seq = jnp.where(fast_commit, seq0, mseq)
-    dec_deps = jnp.where(fast_commit[:, None], deps0, mdeps)
+    dec_deps = jnp.where(fast_commit[:, None, :], deps0, mdeps)
     do_commit = fast_commit | slow_commit
-    my_cmd = encode_cmd(ridx, jnp.clip(cur, 0, I - 1))
-    oh_me = (ridx[:, None, None] == ridx[None, :, None]) \
-        & (iidx[None, None, :] == jnp.clip(cur, 0, I - 1)[:, None, None])
-    wrm = do_commit[:, None, None] & oh_me
-    cmd = jnp.where(wrm, my_cmd[:, None, None], cmd)
-    seq = jnp.where(wrm, dec_seq[:, None, None], seq)
-    deps = jnp.where(wrm[..., None], dec_deps[:, None, None, :], deps)
+    curc = jnp.clip(cur, 0, I - 1)
+    my_cmd = encode_cmd(ridx[:, None], curc)             # (R, G)
+    oh_me = ((ridx[:, None, None, None] == ridx[None, :, None, None])
+             & (iidx[None, None, :, None] == curc[:, None, None, :]))
+    wrm = do_commit[:, None, None, :] & oh_me
+    cmd = jnp.where(wrm, my_cmd[:, None, None, :], cmd)
+    seq = jnp.where(wrm, dec_seq[:, None, None, :], seq)
+    deps = jnp.where(wrm[:, :, :, None, :],
+                     dec_deps[:, None, None, :, :], deps)
     status = jnp.where(wrm, ST_COMMIT, status)
     out_cmt_new = {
-        "valid": jnp.broadcast_to(do_commit[:, None], (R, R)),
-        "inst": jnp.broadcast_to(cur[:, None], (R, R)),
-        "seq": jnp.broadcast_to(dec_seq[:, None], (R, R)),
-        "cmd": jnp.broadcast_to(my_cmd[:, None], (R, R)),
-        **_deps_out(jnp.broadcast_to(dec_deps[:, None, :], (R, R, R)),
-                    R, (R, R)),
+        "valid": jnp.broadcast_to(do_commit[:, None, :], (R, R, G)),
+        "inst": jnp.broadcast_to(curc[:, None, :], (R, R, G)),
+        "seq": jnp.broadcast_to(dec_seq[:, None, :], (R, R, G)),
+        "cmd": jnp.broadcast_to(my_cmd[:, None, :], (R, R, G)),
+        **_deps_out(dec_deps, R, (R, R, G)),
     }
 
     # accept phase start
-    wra = go_accept[:, None, None] & oh_me
-    seq = jnp.where(wra, mseq[:, None, None], seq)
-    deps = jnp.where(wra[..., None], mdeps[:, None, None, :], deps)
-    status = jnp.where(wra, jnp.maximum(status, ST_ACC), status)
-    ac_acks = jnp.where(go_accept[:, None], ridx[None, :] == ridx[:, None],
-                        ac_acks)
+    wra = go_accept[:, None, None, :] & oh_me
+    seq = jnp.where(wra, mseq[:, None, None, :], seq)
+    deps = jnp.where(wra[:, :, :, None, :], mdeps[:, None, None, :, :],
+                     deps)
+    status = jnp.where(wra & (status < ST_COMMIT),
+                       jnp.maximum(status, ST_ACC), status)
+    ac_acks = jnp.where(go_accept, self_bit, ac_acks)
     out_acc = {
-        "valid": jnp.broadcast_to(go_accept[:, None], (R, R)),
-        "inst": jnp.broadcast_to(cur[:, None], (R, R)),
-        "seq": jnp.broadcast_to(mseq[:, None], (R, R)),
-        "cmd": jnp.broadcast_to(my_cmd[:, None], (R, R)),
-        **_deps_out(jnp.broadcast_to(mdeps[:, None, :], (R, R, R)),
-                    R, (R, R)),
+        "valid": jnp.broadcast_to(go_accept[:, None, :], (R, R, G)),
+        "inst": jnp.broadcast_to(curc[:, None, :], (R, R, G)),
+        "seq": jnp.broadcast_to(mseq[:, None, :], (R, R, G)),
+        **_deps_out(mdeps, R, (R, R, G)),
     }
 
-    phase = jnp.where(do_commit, 0, jnp.where(go_accept, 2, phase))
-    cur = cur + do_commit
-    stuck = jnp.where(do_commit | go_accept, 0, state["stuck"])
+    # my in-flight instance was finished externally (a recoverer drove
+    # it to commit, possibly as NOOP): move on — in ANY phase, including
+    # idle, or the owner's pipeline deadlocks on the recovered cell
+    my_status0 = jnp.stack([status[p, p] for p in range(R)], axis=0)
+    ext_commit = (cur < I) & ~do_commit & (jnp.sum(
+        jnp.where(iidx[None, :, None] == curc[:, None, :],
+                  my_status0, 0), axis=1) == ST_COMMIT)
+    phase = jnp.where(do_commit | ext_commit, 0,
+                      jnp.where(go_accept, 2, phase))
+    cur = cur + (do_commit | ext_commit)
+    stuck = jnp.where(do_commit | go_accept | ext_commit, 0,
+                      state["stuck"])
 
     # ---------------- propose the next command --------------------------
     propose = (phase == 0) & (cur < I)
     p_inst = jnp.clip(cur, 0, I - 1)
-    p_cmd = encode_cmd(ridx, p_inst)
-    p_seq, p_deps = _conflict_attrs(cmd, seq, status, p_cmd,
-                                    ridx, p_inst, cfg)     # own-window attrs
-    oh_p = (ridx[:, None, None] == ridx[None, :, None]) \
-        & (iidx[None, None, :] == p_inst[:, None, None])
-    wrp = propose[:, None, None] & oh_p
-    cmd = jnp.where(wrp, p_cmd[:, None, None], cmd)
-    seq = jnp.where(wrp, p_seq[:, None, None], seq)
-    deps = jnp.where(wrp[..., None], p_deps[:, None, None, :], deps)
-    status = jnp.where(wrp, jnp.maximum(status, ST_PRE), status)
+    p_cmd = encode_cmd(ridx[:, None], p_inst)
+    p_seq, p_deps = conflict_attrs(cmd, seq, status, p_cmd[:, None, :],
+                                   jnp.broadcast_to(ridx[:, None, None],
+                                                    (R, 1, G)),
+                                   p_inst[:, None, :])
+    p_seq, p_deps = p_seq[:, 0], p_deps[:, 0]            # (R,G),(R,R,G)
+    oh_p = ((ridx[:, None, None, None] == ridx[None, :, None, None])
+            & (iidx[None, None, :, None] == p_inst[:, None, None, :]))
+    # my own cell may have been recovery-touched (bal > 0): I still
+    # record my proposal if the cell is empty, but acceptors will gate
+    wrp = (propose & (jnp.sum(
+        jnp.where(iidx[None, :, None] == p_inst[:, None, :],
+                  status[ridx, ridx], 0), axis=1) < ST_PRE)
+    )[:, None, None, :] & oh_p
+    cmd = jnp.where(wrp, p_cmd[:, None, None, :], cmd)
+    seq = jnp.where(wrp, p_seq[:, None, None, :], seq)
+    deps = jnp.where(wrp[:, :, :, None, :], p_deps[:, None, None, :, :],
+                     deps)
+    status = jnp.where(wrp, ST_PRE, status)
     seq0 = jnp.where(propose, p_seq, seq0)
-    deps0 = jnp.where(propose[:, None], p_deps, deps0)
+    deps0 = jnp.where(propose[:, None, :], p_deps, deps0)
     mseq = jnp.where(propose, p_seq, mseq)
-    mdeps = jnp.where(propose[:, None], p_deps, mdeps)
+    mdeps = jnp.where(propose[:, None, :], p_deps, mdeps)
     agree = jnp.where(propose, True, agree)
-    pa_acks = jnp.where(propose[:, None], ridx[None, :] == ridx[:, None],
-                        pa_acks)
+    pa_acks = jnp.where(propose, self_bit, pa_acks)
     phase = jnp.where(propose, 1, phase)
 
     # retransmit the in-flight phase message when stuck
-    retry = (stuck >= cfg.retry_timeout)
+    retry = stuck >= cfg.retry_timeout
     send_pa = propose | (retry & (phase == 1))
     send_acc = go_accept | (retry & (phase == 2))
     out_pa = {
-        "valid": jnp.broadcast_to(send_pa[:, None], (R, R)),
-        "inst": jnp.broadcast_to(p_inst[:, None], (R, R)),
-        "seq": jnp.broadcast_to(seq0[:, None], (R, R)),
-        "cmd": jnp.broadcast_to(encode_cmd(ridx, p_inst)[:, None], (R, R)),
-        **_deps_out(jnp.broadcast_to(deps0[:, None, :], (R, R, R)),
-                    R, (R, R)),
+        "valid": jnp.broadcast_to(send_pa[:, None, :], (R, R, G)),
+        "inst": jnp.broadcast_to(p_inst[:, None, :], (R, R, G)),
+        "seq": jnp.broadcast_to(seq0[:, None, :], (R, R, G)),
+        **_deps_out(deps0, R, (R, R, G)),
     }
-    out_acc["valid"] = jnp.broadcast_to(send_acc[:, None], (R, R))
+    out_acc["valid"] = jnp.broadcast_to(send_acc[:, None, :], (R, R, G))
     stuck = jnp.where(retry, 0, stuck + (phase > 0))
 
     # late/periodic commit retransmit: round-robin over my committed
     # instances so followers with dropped cmt messages eventually heal
-    rr = ctx.t % jnp.maximum(cur, 1)
-    rr_cmd = cmd[ridx, ridx, rr]
-    rr_committed = (status[ridx, ridx, rr] == ST_COMMIT) & ~jnp.any(
-        out_cmt_new["valid"], axis=1)
+    rr = ctx.t % jnp.maximum(cur, 1)                     # (R, G)
+    oh_rr = iidx[None, :, None] == rr[:, None, :]
+    mine = lambda pl: jnp.stack([pl[p, p] for p in range(R)], axis=0)
+    my_status = mine(status)                             # (R, I, G)
+    rr_cmd = jnp.sum(jnp.where(oh_rr, mine(cmd), 0), axis=1)
+    rr_seq = jnp.sum(jnp.where(oh_rr, mine(seq), 0), axis=1)
+    my_deps = mine(deps)                                 # (R, I, R, G)
+    rr_deps = jnp.sum(jnp.where(oh_rr[:, :, None, :], my_deps, 0), axis=1)
+    rr_committed = (jnp.sum(jnp.where(oh_rr, my_status, 0), axis=1)
+                    == ST_COMMIT) & ~do_commit
     out_cmt = {
-        "valid": out_cmt_new["valid"] | rr_committed[:, None],
+        "valid": out_cmt_new["valid"] | rr_committed[:, None, :],
         "inst": jnp.where(out_cmt_new["valid"], out_cmt_new["inst"],
-                          rr[:, None] * jnp.ones((1, R), jnp.int32)),
+                          rr[:, None, :]),
         "seq": jnp.where(out_cmt_new["valid"], out_cmt_new["seq"],
-                         seq[ridx, ridx, rr][:, None]),
+                         rr_seq[:, None, :]),
         "cmd": jnp.where(out_cmt_new["valid"], out_cmt_new["cmd"],
-                         rr_cmd[:, None]),
-        **{f"d{p}": jnp.where(out_cmt_new["valid"], out_cmt_new[f"d{p}"],
-                              deps[ridx, ridx, rr, p][:, None])
+                         rr_cmd[:, None, :]),
+        **{f"d{p}": jnp.where(out_cmt_new["valid"],
+                              out_cmt_new[f"d{p}"],
+                              rr_deps[:, None, p])
            for p in range(R)},
     }
 
+    # ================ RECOVERY =========================================
+    # ---------------- Prepare: raise cell ballots, reply ----------------
+    m = inbox["prep"]
+    v = T(m["valid"])                                    # (me, src, G)
+    pr_own = jnp.clip(T(m["owner"]), 0, R - 1)
+    pr_inst = jnp.clip(T(m["inst"]), 0, I - 1)
+    pr_bal = T(m["ballot"])
+    # per-cell max prepare ballot this step (collision: max wins)
+    oh5 = (v[:, :, None, None, :]
+           & (ridx[None, None, :, None, None] == pr_own[:, :, None, None, :])
+           & (iidx[None, None, None, :, None]
+              == pr_inst[:, :, None, None, :]))          # (me,src,own,I,G)
+    cell_max = jnp.max(jnp.where(oh5, pr_bal[:, :, None, None, :], 0),
+                       axis=1)                           # (me, own, I, G)
+    bal = jnp.maximum(bal, cell_max)
+    # reply per edge: src gets my recorded state for its requested cell
+    # iff its ballot won the cell (== new bal)
+    prepr_fields = []
+    for s in range(R):
+        o_s, i_s, b_s = pr_own[:, s], pr_inst[:, s], pr_bal[:, s]
+        ohc = ((ridx[None, :, None, None] == o_s[:, None, None, :])
+               & (iidx[None, None, :, None] == i_s[:, None, None, :]))
+        # ohc: (me, own, I, G); exactly one cell set
+
+        def cell(pl):
+            return jnp.sum(jnp.where(ohc, pl, 0), axis=(1, 2))
+
+        okr = v[:, s] & (b_s >= cell(bal))
+        st_s = cell(status)
+        cm_s = cell(cmd)
+        sq_s = cell(seq)
+        ab_s = cell(abal)
+        dp_s = jnp.sum(jnp.where(ohc[:, :, :, None, :], deps, 0),
+                       axis=(1, 2))
+        dp_s = jnp.where(st_s[:, None, :] >= ST_PRE, dp_s, -1)
+        # fresh conflict attrs for the cell's (deterministic) command
+        fr_cmd = encode_cmd(o_s, i_s)                    # (me, G)
+        f_seq, f_deps = conflict_attrs(cmd, seq, status,
+                                       fr_cmd[:, None, :],
+                                       o_s[:, None, :], i_s[:, None, :])
+        prepr_fields.append(dict(
+            ok=okr, owner=o_s, inst=i_s, ballot=b_s, stat=st_s,
+            cmdv=cm_s, seq=sq_s, abal=ab_s, deps=dp_s,
+            cseq=f_seq[:, 0], cdeps=f_deps[:, 0]))
+    out_prepr = {
+        "valid": jnp.stack([f["ok"] for f in prepr_fields], axis=1),
+        "owner": jnp.stack([f["owner"] for f in prepr_fields], axis=1),
+        "inst": jnp.stack([f["inst"] for f in prepr_fields], axis=1),
+        "ballot": jnp.stack([f["ballot"] for f in prepr_fields], axis=1),
+        "stat": jnp.stack([f["stat"] for f in prepr_fields], axis=1),
+        "cmdv": jnp.stack([f["cmdv"] for f in prepr_fields], axis=1),
+        "seq": jnp.stack([f["seq"] for f in prepr_fields], axis=1),
+        "abal": jnp.stack([f["abal"] for f in prepr_fields], axis=1),
+        "cseq": jnp.stack([f["cseq"] for f in prepr_fields], axis=1),
+        **{f"d{p}": jnp.stack([f["deps"][:, p] for f in prepr_fields],
+                              axis=1) for p in range(R)},
+        **{f"c{p}": jnp.stack([f["cdeps"][:, p] for f in prepr_fields],
+                              axis=1) for p in range(R)},
+    }
+    # NOTE: out_prepr planes are (me, dst, G) — me replies to each dst
+
+    # ---------------- PrepareReply tally at the recoverer ---------------
+    m = inbox["prepr"]
+    v = T(m["valid"])                                    # (me, src, G)
+    ok = (v & (T(m["owner"]) == rowner[:, None, :])
+          & (T(m["inst"]) == rinst[:, None, :])
+          & (T(m["ballot"]) == rballot[:, None, :])
+          & (rphase == 1)[:, None, :])
+    racks = racks | jnp.sum(
+        jnp.where(ok, (jnp.int32(1) << ridx)[None, :, None], 0), axis=1)
+    rstat = jnp.where(ok, T(m["stat"]), rstat)
+    rcmd = jnp.where(ok, T(m["cmdv"]), rcmd)
+    rseq2 = jnp.where(ok, T(m["seq"]), rseq2)
+    rabal = jnp.where(ok, T(m["abal"]), rabal)
+    rcseq = jnp.where(ok, T(m["cseq"]), rcseq)
+    rdeps2 = jnp.where(ok[:, :, None, :], _deps_T(m, R), rdeps2)
+    rcdeps = jnp.where(ok[:, :, None, :], _deps_T(m, R, "c"), rcdeps)
+
+    # ---------------- recovery decision ---------------------------------
+    acked = ((racks[:, None, :] >> ridx[None, :, None]) & 1).astype(bool)
+    # a committed reply is self-certifying; every other case needs the
+    # full FAST-sized prepare quorum (see THRESH above).  Recovery
+    # therefore needs R-FAST+1 failures to stall — the price of the
+    # fast path, as in the reference
+    n_rep = jax.lax.population_count(racks)
+    have_prep = (rphase == 1) & (n_rep >= FAST)          # (me, G)
+    st_ok = jnp.where(acked, rstat, ST_NONE)             # (me, rep, G)
+    # 1. any committed reply
+    is_com = st_ok == ST_COMMIT
+    any_com = jnp.any(is_com, axis=1)
+    # 2. any accepted reply: max abal wins
+    is_acc = st_ok == ST_ACC
+    any_acc = jnp.any(is_acc, axis=1)
+    acc_bal = jnp.max(jnp.where(is_acc, rabal, -1), axis=1)
+    # 3. identical ballot-0 preaccepts >= THRESH
+    is_pre = (st_ok == ST_PRE) & (rabal == 0)
+    same_ij = ((rseq2[:, :, None, :] == rseq2[:, None, :, :])
+               & jnp.all(rdeps2[:, :, None] == rdeps2[:, None, :],
+                         axis=3))                        # (me, i, j, G)
+    ident_cnt = jnp.sum(is_pre[:, :, None, :] & is_pre[:, None, :, :]
+                        & same_ij, axis=2)               # (me, i, G)
+    ident_cnt = jnp.where(is_pre, ident_cnt, 0)
+    has_ident = jnp.any(ident_cnt >= THRESH, axis=1)
+    # 4. any preaccept at all (regardless of recorded ballot)
+    any_pre = jnp.any(st_ok == ST_PRE, axis=1)
+
+    # decided attrs per case (first-match unrolled picks)
+    d_cmd = jnp.full((R, G), NO_CMD, jnp.int32)
+    d_seq = jnp.zeros((R, G), jnp.int32)
+    d_deps = jnp.full((R, R, G), -1, jnp.int32)
+    for s in range(R - 1, -1, -1):
+        pick_c = is_com[:, s]
+        d_cmd = jnp.where(pick_c, rcmd[:, s], d_cmd)
+        d_seq = jnp.where(pick_c, rseq2[:, s], d_seq)
+        d_deps = jnp.where(pick_c[:, None, :], rdeps2[:, s], d_deps)
+    a_cmd_d = jnp.full((R, G), NO_CMD, jnp.int32)
+    a_seq_d = jnp.zeros((R, G), jnp.int32)
+    a_deps_d = jnp.full((R, R, G), -1, jnp.int32)
+    for s in range(R - 1, -1, -1):
+        pick_a = is_acc[:, s] & (rabal[:, s] == acc_bal)
+        a_cmd_d = jnp.where(pick_a, rcmd[:, s], a_cmd_d)
+        a_seq_d = jnp.where(pick_a, rseq2[:, s], a_seq_d)
+        a_deps_d = jnp.where(pick_a[:, None, :], rdeps2[:, s], a_deps_d)
+    i_seq_d = jnp.zeros((R, G), jnp.int32)
+    i_deps_d = jnp.full((R, R, G), -1, jnp.int32)
+    best_cnt = jnp.max(ident_cnt, axis=1)
+    for s in range(R - 1, -1, -1):
+        pick_i = is_pre[:, s] & (ident_cnt[:, s] == best_cnt) \
+            & (best_cnt >= THRESH)
+        i_seq_d = jnp.where(pick_i, rseq2[:, s], i_seq_d)
+        i_deps_d = jnp.where(pick_i[:, None, :], rdeps2[:, s], i_deps_d)
+    # union case: recorded attrs of preaccepts + fresh attrs of all acked
+    pre_any = st_ok == ST_PRE
+    u_seq = jnp.maximum(
+        jnp.max(jnp.where(pre_any, rseq2, 0), axis=1),
+        jnp.max(jnp.where(acked, rcseq, 0), axis=1))
+    u_deps = jnp.maximum(
+        jnp.max(jnp.where(pre_any[:, :, None, :], rdeps2, -1), axis=1),
+        jnp.max(jnp.where(acked[:, :, None, :], rcdeps, -1), axis=1))
+    # the recovered instance never depends on itself
+    self_col = ridx[None, :, None] == rowner[:, None, :]  # (me, R, G)
+    u_deps = jnp.where(self_col & (u_deps == rinst[:, None, :]), -1,
+                       u_deps)
+
+    r_cmdv = encode_cmd(jnp.clip(rowner, 0, R - 1),
+                        jnp.clip(rinst, 0, I - 1))
+    dec_commit = (rphase == 1) & any_com
+    dec_accept = have_prep & ~any_com & (any_acc | has_ident | any_pre)
+    f_seq_d = jnp.where(any_acc, a_seq_d,
+                        jnp.where(has_ident, i_seq_d, u_seq))
+    f_deps_d = jnp.where(any_acc[:, None, :], a_deps_d,
+                         jnp.where(has_ident[:, None, :], i_deps_d,
+                                   u_deps))
+    # accepted values may themselves be NOOPs from an earlier recovery;
+    # preaccepted values are always the owner's real command
+    f_cmd_d = jnp.where(any_acc, a_cmd_d, r_cmdv)
+    dec_noop = have_prep & ~any_com & ~any_acc & ~has_ident & ~any_pre
+
+    # commit-now path (case 1 and the NOOP case): apply + broadcast rcmt
+    do_rcmt = dec_commit | dec_noop
+    cm_cmd2 = jnp.where(dec_commit, d_cmd, NO_CMD)
+    cm_seq2 = jnp.where(dec_commit, d_seq, 0)
+    cm_deps2 = jnp.where(dec_commit[:, None, :], d_deps, -1)
+    # accept path: record decided attrs, broadcast racc at rballot
+    rdcmd = jnp.where(dec_accept, f_cmd_d, rdcmd)
+    rdseq = jnp.where(dec_accept, f_seq_d, rdseq)
+    rddeps = jnp.where(dec_accept[:, None, :], f_deps_d, rddeps)
+    rphase = jnp.where(do_rcmt, 0, jnp.where(dec_accept, 2, rphase))
+    aacks = jnp.where(dec_accept, self_bit, aacks)
+    rstuck = jnp.where(do_rcmt | dec_accept, 0, rstuck)
+
+    # ---------------- recovery Accept handling (racc) -------------------
+    m = inbox["racc"]
+    v = T(m["valid"])
+    ra_own = jnp.clip(T(m["owner"]), 0, R - 1)
+    ra_inst = jnp.clip(T(m["inst"]), 0, I - 1)
+    ra_bal = T(m["ballot"])
+    ra_cmdv = T(m["cmdv"])
+    ra_seq = T(m["seq"])
+    ra_deps = _deps_T(m, R)
+    oh5 = (v[:, :, None, None, :]
+           & (ridx[None, None, :, None, None] == ra_own[:, :, None, None, :])
+           & (iidx[None, None, None, :, None]
+              == ra_inst[:, :, None, None, :]))
+    bal_b = jnp.broadcast_to(ra_bal[:, :, None, None, :], oh5.shape)
+    gate = oh5 & (bal_b >= bal[:, None]) & (status[:, None] < ST_COMMIT)
+    # per-cell winner: max ballot among gating raccs this step
+    win_bal = jnp.max(jnp.where(gate, bal_b, -1), axis=1)  # (me,own,I,G)
+    any_win = win_bal >= 0
+    wf = jnp.zeros((R, R, I, G), jnp.int32)
+    ws = jnp.zeros((R, R, I, G), jnp.int32)
+    wd = jnp.full((R, R, I, R, G), -1, jnp.int32)
+    for s in range(R - 1, -1, -1):
+        hit = gate[:, s] & (bal_b[:, s] == win_bal)
+        wf = jnp.where(hit, ra_cmdv[:, s, None, None, :], wf)
+        ws = jnp.where(hit, ra_seq[:, s, None, None, :], ws)
+        wd = jnp.where(hit[:, :, :, None, :],
+                       ra_deps[:, s, None, None, :, :], wd)
+    cmd = jnp.where(any_win, wf, cmd)
+    seq = jnp.where(any_win, ws, seq)
+    deps = jnp.where(any_win[:, :, :, None, :], wd, deps)
+    status = jnp.where(any_win, jnp.maximum(status, ST_ACC), status)
+    abal = jnp.where(any_win, win_bal, abal)
+    bal = jnp.where(any_win, win_bal, bal)
+    # raccr to each src whose ballot won its cell
+    okr = []
+    for s in range(R):
+        hit = gate[:, s] & (bal_b[:, s] == win_bal)
+        okr.append(jnp.any(hit, axis=(1, 2)))
+    out_raccr = {
+        "valid": jnp.stack(okr, axis=1),
+        "owner": T(m["owner"]),
+        "inst": T(m["inst"]),
+        "ballot": T(m["ballot"]),
+    }
+
+    # ---------------- raccr tally -> rcmt --------------------------------
+    m = inbox["raccr"]
+    ok = (T(m["valid"]) & (T(m["owner"]) == rowner[:, None, :])
+          & (T(m["inst"]) == rinst[:, None, :])
+          & (T(m["ballot"]) == rballot[:, None, :])
+          & (rphase == 2)[:, None, :])
+    aacks = aacks | jnp.sum(
+        jnp.where(ok, (jnp.int32(1) << ridx)[None, :, None], 0), axis=1)
+    acc_done = (rphase == 2) & (jax.lax.population_count(aacks) >= MAJ)
+    do_rcmt2 = do_rcmt | acc_done
+    cm_cmd2 = jnp.where(acc_done, rdcmd, cm_cmd2)
+    cm_seq2 = jnp.where(acc_done, rdseq, cm_seq2)
+    cm_deps2 = jnp.where(acc_done[:, None, :], rddeps, cm_deps2)
+    rphase = jnp.where(acc_done, 0, rphase)
+    recovered = recovered + jnp.sum(do_rcmt2, axis=0)
+    out_rcmt = {
+        "valid": jnp.broadcast_to(do_rcmt2[:, None, :], (R, R, G)),
+        "owner": jnp.broadcast_to(rowner[:, None, :], (R, R, G)),
+        "inst": jnp.broadcast_to(rinst[:, None, :], (R, R, G)),
+        "cmdv": jnp.broadcast_to(cm_cmd2[:, None, :], (R, R, G)),
+        "seq": jnp.broadcast_to(cm_seq2[:, None, :], (R, R, G)),
+        **_deps_out(cm_deps2, R, (R, R, G)),
+    }
+    # apply my own recovery commit locally
+    oh_rc = ((ridx[None, :, None, None]
+              == jnp.clip(rowner, 0, R - 1)[:, None, None, :])
+             & (iidx[None, None, :, None]
+                == jnp.clip(rinst, 0, I - 1)[:, None, None, :]))
+    wr = do_rcmt2[:, None, None, :] & oh_rc & (status < ST_COMMIT)
+    cmd = jnp.where(wr, cm_cmd2[:, None, None, :], cmd)
+    seq = jnp.where(wr, cm_seq2[:, None, None, :], seq)
+    deps = jnp.where(wr[:, :, :, None, :], cm_deps2[:, None, None, :, :],
+                     deps)
+    status = jnp.where(wr, ST_COMMIT, status)
+
+    # ---------------- rcmt delivery --------------------------------------
+    m = inbox["rcmt"]
+    v = T(m["valid"])
+    rc_own = jnp.clip(T(m["owner"]), 0, R - 1)
+    rc_inst = jnp.clip(T(m["inst"]), 0, I - 1)
+    rc_cmdv = T(m["cmdv"])
+    rc_seq = T(m["seq"])
+    rc_deps = _deps_T(m, R)
+    oh5 = (v[:, :, None, None, :]
+           & (ridx[None, None, :, None, None] == rc_own[:, :, None, None, :])
+           & (iidx[None, None, None, :, None]
+              == rc_inst[:, :, None, None, :]))
+    hit_any = jnp.any(oh5, axis=1)                       # (me, own, I, G)
+    wf = jnp.zeros((R, R, I, G), jnp.int32)
+    ws = jnp.zeros((R, R, I, G), jnp.int32)
+    wd = jnp.full((R, R, I, R, G), -1, jnp.int32)
+    for s in range(R - 1, -1, -1):
+        hit = oh5[:, s]
+        wf = jnp.where(hit, rc_cmdv[:, s, None, None, :], wf)
+        ws = jnp.where(hit, rc_seq[:, s, None, None, :], ws)
+        wd = jnp.where(hit[:, :, :, None, :],
+                       rc_deps[:, s, None, None, :, :], wd)
+    wr = hit_any & (status < ST_COMMIT)
+    cmd = jnp.where(wr, wf, cmd)
+    seq = jnp.where(wr, ws, seq)
+    deps = jnp.where(wr[:, :, :, None, :], wd, deps)
+    status = jnp.where(wr, ST_COMMIT, status)
+
     # ---------------- execution: closure -> SCC -> ordered apply --------
-    committed = (status == ST_COMMIT).reshape(R, N)
-    seq_f = seq.reshape(R, N)
-    cmd_f = cmd.reshape(R, N)
-    exec_f = executed.reshape(R, N)
-    # adjacency: u=(p,j) -> v=(q, deps[u][q])
-    A = jnp.zeros((R, N, N), bool)
-    deps_f = deps.reshape(R, N, R)
+    committed = (status == ST_COMMIT).reshape(R, NN, G)
+    seq_f = seq.reshape(R, NN, G)
+    cmd_f = cmd.reshape(R, NN, G)
+    exec_f = executed.reshape(R, NN, G)
+    deps_f = deps.reshape(R, NN, R, G)
+    A = jnp.zeros((R, NN, NN, G), bool)
     for q in range(R):
-        tgt = deps_f[:, :, q]                              # (R, N)
+        tgt = deps_f[:, :, q, :]                         # (R, NN, G)
         has = tgt >= 0
         col = q * I + jnp.clip(tgt, 0, I - 1)
-        A = A | (has[:, :, None]
-                 & (jnp.arange(N)[None, None, :] == col[:, :, None]))
-    A = A & committed[:, :, None]       # only committed sources constrain
-    # MXU-shaped reachability: Pallas VMEM-resident squaring on TPU,
-    # plain XLA elsewhere (ops/closure.py)
-    reach = transitive_closure(A)
-    # an instance is ready when every reachable dep is committed
-    blocked = jnp.any(reach & ~committed[:, None, :], axis=2)
+        A = A | (has[:, :, None, :]
+                 & (jnp.arange(NN)[None, None, :, None]
+                    == col[:, :, None, :]))
+    A = A & committed[:, :, None, :]    # only committed sources constrain
+    reach = jnp.moveaxis(
+        transitive_closure(jnp.moveaxis(A, -1, 1)), 1, -1)
+    blocked = jnp.any(reach & ~committed[:, None, :, :], axis=2)
     ready = committed & ~blocked & ~exec_f
     scc = reach & jnp.swapaxes(reach, 1, 2)
     cross = reach & ~scc
-    exec_ok = ready & ~jnp.any(cross & ~exec_f[:, None, :], axis=2)
-    # apply up to exec_window commands in global (seq, id) order
-    BIG = jnp.int32(1 << 20)
-    order = seq_f * N + jnp.arange(N)[None, :]
+    exec_ok = ready & ~jnp.any(cross & ~exec_f[:, None, :, :], axis=2)
+    BIG = jnp.int32(1 << 28)
+    order = seq_f * NN + jnp.arange(NN, dtype=jnp.int32)[None, :, None]
     new_exec = exec_f
+    kidx = jnp.arange(K, dtype=jnp.int32)
     for _ in range(cfg.exec_window):
         cand = exec_ok & ~new_exec
-        pick = jnp.argmin(jnp.where(cand, order, BIG), axis=1)   # (R,)
-        any_c = jnp.any(cand, axis=1)
-        c_e = cmd_f[ridx, pick]
+        any_c = jnp.any(cand, axis=1)                    # (R, G)
+        best = jnp.min(jnp.where(cand, order, BIG), axis=1)
+        oh_pick = cand & (order == best[:, None, :])
+        c_e = jnp.sum(jnp.where(oh_pick, cmd_f, 0), axis=1)
         k_e = cmd_key(c_e, K)
-        ohk = any_c[:, None] & (jnp.arange(K)[None, :] == k_e[:, None])
-        khash = jnp.where(ohk, khash * HASH_PRIME + c_e[:, None], khash)
+        upd = any_c & (c_e != NO_CMD)
+        ohk = upd[:, None, :] & (kidx[None, :, None] == k_e[:, None, :])
+        khash = jnp.where(ohk, khash * HASH_PRIME + c_e[:, None, :],
+                          khash)
         kcount = kcount + ohk
-        new_exec = new_exec | (any_c[:, None]
-                               & (jnp.arange(N)[None, :] == pick[:, None]))
-    executed = new_exec.reshape(R, R, I)
+        new_exec = new_exec | oh_pick
+    executed = new_exec.reshape(R, R, I, G)
+
+    # ---------------- recovery trigger: age blocking cells ---------------
+    # a cell is "needed" when committed-unexecuted work reaches it and it
+    # is not committed — exactly the frontier blockers
+    src_live = committed & ~new_exec
+    needed = (jnp.any(src_live[:, :, None, :] & reach, axis=1)
+              & ~committed).reshape(R, R, I, G)
+    age = jnp.where(needed, age + 1, 0)
+    # staggered per-replica patience breaks recoverer duels
+    patience = cfg.election_timeout + ridx[:, None] * cfg.backoff
+    age_f = age.reshape(R, NN, G)
+    worst = jnp.max(age_f, axis=1)                       # (R, G)
+    fire = (rphase == 0) & (worst > patience)
+    pick = jnp.argmax(age_f, axis=1).astype(jnp.int32)   # (R, G)
+    f_own = pick // I
+    f_inst = pick % I
+    # ballot: above anything I've seen for the cell, tagged with my id
+    oh_f = ((ridx[None, :, None, None] == f_own[:, None, None, :])
+            & (iidx[None, None, :, None] == f_inst[:, None, None, :]))
+    cell_bal = jnp.max(jnp.where(oh_f, bal, 0), axis=(1, 2))
+    new_rbal = (jnp.maximum(cell_bal, rballot) // cfg.ballot_stride + 1) \
+        * cfg.ballot_stride + ridx[:, None]
+    rowner = jnp.where(fire, f_own, rowner)
+    rinst = jnp.where(fire, f_inst, rinst)
+    rballot = jnp.where(fire, new_rbal, rballot)
+    rphase = jnp.where(fire, 1, rphase)
+    racks = jnp.where(fire, self_bit, racks)
+    rstuck = jnp.where(fire, 0, rstuck)
+    # my own promise + self-reply into the tally
+    bal = jnp.where(fire[:, None, None, :] & oh_f,
+                    jnp.maximum(bal, new_rbal[:, None, None, :]), bal)
+    self_stat = jnp.sum(jnp.where(oh_f, status, 0), axis=(1, 2))
+    self_cmd = jnp.sum(jnp.where(oh_f, cmd, 0), axis=(1, 2))
+    self_seq = jnp.sum(jnp.where(oh_f, seq, 0), axis=(1, 2))
+    self_abal = jnp.sum(jnp.where(oh_f, abal, 0), axis=(1, 2))
+    self_deps = jnp.sum(jnp.where(oh_f[:, :, :, None, :], deps, 0),
+                        axis=(1, 2))
+    self_deps = jnp.where(self_stat[:, None, :] >= ST_PRE, self_deps, -1)
+    sf_cmd = encode_cmd(f_own, f_inst)
+    sf_seq, sf_deps = conflict_attrs(cmd, seq, status, sf_cmd[:, None, :],
+                                     f_own[:, None, :], f_inst[:, None, :])
+    eye = (ridx[:, None, None] == ridx[None, :, None])   # (me, rep, 1)
+    rstat = jnp.where(fire[:, None, :] & eye, self_stat[:, None, :], rstat)
+    rcmd = jnp.where(fire[:, None, :] & eye, self_cmd[:, None, :], rcmd)
+    rseq2 = jnp.where(fire[:, None, :] & eye, self_seq[:, None, :], rseq2)
+    rabal = jnp.where(fire[:, None, :] & eye, self_abal[:, None, :], rabal)
+    rcseq = jnp.where(fire[:, None, :] & eye, sf_seq[:, 0][:, None, :],
+                      rcseq)
+    rdeps2 = jnp.where((fire[:, None, :] & eye)[:, :, None, :],
+                       self_deps[:, None, :, :], rdeps2)
+    rcdeps = jnp.where((fire[:, None, :] & eye)[:, :, None, :],
+                       sf_deps[:, 0][:, None, :, :], rcdeps)
+
+    # recovery retransmit / give-up
+    rstuck = jnp.where(rphase > 0, rstuck + 1, 0)
+    r_retry = (rphase > 0) & (rstuck >= cfg.retry_timeout)
+    give_up = rstuck >= 3 * cfg.retry_timeout
+    rphase = jnp.where(give_up, 0, rphase)
+    out_prep = {
+        "valid": jnp.broadcast_to(
+            (fire | (r_retry & (rphase == 1)))[:, None, :], (R, R, G)),
+        "owner": jnp.broadcast_to(rowner[:, None, :], (R, R, G)),
+        "inst": jnp.broadcast_to(rinst[:, None, :], (R, R, G)),
+        "ballot": jnp.broadcast_to(rballot[:, None, :], (R, R, G)),
+    }
+    out_racc = {
+        "valid": jnp.broadcast_to(
+            (dec_accept | (r_retry & (rphase == 2)))[:, None, :],
+            (R, R, G)),
+        "owner": jnp.broadcast_to(rowner[:, None, :], (R, R, G)),
+        "inst": jnp.broadcast_to(rinst[:, None, :], (R, R, G)),
+        "ballot": jnp.broadcast_to(rballot[:, None, :], (R, R, G)),
+        "cmdv": jnp.broadcast_to(rdcmd[:, None, :], (R, R, G)),
+        "seq": jnp.broadcast_to(rdseq[:, None, :], (R, R, G)),
+        **_deps_out(rddeps, R, (R, R, G)),
+    }
 
     new_state = dict(
         cmd=cmd, seq=seq, deps=deps, status=status, executed=executed,
-        cur=cur, phase=phase, pa_acks=pa_acks, ac_acks=ac_acks,
-        agree=agree, seq0=seq0, deps0=deps0, mseq=mseq, mdeps=mdeps,
-        stuck=stuck, kcount=kcount, khash=khash,
+        bal=bal, abal=abal, age=age, cur=cur, phase=phase,
+        pa_acks=pa_acks, ac_acks=ac_acks, agree=agree, seq0=seq0,
+        deps0=deps0, mseq=mseq, mdeps=mdeps, stuck=stuck,
+        rphase=rphase, rowner=rowner, rinst=rinst, rballot=rballot,
+        rstuck=rstuck, racks=racks, rstat=rstat, rcmd=rcmd, rseq2=rseq2,
+        rabal=rabal, rdeps2=rdeps2, rcseq=rcseq, rcdeps=rcdeps,
+        rdcmd=rdcmd, rdseq=rdseq, rddeps=rddeps, aacks=aacks,
+        recovered=recovered, kcount=kcount, khash=khash,
     )
     outbox = {"pa": out_pa, "par": out_par, "acc": out_acc,
-              "accr": out_accr, "cmt": out_cmt}
+              "accr": out_accr, "cmt": out_cmt, "prep": out_prep,
+              "prepr": out_prepr, "racc": out_racc, "raccr": out_raccr,
+              "rcmt": out_rcmt}
     return new_state, outbox
 
 
 def metrics(state, cfg: SimConfig):
-    com = jnp.any(state["status"] == ST_COMMIT, axis=0)    # (R, I) anywhere
+    com = jnp.any(state["status"] == ST_COMMIT, axis=0)  # (R, I, G)
     return {
         "committed_slots": jnp.sum(com),
-        "executed": jnp.max(jnp.sum(state["executed"], axis=(1, 2))),
-        "fastpath_cur": jnp.sum(state["cur"]),
+        "executed": jnp.sum(jnp.max(
+            jnp.sum(state["executed"], axis=(1, 2)), axis=0)),
+        "recovered": jnp.sum(state["recovered"]),
     }
 
 
@@ -424,12 +903,12 @@ def invariants(old, new, cfg: SimConfig) -> jax.Array:
     attrs or un-commit; executed is monotone.  3. Executed implies
     committed.  4. Execution-order agreement: replicas with equal
     per-key counts have equal per-key hash chains."""
-    c = new["status"] == ST_COMMIT                        # (Rv, R, I)
-    pair = c[:, None] & c[None, :]                        # (Rv, Rv, R, I)
+    c = new["status"] == ST_COMMIT                       # (me, R, I, G)
+    pair = c[:, None] & c[None, :]
     same = ((new["cmd"][:, None] == new["cmd"][None, :])
             & (new["seq"][:, None] == new["seq"][None, :])
             & jnp.all(new["deps"][:, None] == new["deps"][None, :],
-                      axis=-1))
+                      axis=4))
     v_agree = jnp.sum(pair & ~same) // 2
 
     was = old["status"] == ST_COMMIT
@@ -437,7 +916,7 @@ def invariants(old, new, cfg: SimConfig) -> jax.Array:
                               | (new["cmd"] != old["cmd"])
                               | (new["seq"] != old["seq"])
                               | jnp.any(new["deps"] != old["deps"],
-                                        axis=-1)))
+                                        axis=3)))
     v_exec_mono = jnp.sum(old["executed"] & ~new["executed"])
     v_exec_com = jnp.sum(new["executed"] & ~c)
 
@@ -456,4 +935,5 @@ PROTOCOL = SimProtocol(
     step=step,
     metrics=metrics,
     invariants=invariants,
+    batched=True,
 )

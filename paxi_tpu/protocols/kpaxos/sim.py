@@ -41,7 +41,8 @@ import jax
 import jax.numpy as jnp
 
 from paxi_tpu.ops.hashing import fib_key
-from paxi_tpu.sim.ring import require_packable, shift_window
+from paxi_tpu.sim.ring import (dst_major, require_packable,
+                               shift_window)
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 NO_CMD = -1
@@ -100,8 +101,7 @@ def step(state, inbox, ctx: StepCtx):
     kv = state["kv"]
     G = next_slot.shape[-1]
 
-    def T(x):  # mailbox (src, dst, G) -> (me=dst, src=partition, G)
-        return jnp.swapaxes(x, 0, 1)
+    T = dst_major  # mailbox (src, dst, G) -> (me=dst, src=partition, G)
 
     def diag(x):  # (R, P, ...) -> (R, ...) at part == replica
         return jnp.stack([x[p, p] for p in range(R)], axis=0)

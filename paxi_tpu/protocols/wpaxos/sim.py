@@ -49,7 +49,8 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
-from paxi_tpu.sim.ring import require_packable, shift_window
+from paxi_tpu.sim.ring import (dst_major, require_packable,
+                               shift_window)
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 NO_CMD = -1
@@ -146,8 +147,7 @@ def step(state, inbox, ctx: StepCtx):
     steals = state["steals"]
     G = steal_obj.shape[-1]
 
-    def T(x):  # mailbox (src, dst, G) -> (me=dst, src, G)
-        return jnp.swapaxes(x, 0, 1)
+    T = dst_major          # mailbox (src, dst, G) -> (me=dst, src, G)
 
     def at_obj(plane, obj):
         """plane (R, O, G) selected at obj (R, G) -> (R, G)."""
