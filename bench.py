@@ -249,11 +249,15 @@ def launcher() -> int:
                 print(json.dumps(result), flush=True)
                 try:
                     # the worker may still be running the optional
-                    # scaling sweep; never signal it mid-execution —
-                    # an orphaned worker finishes and exits on its own
-                    proc.wait(timeout=120)
+                    # scaling sweep; never signal it mid-execution (an
+                    # orphan holding the device wedges the NEXT init),
+                    # wait generously for a clean exit instead
+                    proc.wait(timeout=float(
+                        os.environ.get("BENCH_SWEEP_WAIT_S", "900")))
                 except subprocess.TimeoutExpired:
-                    pass
+                    _log_attempt("worker still in scaling sweep at "
+                                 "launcher exit — left to finish "
+                                 "unsignalled (may hold the device)")
                 return 0 if result.get("invariant_violations", 1) == 0 \
                     else 1
             _abandon(proc)
@@ -281,9 +285,11 @@ def launcher() -> int:
     if result is not None:
         print(json.dumps(result), flush=True)
         try:
-            proc.wait(timeout=120)   # may still be in the scaling sweep
+            proc.wait(timeout=float(
+                os.environ.get("BENCH_SWEEP_WAIT_S", "900")))
         except subprocess.TimeoutExpired:
-            pass
+            _log_attempt("cpu worker still in scaling sweep at launcher "
+                         "exit — left to finish unsignalled")
         return 0 if result.get("invariant_violations", 1) == 0 else 1
 
     # Last resort: a tiny inline CPU measurement in THIS process (no
@@ -294,6 +300,7 @@ def launcher() -> int:
     os.environ["BENCH_FALLBACK"] = "1"
     os.environ["BENCH_CPU_GROUPS"] = "256"
     os.environ["BENCH_CPU_SLOTS"] = "25600"
+    os.environ["BENCH_SCALING"] = "0"   # tiny means tiny: no sweep
     return worker()
 
 

@@ -4,9 +4,10 @@ Reference: paxi quorum.go — ``Quorum{size, acks, zones}`` with ``ACK(id)``,
 ``Majority()``, fast quorum (ceil(3N/4), EPaxos), zone quorums
 (``ZoneMajority``) and flexible grid quorums (Q1 rows x Q2 columns,
 WPaxos).  This host-side class mirrors that surface; the sim runtime's
-equivalent is an ack *bitmask/bool-matrix popcount* (see
-paxi_tpu.ops.bitops and the protocol kernels) — Quorum.ACK lifts to a
-bitwise-or, Majority() to a popcount compare.
+equivalent is a bit-packed int32 ack mask per quorum site (see the
+protocol kernels, e.g. protocols/paxos/sim.py ``p1_acks``/``log_acks``
+and protocols/wpaxos/sim.py ``_zone_quorums``) — Quorum.ACK lifts to a
+bitwise-or, Majority() to a ``lax.population_count`` compare.
 """
 
 from __future__ import annotations

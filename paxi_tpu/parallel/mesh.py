@@ -24,7 +24,7 @@ import jax.random as jr
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from paxi_tpu.sim.runner import _finish, init_carry, make_scan_body
+from paxi_tpu.sim.runner import finish_run, init_carry, make_scan_body
 from paxi_tpu.sim.types import FAULT_FREE, FuzzConfig, SimConfig, SimProtocol
 
 
@@ -69,7 +69,7 @@ def make_sharded_run(proto: SimProtocol, cfg: SimConfig,
             carry, viols = jax.lax.scan(body, carry, jnp.arange(n_steps))
             # the shared aggregation tail (group-major public state for
             # either layout), then reduce across shards
-            state, metrics, viol = _finish(proto, cfg, carry, viols)
+            state, metrics, viol = finish_run(proto, cfg, carry, viols)
             metrics = {k: jax.lax.psum(v, axis) for k, v in metrics.items()}
             viol = jax.lax.psum(viol, axis)
             return state, metrics, viol

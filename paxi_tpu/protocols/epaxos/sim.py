@@ -66,7 +66,7 @@ import jax.numpy as jnp
 
 from paxi_tpu.ops.closure import transitive_closure
 from paxi_tpu.ops.hashing import fib_key
-from paxi_tpu.sim.ring import dst_major, require_packable
+from paxi_tpu.sim.ring import diag2, dst_major, require_packable
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 NO_CMD = -1
@@ -394,7 +394,7 @@ def step(state, inbox, ctx: StepCtx):
     # my in-flight instance was finished externally (a recoverer drove
     # it to commit, possibly as NOOP): move on — in ANY phase, including
     # idle, or the owner's pipeline deadlocks on the recovered cell
-    my_status0 = jnp.stack([status[p, p] for p in range(R)], axis=0)
+    my_status0 = diag2(status)
     ext_commit = (cur < I) & ~do_commit & (jnp.sum(
         jnp.where(iidx[None, :, None] == curc[:, None, :],
                   my_status0, 0), axis=1) == ST_COMMIT)
@@ -451,7 +451,7 @@ def step(state, inbox, ctx: StepCtx):
     # instances so followers with dropped cmt messages eventually heal
     rr = ctx.t % jnp.maximum(cur, 1)                     # (R, G)
     oh_rr = iidx[None, :, None] == rr[:, None, :]
-    mine = lambda pl: jnp.stack([pl[p, p] for p in range(R)], axis=0)
+    mine = diag2
     my_status = mine(status)                             # (R, I, G)
     rr_cmd = jnp.sum(jnp.where(oh_rr, mine(cmd), 0), axis=1)
     rr_seq = jnp.sum(jnp.where(oh_rr, mine(seq), 0), axis=1)
@@ -845,9 +845,11 @@ def step(state, inbox, ctx: StepCtx):
     rcdeps = jnp.where((fire[:, None, :] & eye)[:, :, None, :],
                        sf_deps[:, 0][:, None, :, :], rcdeps)
 
-    # recovery retransmit / give-up
+    # recovery retransmit (periodic, not every-step: rstuck is kept
+    # monotone for the give-up horizon, so retry on the cadence)
     rstuck = jnp.where(rphase > 0, rstuck + 1, 0)
-    r_retry = (rphase > 0) & (rstuck >= cfg.retry_timeout)
+    r_retry = (rphase > 0) & (rstuck > 0) \
+        & (rstuck % cfg.retry_timeout == 0)
     give_up = rstuck >= 3 * cfg.retry_timeout
     rphase = jnp.where(give_up, 0, rphase)
     out_prep = {

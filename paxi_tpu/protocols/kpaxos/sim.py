@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from paxi_tpu.ops.hashing import fib_key
-from paxi_tpu.sim.ring import (dst_major, require_packable,
+from paxi_tpu.sim.ring import (diag2, dst_major, require_packable,
                                shift_window)
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
@@ -103,8 +103,7 @@ def step(state, inbox, ctx: StepCtx):
 
     T = dst_major  # mailbox (src, dst, G) -> (me=dst, src=partition, G)
 
-    def diag(x):  # (R, P, ...) -> (R, ...) at part == replica
-        return jnp.stack([x[p, p] for p in range(R)], axis=0)
+    diag = diag2   # (R, P, ...) -> (R, ...) at part == replica
 
     # ---------------- P2a: accept for partition == src ------------------
     m = inbox["p2a"]

@@ -25,6 +25,14 @@ import numpy as np
 
 _META_KEY = "__paxi_tpu_meta__"
 _SEP = "|"
+# bump when a kernel's carry layout changes incompatibly (e.g. the
+# r4 group-major -> lane-major migration): load_carry turns a mismatch
+# into a clear "incompatible layout" error instead of a bare shape error
+LAYOUT_VERSION = 2
+
+
+def layout_version(meta: dict) -> int:
+    return int(meta.get("layout_version", 1))
 
 
 def _flatten(carry: Any) -> Dict[str, np.ndarray]:
@@ -44,8 +52,10 @@ def _norm(path: str) -> str:
 def save_carry(path: str, carry: Any, meta: Optional[dict] = None) -> None:
     """Write a resumable checkpoint of a simulation carry."""
     flat = _flatten(carry)
+    meta = dict(meta or {})
+    meta.setdefault("layout_version", LAYOUT_VERSION)
     flat[_META_KEY] = np.frombuffer(
-        json.dumps(meta or {}).encode(), dtype=np.uint8)
+        json.dumps(meta).encode(), dtype=np.uint8)
     np.savez_compressed(_norm(path), **flat)
 
 
@@ -56,6 +66,11 @@ def load_carry(path: str, like: Any) -> Tuple[Any, dict]:
         meta = json.loads(bytes(z[_META_KEY]).decode()) if _META_KEY in z \
             else {}
         flat = {k: z[k] for k in z.files if k != _META_KEY}
+    if layout_version(meta) != LAYOUT_VERSION:
+        raise ValueError(
+            f"checkpoint layout v{layout_version(meta)} is incompatible "
+            f"with this build (v{LAYOUT_VERSION}): kernel carry layouts "
+            "changed; re-run the simulation from scratch")
     leaves = jax.tree_util.tree_flatten_with_path(like)
     out_leaves = []
     for path_k, leaf in leaves[0]:

@@ -32,6 +32,13 @@ def dst_major(x):
     return jnp.swapaxes(x, 0, 1)
 
 
+def diag2(x):
+    """State plane (R, R, ...) -> (R, ...) at second-index == replica —
+    a replica's own row (its own partition/instance column), unrolled
+    over the tiny R axis."""
+    return jnp.stack([x[p, p] for p in range(x.shape[0])], axis=0)
+
+
 def shift_window(arr, adv, fill):
     """Slide ``arr (..., S, G)`` forward along the slot axis by
     ``adv (..., G)`` >= 0: out[..., i, g] = arr[..., i + adv[..., g], g]

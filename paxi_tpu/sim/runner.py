@@ -85,10 +85,7 @@ def make_scan_body(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig):
     vmapped over a leading group axis."""
     step1 = functools.partial(_group_step, proto, cfg, fuzz)
     if proto.batched:
-        def body(carry, t):
-            return step1(carry, t)
-
-        return body
+        return step1
 
     def body(carry, t):
         carry, viol = jax.vmap(step1, in_axes=(0, None))(carry, t)
@@ -97,13 +94,14 @@ def make_scan_body(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig):
     return body
 
 
-def _finish(proto: SimProtocol, cfg: SimConfig, carry, viols):
+def finish_run(proto: SimProtocol, cfg: SimConfig, carry, viols):
     """Shared aggregation tail: per-group metrics summed over groups.
     One implementation for both the straight and the resumed path, so
-    checkpointed runs can never diverge from uninterrupted ones.
-    Lane-major kernels aggregate internally; their final state is
-    transposed back to the public group-major layout (one cheap
-    transpose per run, outside the hot loop)."""
+    checkpointed runs can never diverge from uninterrupted ones — and
+    part of the runner's cross-module contract (parallel/mesh.py calls
+    it inside each shard).  Lane-major kernels aggregate internally;
+    their final state is transposed back to the public group-major
+    layout (one cheap transpose per run, outside the hot loop)."""
     state = carry[0]
     if proto.batched:
         metrics = proto.metrics(state, cfg)
@@ -127,7 +125,7 @@ def make_run(proto: SimProtocol, cfg: SimConfig,
     def run(rng, n_groups: int, n_steps: int):
         carry = init_carry(proto, cfg, fuzz, n_groups, rng)
         carry, viols = jax.lax.scan(body, carry, jnp.arange(n_steps))
-        return _finish(proto, cfg, carry, viols)
+        return finish_run(proto, cfg, carry, viols)
 
     return run
 
@@ -163,7 +161,7 @@ def continue_run(proto: SimProtocol, cfg: SimConfig, carry,
         def run(carry, t0, n_steps: int):
             carry, viols = jax.lax.scan(body, carry,
                                         t0 + jnp.arange(n_steps))
-            return carry, *_finish(proto, cfg, carry, viols)
+            return carry, *finish_run(proto, cfg, carry, viols)
 
         _CONTINUE_CACHE[key] = run
     carry, state, metrics, viols = run(carry, jnp.int32(t0), n_steps)

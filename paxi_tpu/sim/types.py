@@ -127,6 +127,10 @@ class SimProtocol:
 
     name: str
     mailbox_spec: Callable[[SimConfig], Dict[str, Tuple[str, ...]]]
+    # two accepted signatures, keyed on ``batched`` below:
+    #   batched=False -> init_state(cfg, rng) builds ONE group's state
+    #   batched=True  -> init_state(cfg, rng, n_groups) builds the whole
+    #                    lane-major batch (group axis LAST)
     init_state: Callable[..., State]
     step: Callable[[State, Mailboxes, StepCtx], Tuple[State, Mailboxes]]
     metrics: Callable[[State, SimConfig], Dict[str, Array]]

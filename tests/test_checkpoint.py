@@ -21,7 +21,8 @@ def test_resume_equals_straight_run(tmp_path):
     path = str(tmp_path / "ck.npz")
     save_carry(path, carry, meta={"t": 30, "proto": "paxos"})
     carry2, meta = load_carry(path, carry)
-    assert meta == {"t": 30, "proto": "paxos"}
+    assert meta["t"] == 30 and meta["proto"] == "paxos"
+    assert "layout_version" in meta   # stamped automatically on save
     res2, _ = continue_run(PAXOS, cfg, carry2, 30, 30, fuzz=fuzz)
 
     assert int(straight.violations) == 0
