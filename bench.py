@@ -224,6 +224,19 @@ def _log_attempt(line: str) -> None:
         pass
 
 
+def _wait_for_sweep(proc: subprocess.Popen, label: str) -> None:
+    """The worker may still be running the optional scaling sweep after
+    emitting its result line; never signal it mid-execution (an orphan
+    holding the device wedges the NEXT init) — wait generously for a
+    clean exit and attest if one has to be left behind."""
+    try:
+        proc.wait(timeout=float(os.environ.get("BENCH_SWEEP_WAIT_S",
+                                               "900")))
+    except subprocess.TimeoutExpired:
+        _log_attempt(f"{label} still in scaling sweep at launcher exit "
+                     "— left to finish unsignalled")
+
+
 def launcher() -> int:
     env = dict(os.environ, BENCH_STAGE="worker")
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "420"))
@@ -247,17 +260,7 @@ def launcher() -> int:
                              f"{result.get('device')} value="
                              f"{result.get('value')}")
                 print(json.dumps(result), flush=True)
-                try:
-                    # the worker may still be running the optional
-                    # scaling sweep; never signal it mid-execution (an
-                    # orphan holding the device wedges the NEXT init),
-                    # wait generously for a clean exit instead
-                    proc.wait(timeout=float(
-                        os.environ.get("BENCH_SWEEP_WAIT_S", "900")))
-                except subprocess.TimeoutExpired:
-                    _log_attempt("worker still in scaling sweep at "
-                                 "launcher exit — left to finish "
-                                 "unsignalled (may hold the device)")
+                _wait_for_sweep(proc, "worker (may hold the device)")
                 return 0 if result.get("invariant_violations", 1) == 0 \
                     else 1
             _abandon(proc)
@@ -284,12 +287,7 @@ def launcher() -> int:
         os.environ.get("BENCH_CPU_TIMEOUT_S", "1200")))
     if result is not None:
         print(json.dumps(result), flush=True)
-        try:
-            proc.wait(timeout=float(
-                os.environ.get("BENCH_SWEEP_WAIT_S", "900")))
-        except subprocess.TimeoutExpired:
-            _log_attempt("cpu worker still in scaling sweep at launcher "
-                         "exit — left to finish unsignalled")
+        _wait_for_sweep(proc, "cpu worker")
         return 0 if result.get("invariant_violations", 1) == 0 else 1
 
     # Last resort: a tiny inline CPU measurement in THIS process (no

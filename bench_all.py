@@ -40,7 +40,8 @@ FUZZ = FuzzConfig(p_drop=0.1, p_dup=0.05, max_delay=2, p_partition=0.1,
 
 
 def _cfgs():
-    """(label, protocol, SimConfig, fuzz, groups, steps, metric key)."""
+    """(label, protocol, SimConfig, fuzz, groups, steps, metric key,
+    unit) — 8 fields, unpacked in main()."""
     big = jax.default_backend() != "cpu"
     s = 16 if big else 1
     return [

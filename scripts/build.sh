@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Build + check, the bin/build.sh analog (the reference builds its three
+# Go binaries; here the package is pure Python plus an optional C++
+# linearizability-checker extension).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if command -v make >/dev/null && [ -d native ]; then
+    make -C native
+fi
+
+# logic checks run on CPU: skip the accelerator PJRT registration so a
+# wedged tunnel can't hang the build (see .claude/skills/verify)
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q "$@"

@@ -1,0 +1,69 @@
+#!/usr/bin/env bash
+# Launch a local cluster and drive the benchmark client against it —
+# the bin/run.sh analog.
+#
+#   scripts/run.sh [N_REPLICAS] [ALGORITHM] [N_OPS]
+#
+# Starts N separate server processes from one generated config (real
+# TCP transports on localhost), waits for them, runs the closed-loop
+# benchmark client with the linearizability check, then tears the
+# cluster down.  Exit code is the client's (nonzero on errors or
+# anomalies).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N="${1:-3}"
+ALGO="${2:-paxos}"
+OPS="${3:-200}"
+CFG="$(mktemp -t paxi_tpu_cfg_XXXX.json)"
+
+python - "$N" "$CFG" <<'EOF'
+import sys
+from paxi_tpu.core.config import Bconfig, local_config
+cfg = local_config(int(sys.argv[1]))
+cfg.benchmark = Bconfig(T=0, N=0, linearizability_check=True)
+cfg.to_json(sys.argv[2])
+EOF
+
+PIDS=()
+cleanup() {
+    for p in "${PIDS[@]:-}"; do kill "$p" 2>/dev/null || true; done
+    rm -f "$CFG"
+}
+trap cleanup EXIT
+
+for z_n in $(python - "$N" <<'EOF'
+import sys
+from paxi_tpu.core.config import local_config
+print("\n".join(str(i) for i in local_config(int(sys.argv[1])).addrs))
+EOF
+); do
+    python -m paxi_tpu server -id "$z_n" -algorithm "$ALGO" \
+        -config "$CFG" &
+    PIDS+=("$!")
+done
+
+# wait until every replica's HTTP port accepts connections (server
+# startup pays the Python/JAX import, several seconds on small boxes)
+for port in $(python - "$CFG" <<'EOF'
+import json, sys
+cfg = json.load(open(sys.argv[1]))
+print("\n".join(a.rsplit(":", 1)[1] for a in cfg["http_address"].values()))
+EOF
+); do
+    for _ in $(seq 1 120); do
+        if (exec 3<>"/dev/tcp/127.0.0.1/$port") 2>/dev/null; then
+            exec 3>&- 3<&-
+            break
+        fi
+        for p in "${PIDS[@]}"; do
+            if ! kill -0 "$p" 2>/dev/null; then
+                echo "run.sh: server pid $p died during startup" >&2
+                exit 1
+            fi
+        done
+        sleep 0.5
+    done
+done
+
+python -m paxi_tpu client -config "$CFG" -N "$OPS"
