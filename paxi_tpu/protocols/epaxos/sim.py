@@ -16,6 +16,19 @@ TPU re-design (not a translation):
   axis LAST; owner-driven mailbox planes ``(src, dst, G)`` scatter
   directly onto the (me, owner=src) axes — no gather in the hot
   handlers.  Quorum tallies are bit-packed int32 masks + popcount.
+- The per-owner instance window is a sliding **ring** over ABSOLUTE
+  instance ids (sim/ring.py): position i holds ``base[me, owner] + i``;
+  each (me, owner) window recycles executed prefixes (retaining the
+  last I//2 for retransmits/prepares), so the horizon is unbounded in
+  O(window) memory.  Deps carry absolute ids: below my window ->
+  satisfied (the ring only slides past locally-executed cells);
+  in-window -> a graph edge; above my window -> execution blocks until
+  my window catches up.  Out-of-window messages are ignored unacked
+  (the owner's window flow control throttles to the majority's
+  execution progress); a prepare request OUTSIDE my window gets no
+  reply (below base: answering "no record" for an instance I executed
+  could let a recoverer NOOP over a committed value; above: the
+  ballot promise could not be durably recorded).
 - Conflict attribute computation (exec.go's conflict map) is a masked
   max over the recorded window, vectorized over all inboxes at once.
 - Execution replaces Tarjan with **boolean transitive closure by
@@ -66,7 +79,8 @@ import jax.numpy as jnp
 
 from paxi_tpu.ops.closure import transitive_closure
 from paxi_tpu.ops.hashing import fib_key
-from paxi_tpu.sim.ring import diag2, dst_major, require_packable
+from paxi_tpu.sim.ring import (diag2, dst_major, require_packable,
+                               shift_deps, shift_window)
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 NO_CMD = -1
@@ -95,14 +109,19 @@ def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
         "racc": ("owner", "inst", "ballot", "cmdv", "seq") + dep_fields,
         "raccr": ("owner", "inst", "ballot"),
         "rcmt": ("owner", "inst", "cmdv", "seq") + dep_fields,
+        # GC gossip: each replica's contiguous executed frontier per
+        # owner column, broadcast every step — windows recycle only
+        # past the GLOBAL minimum (see the slide block)
+        "gc": tuple(f"f{p}" for p in range(R)),
     }
 
 
 def encode_cmd(owner, inst):
-    """The command id is a pure function of (owner, inst) — I <= 256 —
-    so recovery repliers can compute conflict attrs for instances they
-    never saw."""
-    return (owner << 8) | inst
+    """The command id is a pure function of (owner, absolute inst) — so
+    recovery repliers can compute conflict attrs for instances they
+    never saw.  24 bits of instance space: a 16M-instance horizon per
+    owner before ids wrap."""
+    return (owner << 24) | (inst & 0xFFFFFF)
 
 
 def cmd_key(cmd, n_keys):
@@ -127,11 +146,13 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
     R, I, K, G = cfg.n_replicas, cfg.n_slots, cfg.n_keys, n_groups
     del rng
     require_packable(R)
-    if I > 256:
-        raise ValueError("epaxos instance window > 256 breaks encode_cmd")
     i32 = jnp.int32
     return dict(
-        # instance window SoA, (me, owner, I, G); deps (me, owner, I, R, G)
+        # instance RING SoA, (me, owner, I, G): position i holds
+        # absolute instance base[me, owner] + i (sim/ring.py); the
+        # window slides past executed prefixes, so the horizon is
+        # unbounded.  deps (me, owner, I, R, G) hold ABSOLUTE ids.
+        base=jnp.zeros((R, R, G), i32),
         cmd=jnp.full((R, R, I, G), NO_CMD, i32),
         seq=jnp.zeros((R, R, I, G), i32),
         deps=jnp.full((R, R, I, R, G), -1, i32),
@@ -176,6 +197,13 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
         rddeps=jnp.full((R, R, G), -1, i32),
         aacks=jnp.zeros((R, G), i32),
         recovered=jnp.zeros((G,), i32),  # completed recoveries (metric)
+        # latest-known executed frontier per (peer, owner) from the GC
+        # gossip; the window slides only past min over peers
+        gfront=jnp.zeros((R, R, R, G), i32),
+        # cumulative per-replica counters (the window recycles, so
+        # metrics cannot be recomputed from resident cells)
+        ccount=jnp.zeros((R, G), i32),   # commit events seen at me
+        xcount=jnp.zeros((R, G), i32),   # execution events at me
         # per-key execution oracle: count + order-sensitive hash chain
         kcount=jnp.zeros((R, K, G), i32),
         khash=jnp.zeros((R, K, G), i32),
@@ -221,8 +249,12 @@ def step(state, inbox, ctx: StepCtx):
     rdcmd, rdseq, rddeps = state["rdcmd"], state["rdseq"], state["rddeps"]
     aacks = state["aacks"]
     recovered = state["recovered"]
+    gfront = state["gfront"]          # (me, peer, owner, G)
+    base = state["base"]              # (me, owner, G) window bases
+    ccount, xcount = state["ccount"], state["xcount"]
     kcount, khash = state["kcount"], state["khash"]
     G = cur.shape[-1]
+    status_in = status               # pre-step statuses (commit counting)
 
     T = dst_major                                    # (me, src, G)
 
@@ -241,27 +273,31 @@ def step(state, inbox, ctx: StepCtx):
         k_tab = cmd_key(cmd_t, K)                        # (me, owner, I, G)
         recorded_tab = (status_t >= ST_PRE) & (cmd_t != NO_CMD)
         k_new = cmd_key(new_cmd, K)                      # (me, X, G)
+        abs_i = base[:, None, :, None, :] \
+            + iidx[None, None, None, :, None]            # (me,1,owner,I,G)
         is_self = ((ridx[None, None, :, None, None]
                     == excl_owner[:, :, None, None, :])
-                   & (iidx[None, None, None, :, None]
-                      == excl_inst[:, :, None, None, :]))
+                   & (abs_i == excl_inst[:, :, None, None, :]))
         conflict = (recorded_tab[:, None] & ~is_self
                     & (k_tab[:, None] == k_new[:, :, None, None, :]))
-        # (me, X, owner, I, G)
+        # (me, X, owner, I, G); deps reported as ABSOLUTE instance ids
         cseq = jnp.max(jnp.where(conflict, seq_t[:, None], 0),
                        axis=(2, 3))
-        cdep = jnp.max(jnp.where(conflict, iidx[None, None, None, :, None],
-                                 -1), axis=3)            # (me, X, R, G)
+        cdep = jnp.max(jnp.where(conflict, abs_i, -1),
+                       axis=3)                           # (me, X, R, G)
         return cseq + 1, cdep
 
     # ---------------- PreAccept: record, merge conflict attrs, reply ----
     m = inbox["pa"]
     v = T(m["valid"])                                    # (me, src, G)
-    pa_inst = jnp.clip(T(m["inst"]), 0, I - 1)
+    pa_inst = T(m["inst"])                               # ABSOLUTE
     pa_seq = T(m["seq"])
     pa_deps = _deps_T(m, R)                              # (me, src, R, G)
-    # owner == src: the cell one-hot is directly (me, src, I, G)
-    oh_cell = iidx[None, None, :, None] == pa_inst[:, :, None, :]
+    # owner == src: the ring position maps against base[me, owner=src],
+    # whose axes line up with the (me, src) message planes directly
+    pa_rel = pa_inst - base
+    v = v & (pa_rel >= 0) & (pa_rel < I)   # out-of-window: ignore, no ack
+    oh_cell = iidx[None, None, :, None] == pa_rel[:, :, None, :]
     # the owner's implicit ballot is 0: once a recoverer's Prepare
     # touched the cell (bal > 0), its PreAccepts are stale — no record,
     # no reply (host handle_preaccept's ballot gate)
@@ -323,10 +359,12 @@ def step(state, inbox, ctx: StepCtx):
 
     m = inbox["acc"]
     v = T(m["valid"])
-    ac_inst = jnp.clip(T(m["inst"]), 0, I - 1)
+    ac_inst = T(m["inst"])                               # absolute
     ac_seq = T(m["seq"])
     ac_deps = _deps_T(m, R)
-    oh_cell = iidx[None, None, :, None] == ac_inst[:, :, None, :]
+    ac_rel = ac_inst - base
+    v = v & (ac_rel >= 0) & (ac_rel < I)
+    oh_cell = iidx[None, None, :, None] == ac_rel[:, :, None, :]
     cell_free = jnp.sum(jnp.where(oh_cell, bal, 0), axis=2) == 0
     v = v & cell_free
     ac_cmd = encode_cmd(ridx[None, :, None], ac_inst)
@@ -342,11 +380,13 @@ def step(state, inbox, ctx: StepCtx):
     # ---------------- Commit delivery (owner-driven) --------------------
     m = inbox["cmt"]
     v = T(m["valid"])
-    cm_inst = jnp.clip(T(m["inst"]), 0, I - 1)
+    cm_inst = T(m["inst"])                               # absolute
     cm_seq = T(m["seq"])
     cm_cmd = T(m["cmd"])
     cm_deps = _deps_T(m, R)
-    oh_cell = iidx[None, None, :, None] == cm_inst[:, :, None, :]
+    cm_rel = cm_inst - base
+    v = v & (cm_rel >= 0) & (cm_rel < I)
+    oh_cell = iidx[None, None, :, None] == cm_rel[:, :, None, :]
     wr = (v & (jnp.sum(jnp.where(oh_cell, status, 0), axis=2)
                < ST_COMMIT))[:, :, None, :] & oh_cell
     cmd = jnp.where(wr, cm_cmd[:, :, None, :], cmd)
@@ -358,10 +398,11 @@ def step(state, inbox, ctx: StepCtx):
     dec_seq = jnp.where(fast_commit, seq0, mseq)
     dec_deps = jnp.where(fast_commit[:, None, :], deps0, mdeps)
     do_commit = fast_commit | slow_commit
-    curc = jnp.clip(cur, 0, I - 1)
-    my_cmd = encode_cmd(ridx[:, None], curc)             # (R, G)
+    base_own = diag2(base)                               # (R, G)
+    rel_cur = jnp.clip(cur - base_own, 0, I - 1)
+    my_cmd = encode_cmd(ridx[:, None], cur)              # (R, G)
     oh_me = ((ridx[:, None, None, None] == ridx[None, :, None, None])
-             & (iidx[None, None, :, None] == curc[:, None, None, :]))
+             & (iidx[None, None, :, None] == rel_cur[:, None, None, :]))
     wrm = do_commit[:, None, None, :] & oh_me
     cmd = jnp.where(wrm, my_cmd[:, None, None, :], cmd)
     seq = jnp.where(wrm, dec_seq[:, None, None, :], seq)
@@ -370,7 +411,7 @@ def step(state, inbox, ctx: StepCtx):
     status = jnp.where(wrm, ST_COMMIT, status)
     out_cmt_new = {
         "valid": jnp.broadcast_to(do_commit[:, None, :], (R, R, G)),
-        "inst": jnp.broadcast_to(curc[:, None, :], (R, R, G)),
+        "inst": jnp.broadcast_to(cur[:, None, :], (R, R, G)),
         "seq": jnp.broadcast_to(dec_seq[:, None, :], (R, R, G)),
         "cmd": jnp.broadcast_to(my_cmd[:, None, :], (R, R, G)),
         **_deps_out(dec_deps, R, (R, R, G)),
@@ -386,7 +427,7 @@ def step(state, inbox, ctx: StepCtx):
     ac_acks = jnp.where(go_accept, self_bit, ac_acks)
     out_acc = {
         "valid": jnp.broadcast_to(go_accept[:, None, :], (R, R, G)),
-        "inst": jnp.broadcast_to(curc[:, None, :], (R, R, G)),
+        "inst": jnp.broadcast_to(cur[:, None, :], (R, R, G)),
         "seq": jnp.broadcast_to(mseq[:, None, :], (R, R, G)),
         **_deps_out(mdeps, R, (R, R, G)),
     }
@@ -395,8 +436,9 @@ def step(state, inbox, ctx: StepCtx):
     # it to commit, possibly as NOOP): move on — in ANY phase, including
     # idle, or the owner's pipeline deadlocks on the recovered cell
     my_status0 = diag2(status)
-    ext_commit = (cur < I) & ~do_commit & (jnp.sum(
-        jnp.where(iidx[None, :, None] == curc[:, None, :],
+    in_win_cur = cur - base_own < I
+    ext_commit = ~do_commit & in_win_cur & (jnp.sum(
+        jnp.where(iidx[None, :, None] == rel_cur[:, None, :],
                   my_status0, 0), axis=1) == ST_COMMIT)
     phase = jnp.where(do_commit | ext_commit, 0,
                       jnp.where(go_accept, 2, phase))
@@ -405,8 +447,10 @@ def step(state, inbox, ctx: StepCtx):
                       state["stuck"])
 
     # ---------------- propose the next command --------------------------
-    propose = (phase == 0) & (cur < I)
-    p_inst = jnp.clip(cur, 0, I - 1)
+    # window flow control: my next instance must be ring-resident
+    propose = (phase == 0) & (cur - base_own < I)
+    p_inst = cur                                         # absolute
+    p_rel = jnp.clip(cur - base_own, 0, I - 1)
     p_cmd = encode_cmd(ridx[:, None], p_inst)
     p_seq, p_deps = conflict_attrs(cmd, seq, status, p_cmd[:, None, :],
                                    jnp.broadcast_to(ridx[:, None, None],
@@ -414,11 +458,11 @@ def step(state, inbox, ctx: StepCtx):
                                    p_inst[:, None, :])
     p_seq, p_deps = p_seq[:, 0], p_deps[:, 0]            # (R,G),(R,R,G)
     oh_p = ((ridx[:, None, None, None] == ridx[None, :, None, None])
-            & (iidx[None, None, :, None] == p_inst[:, None, None, :]))
+            & (iidx[None, None, :, None] == p_rel[:, None, None, :]))
     # my own cell may have been recovery-touched (bal > 0): I still
     # record my proposal if the cell is empty, but acceptors will gate
     wrp = (propose & (jnp.sum(
-        jnp.where(iidx[None, :, None] == p_inst[:, None, :],
+        jnp.where(iidx[None, :, None] == p_rel[:, None, :],
                   status[ridx, ridx], 0), axis=1) < ST_PRE)
     )[:, None, None, :] & oh_p
     cmd = jnp.where(wrp, p_cmd[:, None, None, :], cmd)
@@ -447,10 +491,15 @@ def step(state, inbox, ctx: StepCtx):
     out_acc["valid"] = jnp.broadcast_to(send_acc[:, None, :], (R, R, G))
     stuck = jnp.where(retry, 0, stuck + (phase > 0))
 
-    # late/periodic commit retransmit: round-robin over my committed
-    # instances so followers with dropped cmt messages eventually heal
-    rr = ctx.t % jnp.maximum(cur, 1)                     # (R, G)
-    oh_rr = iidx[None, :, None] == rr[:, None, :]
+    # late/periodic commit retransmit: round-robin over my in-window
+    # committed instances so followers with dropped cmt messages heal
+    # (laggards that fell behind the window stall — like the reference,
+    # which has no snapshot transfer for epaxos)
+    span = jnp.clip(cur - base_own, 1, I)                # (R, G)
+    rr_rel = jnp.clip(cur - base_own - 1, 0, I - 1) - (ctx.t % span)
+    rr_rel = jnp.clip(rr_rel, 0, I - 1)
+    rr = base_own + rr_rel                               # absolute
+    oh_rr = iidx[None, :, None] == rr_rel[:, None, :]
     mine = diag2
     my_status = mine(status)                             # (R, I, G)
     rr_cmd = jnp.sum(jnp.where(oh_rr, mine(cmd), 0), axis=1)
@@ -478,29 +527,44 @@ def step(state, inbox, ctx: StepCtx):
     m = inbox["prep"]
     v = T(m["valid"])                                    # (me, src, G)
     pr_own = jnp.clip(T(m["owner"]), 0, R - 1)
-    pr_inst = jnp.clip(T(m["inst"]), 0, I - 1)
+    pr_inst = T(m["inst"])                               # absolute
     pr_bal = T(m["ballot"])
-    # per-cell max prepare ballot this step (collision: max wins)
+    # ring position of the requested cell per possible owner column
+    pr_rel = pr_inst[:, :, None, :] - base[:, None, :, :]  # (me,src,own,G)
+    # per-cell max prepare ballot this step (collision: max wins);
+    # out-of-window positions simply match no one-hot
     oh5 = (v[:, :, None, None, :]
            & (ridx[None, None, :, None, None] == pr_own[:, :, None, None, :])
            & (iidx[None, None, None, :, None]
-              == pr_inst[:, :, None, None, :]))          # (me,src,own,I,G)
+              == pr_rel[:, :, :, None, :]))              # (me,src,own,I,G)
     cell_max = jnp.max(jnp.where(oh5, pr_bal[:, :, None, None, :], 0),
                        axis=1)                           # (me, own, I, G)
     bal = jnp.maximum(bal, cell_max)
     # reply per edge: src gets my recorded state for its requested cell
-    # iff its ballot won the cell (== new bal)
+    # iff its ballot won the cell (== new bal).  A request BELOW my
+    # window gets NO reply: I executed and recycled that instance, so
+    # answering "no record" could let the recoverer NOOP-commit over a
+    # value I know committed.  An above-window request also gets no
+    # reply: the promise could not be recorded in a resident cell.
     prepr_fields = []
     for s in range(R):
         o_s, i_s, b_s = pr_own[:, s], pr_inst[:, s], pr_bal[:, s]
+        base_sel = jnp.sum(jnp.where(ridx[None, :, None]
+                                     == o_s[:, None, :], base, 0), axis=1)
+        rel_s = i_s - base_sel                           # (me, G)
         ohc = ((ridx[None, :, None, None] == o_s[:, None, None, :])
-               & (iidx[None, None, :, None] == i_s[:, None, None, :]))
-        # ohc: (me, own, I, G); exactly one cell set
+               & (iidx[None, None, :, None] == rel_s[:, None, None, :]))
+        # ohc: (me, own, I, G); at most one cell set
 
         def cell(pl):
             return jnp.sum(jnp.where(ohc, pl, 0), axis=(1, 2))
 
-        okr = v[:, s] & (b_s >= cell(bal))
+        # in-window only: a below-window cell was executed+recycled
+        # here (replying "no record" could NOOP over a committed
+        # value), and an above-window reply cannot durably record the
+        # ballot promise (oh5 matched no cell), so counting it toward
+        # the prepare quorum would break the NOOP-commit safety rule
+        okr = v[:, s] & (b_s >= cell(bal)) & (rel_s >= 0) & (rel_s < I)
         st_s = cell(status)
         cm_s = cell(cmd)
         sq_s = cell(seq)
@@ -618,7 +682,7 @@ def step(state, inbox, ctx: StepCtx):
                        u_deps)
 
     r_cmdv = encode_cmd(jnp.clip(rowner, 0, R - 1),
-                        jnp.clip(rinst, 0, I - 1))
+                        jnp.maximum(rinst, 0))
     dec_commit = (rphase == 1) & any_com
     dec_accept = have_prep & ~any_com & (any_acc | has_ident | any_pre)
     f_seq_d = jnp.where(any_acc, a_seq_d,
@@ -648,15 +712,16 @@ def step(state, inbox, ctx: StepCtx):
     m = inbox["racc"]
     v = T(m["valid"])
     ra_own = jnp.clip(T(m["owner"]), 0, R - 1)
-    ra_inst = jnp.clip(T(m["inst"]), 0, I - 1)
+    ra_inst = T(m["inst"])                               # absolute
     ra_bal = T(m["ballot"])
     ra_cmdv = T(m["cmdv"])
     ra_seq = T(m["seq"])
     ra_deps = _deps_T(m, R)
+    ra_rel = ra_inst[:, :, None, :] - base[:, None, :, :]  # (me,src,own,G)
     oh5 = (v[:, :, None, None, :]
            & (ridx[None, None, :, None, None] == ra_own[:, :, None, None, :])
            & (iidx[None, None, None, :, None]
-              == ra_inst[:, :, None, None, :]))
+              == ra_rel[:, :, :, None, :]))
     bal_b = jnp.broadcast_to(ra_bal[:, :, None, None, :], oh5.shape)
     gate = oh5 & (bal_b >= bal[:, None]) & (status[:, None] < ST_COMMIT)
     # per-cell winner: max ballot among gating raccs this step
@@ -712,11 +777,14 @@ def step(state, inbox, ctx: StepCtx):
         "seq": jnp.broadcast_to(cm_seq2[:, None, :], (R, R, G)),
         **_deps_out(cm_deps2, R, (R, R, G)),
     }
-    # apply my own recovery commit locally
+    # apply my own recovery commit locally (ring position vs my base)
+    rc_base = jnp.sum(jnp.where(ridx[None, :, None]
+                                == jnp.clip(rowner, 0, R - 1)[:, None, :],
+                                base, 0), axis=1)        # (me, G)
     oh_rc = ((ridx[None, :, None, None]
               == jnp.clip(rowner, 0, R - 1)[:, None, None, :])
              & (iidx[None, None, :, None]
-                == jnp.clip(rinst, 0, I - 1)[:, None, None, :]))
+                == (rinst - rc_base)[:, None, None, :]))
     wr = do_rcmt2[:, None, None, :] & oh_rc & (status < ST_COMMIT)
     cmd = jnp.where(wr, cm_cmd2[:, None, None, :], cmd)
     seq = jnp.where(wr, cm_seq2[:, None, None, :], seq)
@@ -728,14 +796,15 @@ def step(state, inbox, ctx: StepCtx):
     m = inbox["rcmt"]
     v = T(m["valid"])
     rc_own = jnp.clip(T(m["owner"]), 0, R - 1)
-    rc_inst = jnp.clip(T(m["inst"]), 0, I - 1)
+    rc_inst = T(m["inst"])                               # absolute
     rc_cmdv = T(m["cmdv"])
     rc_seq = T(m["seq"])
     rc_deps = _deps_T(m, R)
+    rc_rel = rc_inst[:, :, None, :] - base[:, None, :, :]
     oh5 = (v[:, :, None, None, :]
            & (ridx[None, None, :, None, None] == rc_own[:, :, None, None, :])
            & (iidx[None, None, None, :, None]
-              == rc_inst[:, :, None, None, :]))
+              == rc_rel[:, :, :, None, :]))
     hit_any = jnp.any(oh5, axis=1)                       # (me, own, I, G)
     wf = jnp.zeros((R, R, I, G), jnp.int32)
     ws = jnp.zeros((R, R, I, G), jnp.int32)
@@ -758,32 +827,47 @@ def step(state, inbox, ctx: StepCtx):
     cmd_f = cmd.reshape(R, NN, G)
     exec_f = executed.reshape(R, NN, G)
     deps_f = deps.reshape(R, NN, R, G)
+    # deps hold ABSOLUTE ids: below my window -> executed here already
+    # (the ring only slides past executed cells), satisfied, no edge;
+    # in-window -> an edge; above my window -> the dependency is not
+    # yet resident, block the source until my window catches up
     A = jnp.zeros((R, NN, NN, G), bool)
+    fblock = jnp.zeros((R, NN, G), bool)
     for q in range(R):
-        tgt = deps_f[:, :, q, :]                         # (R, NN, G)
-        has = tgt >= 0
-        col = q * I + jnp.clip(tgt, 0, I - 1)
-        A = A | (has[:, :, None, :]
+        tgt = deps_f[:, :, q, :]                         # (R, NN, G) abs
+        rel_q = tgt - base[:, q, None, :]
+        inw_q = (tgt >= 0) & (rel_q >= 0) & (rel_q < I)
+        fblock = fblock | ((tgt >= 0) & (rel_q >= I))
+        col = q * I + jnp.clip(rel_q, 0, I - 1)
+        A = A | (inw_q[:, :, None, :]
                  & (jnp.arange(NN)[None, None, :, None]
                     == col[:, :, None, :]))
     A = A & committed[:, :, None, :]    # only committed sources constrain
     reach = jnp.moveaxis(
         transitive_closure(jnp.moveaxis(A, -1, 1)), 1, -1)
-    blocked = jnp.any(reach & ~committed[:, None, :, :], axis=2)
+    blocked = jnp.any(reach & ~committed[:, None, :, :], axis=2) \
+        | fblock
     ready = committed & ~blocked & ~exec_f
     scc = reach & jnp.swapaxes(reach, 1, 2)
     cross = reach & ~scc
     exec_ok = ready & ~jnp.any(cross & ~exec_f[:, None, :, :], axis=2)
-    BIG = jnp.int32(1 << 28)
-    order = seq_f * NN + jnp.arange(NN, dtype=jnp.int32)[None, :, None]
+    # above every encodable cmd id: owner <= 30 (require_packable),
+    # so cmd = (owner << 24) | inst24 <= (31 << 24) | 0xFFFFFF < 2^29
+    BIG = jnp.int32(1 << 29)
     new_exec = exec_f
     kidx = jnp.arange(K, dtype=jnp.int32)
     for _ in range(cfg.exec_window):
         cand = exec_ok & ~new_exec
         any_c = jnp.any(cand, axis=1)                    # (R, G)
-        best = jnp.min(jnp.where(cand, order, BIG), axis=1)
-        oh_pick = cand & (order == best[:, None, :])
-        c_e = jnp.sum(jnp.where(oh_pick, cmd_f, 0), axis=1)
+        # replica-independent total order: (seq, cmd id) lexicographic
+        # — ring positions differ across replicas, command ids do not.
+        # Two-stage min; ties only between NOOPs (cmd == NO_CMD), whose
+        # simultaneous execution is key-neutral.
+        mseq_e = jnp.min(jnp.where(cand, seq_f, BIG), axis=1)
+        cand2 = cand & (seq_f == mseq_e[:, None, :])
+        mcmd_e = jnp.min(jnp.where(cand2, cmd_f, BIG), axis=1)
+        oh_pick = cand2 & (cmd_f == mcmd_e[:, None, :])
+        c_e = mcmd_e
         k_e = cmd_key(c_e, K)
         upd = any_c & (c_e != NO_CMD)
         ohk = upd[:, None, :] & (kidx[None, :, None] == k_e[:, None, :])
@@ -807,10 +891,13 @@ def step(state, inbox, ctx: StepCtx):
     fire = (rphase == 0) & (worst > patience)
     pick = jnp.argmax(age_f, axis=1).astype(jnp.int32)   # (R, G)
     f_own = pick // I
-    f_inst = pick % I
+    f_pos = pick % I                                     # ring position
+    f_base = jnp.sum(jnp.where(ridx[None, :, None] == f_own[:, None, :],
+                               base, 0), axis=1)
+    f_inst = f_base + f_pos                              # absolute
     # ballot: above anything I've seen for the cell, tagged with my id
     oh_f = ((ridx[None, :, None, None] == f_own[:, None, None, :])
-            & (iidx[None, None, :, None] == f_inst[:, None, None, :]))
+            & (iidx[None, None, :, None] == f_pos[:, None, None, :]))
     cell_bal = jnp.max(jnp.where(oh_f, bal, 0), axis=(1, 2))
     new_rbal = (jnp.maximum(cell_bal, rballot) // cfg.ballot_stride + 1) \
         * cfg.ballot_stride + ridx[:, None]
@@ -871,56 +958,121 @@ def step(state, inbox, ctx: StepCtx):
         **_deps_out(rddeps, R, (R, R, G)),
     }
 
+    # ---------------- cumulative counters (pre-slide layouts align) -----
+    ccount = ccount + jnp.sum((status == ST_COMMIT)
+                              & (status_in < ST_COMMIT), axis=(1, 2))
+    xcount = xcount + jnp.sum(new_exec & ~exec_f, axis=1)
+
+    # ---------------- GC gossip + slide the instance rings --------------
+    # my contiguous executed frontier per owner column (absolute)
+    lead_exec = jnp.sum(jnp.cumprod(executed.astype(jnp.int32), axis=2),
+                        axis=2)                          # (me, owner, G)
+    my_front = base + lead_exec
+    m = inbox["gc"]
+    for s in range(R):
+        fr_s = jnp.stack([T(m[f"f{p}"])[:, s] for p in range(R)],
+                         axis=1)                         # (me, owner, G)
+        got = T(m["valid"])[:, s][:, None, :]
+        gfront = gfront.at[:, s].set(
+            jnp.where(got, jnp.maximum(gfront[:, s], fr_s),
+                      gfront[:, s]))
+    eye3 = (ridx[:, None, None, None] == ridx[None, :, None, None])
+    gfront = jnp.where(eye3, my_front[:, None], gfront)
+    out_gc = {
+        "valid": jnp.ones((R, R, G), bool),
+        **{f"f{p}": jnp.broadcast_to(my_front[:, None, p], (R, R, G))
+           for p in range(R)},
+    }
+    # recycle only past the GLOBAL minimum executed frontier: a cell a
+    # replica recycles must be executed EVERYWHERE, else a new command
+    # could commit blind to a recycled conflict that a laggard still
+    # holds uncommitted (divergent per-key execution order).  The
+    # min-over-peers watermark stalls if a replica dies permanently —
+    # exactly the reference's GC/stability semantics; survivors retain
+    # one window's worth of headroom.  RETAIN keeps recent cells
+    # answerable for prepares/retransmits.
+    RETAIN = max(I // 2, 1)
+    # gfront's diagonal is my_front, so gmin <= my_front and the
+    # advance can never pass my own executed prefix (lead_exec)
+    gmin = jnp.min(gfront, axis=1)                       # (me, owner, G)
+    adv = jnp.maximum(gmin - RETAIN - base, 0)
+    base = base + adv
+    cmd = shift_window(cmd, adv, NO_CMD)
+    seq = shift_window(seq, adv, 0)
+    status = shift_window(status, adv, ST_NONE)
+    executed = shift_window(executed, adv, False)
+    bal = shift_window(bal, adv, 0)
+    abal = shift_window(abal, adv, 0)
+    age = shift_window(age, adv, 0)
+    deps = shift_deps(deps, adv)
+
     new_state = dict(
-        cmd=cmd, seq=seq, deps=deps, status=status, executed=executed,
-        bal=bal, abal=abal, age=age, cur=cur, phase=phase,
-        pa_acks=pa_acks, ac_acks=ac_acks, agree=agree, seq0=seq0,
-        deps0=deps0, mseq=mseq, mdeps=mdeps, stuck=stuck,
+        base=base, cmd=cmd, seq=seq, deps=deps, status=status,
+        executed=executed, bal=bal, abal=abal, age=age, cur=cur,
+        phase=phase, pa_acks=pa_acks, ac_acks=ac_acks, agree=agree,
+        seq0=seq0, deps0=deps0, mseq=mseq, mdeps=mdeps, stuck=stuck,
         rphase=rphase, rowner=rowner, rinst=rinst, rballot=rballot,
         rstuck=rstuck, racks=racks, rstat=rstat, rcmd=rcmd, rseq2=rseq2,
         rabal=rabal, rdeps2=rdeps2, rcseq=rcseq, rcdeps=rcdeps,
         rdcmd=rdcmd, rdseq=rdseq, rddeps=rddeps, aacks=aacks,
-        recovered=recovered, kcount=kcount, khash=khash,
+        recovered=recovered, gfront=gfront, ccount=ccount,
+        xcount=xcount, kcount=kcount, khash=khash,
     )
     outbox = {"pa": out_pa, "par": out_par, "acc": out_acc,
               "accr": out_accr, "cmt": out_cmt, "prep": out_prep,
               "prepr": out_prepr, "racc": out_racc, "raccr": out_raccr,
-              "rcmt": out_rcmt}
+              "rcmt": out_rcmt, "gc": out_gc}
     return new_state, outbox
 
 
 def metrics(state, cfg: SimConfig):
-    com = jnp.any(state["status"] == ST_COMMIT, axis=0)  # (R, I, G)
     return {
-        "committed_slots": jnp.sum(com),
-        "executed": jnp.sum(jnp.max(
-            jnp.sum(state["executed"], axis=(1, 2)), axis=0)),
+        # cumulative counters (the ring recycles, so the resident
+        # window no longer reflects history): most-advanced replica
+        "committed_slots": jnp.sum(jnp.max(state["ccount"], axis=0)),
+        "executed": jnp.sum(jnp.max(state["xcount"], axis=0)),
         "recovered": jnp.sum(state["recovered"]),
     }
 
 
 def invariants(old, new, cfg: SimConfig) -> jax.Array:
-    """1. Commit agreement: two replicas that both committed (p, j)
-    agree on (cmd, seq, deps).  2. Stability: commits never change
-    attrs or un-commit; executed is monotone.  3. Executed implies
+    """1. Commit agreement: two replicas that both committed an
+    absolute (p, j) agree on (cmd, seq, deps) — checked on the
+    base-aligned common window.  2. Stability: ring-resident commits
+    never change attrs or un-commit; the window only advances.
+    3. Executed is monotone under the slide; executed implies
     committed.  4. Execution-order agreement: replicas with equal
     per-key counts have equal per-key hash chains."""
-    c = new["status"] == ST_COMMIT                       # (me, R, I, G)
+    base = new["base"]                                   # (me, R, G)
+    align = jnp.max(base, axis=0)[None] - base
+
+    def al(pl, fill):
+        return shift_window(pl, align, fill)
+
+    c = al(new["status"] == ST_COMMIT, False)            # (me, R, I, G)
+    a_cmd = al(new["cmd"], NO_CMD)
+    a_seq = al(new["seq"], 0)
+    a_deps = shift_deps(new["deps"], align)
     pair = c[:, None] & c[None, :]
-    same = ((new["cmd"][:, None] == new["cmd"][None, :])
-            & (new["seq"][:, None] == new["seq"][None, :])
-            & jnp.all(new["deps"][:, None] == new["deps"][None, :],
-                      axis=4))
+    same = ((a_cmd[:, None] == a_cmd[None, :])
+            & (a_seq[:, None] == a_seq[None, :])
+            & jnp.all(a_deps[:, None] == a_deps[None, :], axis=4))
     v_agree = jnp.sum(pair & ~same) // 2
 
-    was = old["status"] == ST_COMMIT
-    v_stable = jnp.sum(was & ((new["status"] != ST_COMMIT)
-                              | (new["cmd"] != old["cmd"])
-                              | (new["seq"] != old["seq"])
-                              | jnp.any(new["deps"] != old["deps"],
-                                        axis=3)))
-    v_exec_mono = jnp.sum(old["executed"] & ~new["executed"])
-    v_exec_com = jnp.sum(new["executed"] & ~c)
+    adv = base - old["base"]
+    o_c = shift_window(old["status"] == ST_COMMIT, adv, False)
+    o_cmd = shift_window(old["cmd"], adv, NO_CMD)
+    o_seq = shift_window(old["seq"], adv, 0)
+    o_deps = shift_deps(old["deps"], adv)
+    n_c = new["status"] == ST_COMMIT
+    v_stable = jnp.sum(o_c & (~n_c | (new["cmd"] != o_cmd)
+                              | (new["seq"] != o_seq)
+                              | jnp.any(new["deps"] != o_deps, axis=3)))
+    v_stable = v_stable + jnp.sum(adv < 0)
+
+    o_x = shift_window(old["executed"], adv, False)
+    v_exec_mono = jnp.sum(o_x & ~new["executed"])
+    v_exec_com = jnp.sum(new["executed"] & ~n_c)
 
     eqc = new["kcount"][:, None] == new["kcount"][None, :]
     eqh = new["khash"][:, None] == new["khash"][None, :]

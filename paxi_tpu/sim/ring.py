@@ -39,6 +39,15 @@ def diag2(x):
     return jnp.stack([x[p, p] for p in range(x.shape[0])], axis=0)
 
 
+def shift_deps(pl, adv, fill=-1):
+    """shift_window for a deps-style plane ``(..., S, R, G)`` whose slot
+    axis sits third-from-last: transpose the (S, R) pair around the
+    shift and back."""
+    return jnp.swapaxes(
+        shift_window(jnp.swapaxes(pl, -3, -2), adv[..., None, :], fill),
+        -3, -2)
+
+
 def shift_window(arr, adv, fill):
     """Slide ``arr (..., S, G)`` forward along the slot axis by
     ``adv (..., G)`` >= 0: out[..., i, g] = arr[..., i + adv[..., g], g]

@@ -51,9 +51,11 @@ cfg = json.load(open(sys.argv[1]))
 print("\n".join(a.rsplit(":", 1)[1] for a in cfg["http_address"].values()))
 EOF
 ); do
+    up=0
     for _ in $(seq 1 120); do
         if (exec 3<>"/dev/tcp/127.0.0.1/$port") 2>/dev/null; then
             exec 3>&- 3<&-
+            up=1
             break
         fi
         for p in "${PIDS[@]}"; do
@@ -64,6 +66,10 @@ EOF
         done
         sleep 0.5
     done
+    if [ "$up" != 1 ]; then
+        echo "run.sh: port $port never became ready" >&2
+        exit 1
+    fi
 done
 
 python -m paxi_tpu client -config "$CFG" -N "$OPS"
