@@ -5,10 +5,12 @@ no consensus: writes stamp Lamport (counter, node) versions, replicate
 best-effort, and merge last-writer-wins; anti-entropy gossip heals
 divergence.  See host.py for the deployment form.
 
-TPU re-design:
-- The whole store is two version planes ``ver_c/ver_n[R, K]`` — the
-  value is a deterministic function of the version, so payloads never
-  need to be carried or stored; LWW merge is a lexicographic max.
+TPU re-design (lane-major layout — see sim/lanes.py):
+- The kernel operates on the whole group batch with the group axis LAST
+  (version planes ``ver_c/ver_n[R, K, G]``, mailbox planes
+  ``(src, dst, G)``) so the group axis feeds the 8x128 vector lanes.
+- The value is a deterministic function of the version, so payloads
+  never need to be carried or stored; LWW merge is a lexicographic max.
 - Each step, each replica writes one hashed key while ``t <
   write_rounds`` (= cfg.n_slots — the write window), then switches to
   pure anti-entropy: broadcasting a rotating key's version.  After
@@ -30,6 +32,7 @@ import jax.numpy as jnp
 import jax.random as jr
 
 from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim.ring import dst_major
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 
@@ -37,14 +40,14 @@ def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
     return {"gossip": ("key", "c", "n")}
 
 
-def init_state(cfg: SimConfig, rng: jax.Array):
-    R, K = cfg.n_replicas, cfg.n_keys
+def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
+    R, K, G = cfg.n_replicas, cfg.n_keys, n_groups
     del rng
     return dict(
-        ver_c=jnp.zeros((R, K), jnp.int32),
-        ver_n=jnp.full((R, K), -1, jnp.int32),
-        clock=jnp.zeros((R,), jnp.int32),
-        writes=jnp.zeros((), jnp.int32),
+        ver_c=jnp.zeros((R, K, G), jnp.int32),
+        ver_n=jnp.full((R, K, G), -1, jnp.int32),
+        clock=jnp.zeros((R, G), jnp.int32),
+        writes=jnp.zeros((G,), jnp.int32),
     )
 
 
@@ -54,22 +57,27 @@ def step(state, inbox, ctx: StepCtx):
     ridx = jnp.arange(R, dtype=jnp.int32)
     kidx = jnp.arange(K, dtype=jnp.int32)
 
-    ver_c = state["ver_c"]
+    ver_c = state["ver_c"]                              # (R, K, G)
     ver_n = state["ver_n"]
-    clock = state["clock"]
+    clock = state["clock"]                              # (R, G)
+    G = clock.shape[-1]
 
     # ---------------- merge incoming gossip (LWW by (c, n)) -------------
     m = inbox["gossip"]
-    v = jnp.transpose(m["valid"])                       # (me, src)
-    g_key = jnp.transpose(m["key"])
-    g_c = jnp.transpose(m["c"])
-    g_n = jnp.transpose(m["n"])
-    oh = v[:, :, None] & (g_key[:, :, None] == kidx[None, None, :])
-    in_c = jnp.max(jnp.where(oh, g_c[:, :, None], -1), axis=1)   # (me, K)
-    pick = jnp.argmax(jnp.where(oh, g_c[:, :, None] * R
-                                + jnp.maximum(g_n[:, :, None], 0), -1),
-                      axis=1)
-    in_n = jnp.take_along_axis(g_n, pick, axis=1)
+    v = dst_major(m["valid"])                           # (me, src, G)
+    g_key = dst_major(m["key"])
+    g_c = dst_major(m["c"])
+    g_n = dst_major(m["n"])
+    oh = v[:, :, None, :] & (g_key[:, :, None, :]
+                             == kidx[None, None, :, None])  # (me,src,K,G)
+    in_c = jnp.max(jnp.where(oh, g_c[:, :, None, :], -1), axis=1)
+    pick = jnp.argmax(jnp.where(oh, g_c[:, :, None, :] * R
+                                + jnp.maximum(g_n[:, :, None, :], 0), -1),
+                      axis=1)                           # (me, K, G)
+    in_n = jnp.squeeze(
+        jnp.take_along_axis(
+            jnp.broadcast_to(g_n[:, :, None, :], (R, R, K, G)),
+            pick[:, None], axis=1), axis=1)             # (me, K, G)
     has = jnp.any(oh, axis=1)
     newer = has & ((in_c > ver_c)
                    | ((in_c == ver_c) & (in_n > ver_n)))
@@ -80,25 +88,29 @@ def step(state, inbox, ctx: StepCtx):
     # ---------------- local write while inside the write window ---------
     writing = ctx.t < cfg.n_slots
     k_w = jr.fold_in(ctx.rng, 3)
-    wkey = fib_key(jr.randint(k_w, (R,), 0, 1 << 16) + ridx * 977, K)
+    wkey = fib_key(jr.randint(k_w, (R, G), 0, 1 << 16)
+                   + ridx[:, None] * 977, K)            # (R, G)
     clock = clock + jnp.where(writing, 1, 0)
-    oh_w = (kidx[None, :] == wkey[:, None]) & writing
-    bump = oh_w & ((clock[:, None] > ver_c)
-                   | ((clock[:, None] == ver_c) & (ridx[:, None] > ver_n)))
-    ver_c = jnp.where(bump, clock[:, None], ver_c)
-    ver_n = jnp.where(bump, ridx[:, None], ver_n)
-    writes = state["writes"] + jnp.sum(writing & jnp.ones((R,), bool))
+    oh_w = (kidx[None, :, None] == wkey[:, None, :]) & writing  # (R, K, G)
+    bump = oh_w & ((clock[:, None, :] > ver_c)
+                   | ((clock[:, None, :] == ver_c)
+                      & (ridx[:, None, None] > ver_n)))
+    ver_c = jnp.where(bump, clock[:, None, :], ver_c)
+    ver_n = jnp.where(bump, ridx[:, None, None], ver_n)
+    writes = state["writes"] + jnp.where(writing, R, 0).astype(jnp.int32)
 
     # ---------------- gossip out: written key, else rotate anti-entropy -
-    akey = (ctx.t + ridx) % K
-    gkey = jnp.where(writing, wkey, akey).astype(jnp.int32)
-    out_c = ver_c[ridx, gkey]
-    out_n = ver_n[ridx, gkey]
+    akey = (ctx.t + ridx[:, None]) % K                  # (R, G)
+    gkey = jnp.where(writing, wkey, jnp.broadcast_to(akey, (R, G))) \
+        .astype(jnp.int32)
+    goh = kidx[None, :, None] == gkey[:, None, :]       # (R, K, G)
+    out_c = jnp.sum(jnp.where(goh, ver_c, 0), axis=1)   # (R, G)
+    out_n = jnp.sum(jnp.where(goh, ver_n, 0), axis=1)
     out = {
-        "valid": jnp.ones((R, R), bool),
-        "key": jnp.broadcast_to(gkey[:, None], (R, R)),
-        "c": jnp.broadcast_to(out_c[:, None], (R, R)),
-        "n": jnp.broadcast_to(out_n[:, None], (R, R)),
+        "valid": jnp.ones((R, R, G), bool),
+        "key": jnp.broadcast_to(gkey[:, None, :], (R, R, G)),
+        "c": jnp.broadcast_to(out_c[:, None, :], (R, R, G)),
+        "n": jnp.broadcast_to(out_n[:, None, :], (R, R, G)),
     }
 
     new_state = dict(ver_c=ver_c, ver_n=ver_n, clock=clock, writes=writes)
@@ -107,12 +119,13 @@ def step(state, inbox, ctx: StepCtx):
 
 def metrics(state, cfg: SimConfig):
     c, n = state["ver_c"], state["ver_n"]
-    same = (jnp.all(c == c[:1], axis=0) & jnp.all(n == n[:1], axis=0))
+    same = (jnp.all(c == c[:1], axis=0)
+            & jnp.all(n == n[:1], axis=0))              # (K, G)
     return {
         "converged_keys": jnp.sum(same),
-        "total_keys": jnp.int32(cfg.n_keys),
-        "writes": state["writes"],
-        "committed_slots": state["writes"],   # comparable progress metric
+        "total_keys": jnp.int32(cfg.n_keys) * same.shape[-1],
+        "writes": jnp.sum(state["writes"]),
+        "committed_slots": jnp.sum(state["writes"]),
     }
 
 
@@ -138,4 +151,5 @@ PROTOCOL = SimProtocol(
     step=step,
     metrics=metrics,
     invariants=invariants,
+    batched=True,
 )

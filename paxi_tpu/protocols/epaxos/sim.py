@@ -845,8 +845,11 @@ def step(state, inbox, ctx: StepCtx):
     A = A & committed[:, :, None, :]    # only committed sources constrain
     reach = jnp.moveaxis(
         transitive_closure(jnp.moveaxis(A, -1, 1)), 1, -1)
-    blocked = jnp.any(reach & ~committed[:, None, :, :], axis=2) \
-        | fblock
+    # an above-window dep blocks not just its direct source but every
+    # instance that can reach it (an SCC mate of a blocked instance must
+    # not execute ahead of the mate's unresident dependency)
+    blocked = jnp.any(reach & (~committed | fblock)[:, None, :, :],
+                      axis=2) | fblock
     ready = committed & ~blocked & ~exec_f
     scc = reach & jnp.swapaxes(reach, 1, 2)
     cross = reach & ~scc
