@@ -245,8 +245,11 @@ def launcher() -> int:
 
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
     if not force_cpu:
+        groups = int(env.get("BENCH_GROUPS", "100000"))
+        min_groups = int(os.environ.get("BENCH_MIN_GROUPS", "8192"))
         for attempt in range(1, attempts + 1):
             t_start = time.monotonic()
+            env["BENCH_GROUPS"] = str(groups)
             proc = _spawn_worker(env)
             result, saw_ready = _drain(
                 proc, time.monotonic() + init_timeout,
@@ -266,13 +269,20 @@ def launcher() -> int:
             _abandon(proc)
             phase = "run" if saw_ready else "device init"
             waited = time.monotonic() - t_start
-            _log_attempt(f"attempt {attempt}: timed out during {phase} "
-                         f"after {waited:.0f}s (init_timeout="
-                         f"{init_timeout:.0f}s)")
-            print(f"bench: worker attempt {attempt}/{attempts} timed out "
-                  f"during {phase}", file=sys.stderr)
+            _log_attempt(f"attempt {attempt}: died/timed out during "
+                         f"{phase} after {waited:.0f}s (groups={groups}, "
+                         f"init_timeout={init_timeout:.0f}s)")
+            print(f"bench: worker attempt {attempt}/{attempts} failed "
+                  f"during {phase} (groups={groups})", file=sys.stderr)
             if saw_ready:
-                break          # init works; the run itself is the problem
+                # init works; the run faulted (observed r5: a device
+                # fault at the 100k shape) — retry smaller before giving
+                # up the accelerator: a labeled on-chip number at 25k
+                # groups beats a CPU fallback
+                if groups // 4 < min_groups:
+                    break
+                groups //= 4
+                continue
             if attempt < attempts:
                 time.sleep(backoff * attempt)
         print("bench: falling back to a fresh CPU worker", file=sys.stderr)

@@ -1,8 +1,8 @@
-"""Per-protocol benchmark sweep — BASELINE.md configs 1-5.
+"""Per-protocol benchmark sweep — BASELINE.md configs 1-5 + extras.
 
 Prints ONE JSON line PER config (paxos anchor, epaxos conflict-heavy,
-wpaxos 3x3 locality grid, abd, chain, fuzzed paxos) and writes the
-collected list to BENCH_PROTOCOLS.json next to this file.
+wpaxos 3x3 locality grid, abd, chain, fuzzed paxos, sdpaxos tokens) and
+writes the collected list to BENCH_PROTOCOLS.json next to this file.
 
 Runs on CPU by default (deterministic completion even when the
 accelerator tunnel is wedged — set BENCH_ALL_DEVICE=native to use the
@@ -71,6 +71,10 @@ def _cfgs():
         ("paxos_fuzzed", "paxos" if big else "paxos_pg",
          SimConfig(n_replicas=5, n_slots=64), FUZZ,
          256 * s, 150, "committed_slots", "slots/s"),
+        # 6. sdpaxos: decentralized command leaders + central sequencer
+        ("sdpaxos_tokens", "sdpaxos",
+         SimConfig(n_replicas=5, n_slots=32, n_keys=16), FAULT_FREE,
+         256 * s, 80, "committed_slots", "slots/s"),
     ]
 
 

@@ -22,6 +22,7 @@ _SIM_MODULES = {
     "epaxos": "paxi_tpu.protocols.epaxos.sim",
     "kpaxos": "paxi_tpu.protocols.kpaxos.sim",
     "dynamo": "paxi_tpu.protocols.dynamo.sim",
+    "sdpaxos": "paxi_tpu.protocols.sdpaxos.sim",
 }
 
 _HOST_MODULES = {
@@ -32,6 +33,7 @@ _HOST_MODULES = {
     "epaxos": "paxi_tpu.protocols.epaxos.host",
     "kpaxos": "paxi_tpu.protocols.kpaxos.host",
     "dynamo": "paxi_tpu.protocols.dynamo.host",
+    "sdpaxos": "paxi_tpu.protocols.sdpaxos.host",
 }
 
 

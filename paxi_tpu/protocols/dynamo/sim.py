@@ -74,10 +74,9 @@ def step(state, inbox, ctx: StepCtx):
     pick = jnp.argmax(jnp.where(oh, g_c[:, :, None, :] * R
                                 + jnp.maximum(g_n[:, :, None, :], 0), -1),
                       axis=1)                           # (me, K, G)
-    in_n = jnp.squeeze(
-        jnp.take_along_axis(
-            jnp.broadcast_to(g_n[:, :, None, :], (R, R, K, G)),
-            pick[:, None], axis=1), axis=1)             # (me, K, G)
+    in_n = jnp.zeros_like(in_c)                         # (me, K, G)
+    for s in range(R):      # masked select over the tiny src axis
+        in_n = jnp.where(pick == s, g_n[:, s, None, :], in_n)
     has = jnp.any(oh, axis=1)
     newer = has & ((in_c > ver_c)
                    | ((in_c == ver_c) & (in_n > ver_n)))

@@ -59,6 +59,7 @@ import jax.numpy as jnp
 import jax.random as jr
 
 from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim.ring import pick_src as _pick_src
 from paxi_tpu.sim.ring import require_packable
 from paxi_tpu.sim.ring import shift_row as _shift_row
 from paxi_tpu.sim.ring import shift_window as _shift
@@ -88,16 +89,6 @@ def encode_cmd(bal, slot):
 def cmd_key(cmd, n_keys):
     """Hash the command id onto the KV key space."""
     return fib_key(cmd, n_keys)
-
-
-def _pick_src(field, src_idx):
-    """out[d, g] = field[src_idx[d, g], d, g] — select each destination's
-    chosen sender's message, unrolled over the tiny src axis (masked
-    selects instead of an XLA gather)."""
-    acc = jnp.zeros_like(field[0])
-    for s in range(field.shape[0]):
-        acc = jnp.where(src_idx == s, field[s], acc)
-    return acc
 
 
 def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):

@@ -1,0 +1,637 @@
+"""SDPaxos (semi-decentralized Paxos) as a pure TPU kernel.
+
+Reference: the paxi lineage's sdpaxos/ package (SURVEY §2.2 "others" —
+the SoCC'18 protocol): command replication is **decentralized** — every
+replica is the command leader for the commands it receives and
+replicates them from where they arrive (a C-instance per command) —
+while ordering is **centralized** — one elected sequencer assigns
+global sequence slots (O-instances).  A command is executable once BOTH
+its C-instance is durable on a majority and its O-instance is
+committed; execution follows O-log order.  The sequencer is recovered
+with ordinary Paxos ballots, so a sequencer crash costs one election,
+not availability.
+
+TPU re-design (lane-major layout — see sim/lanes.py; not a translation):
+- **O-log = the Multi-Paxos ring machinery** (protocols/paxos/sim.py):
+  ballot election with jittered timers, P1 merge by reference, P2
+  acceptance under bit-packed ack masks, P3 commit + frontier, snapshot
+  catch-up, and a sliding window over absolute slots.
+- **O-entries are owner tokens, bound positionally.**  The reference
+  names (owner, index) pairs in O-instances; here an O-entry carries
+  only the owner id, and the t-th committed token of owner ``o`` maps
+  to o's t-th command.  The binding is a pure function of the agreed
+  O-log, so ordering is **idempotent across sequencer failovers**: a
+  token lost below the new sequencer's P1 quorum is simply re-counted
+  into the backlog and re-proposed, and a token double-adopted by a log
+  merge just orders the owner's next command early — no per-index
+  recovery state, no duplicate/gap hazard for the count-based pointer
+  rebuild.  (An index-named design needs a per-instance recovery map;
+  on TPU that is a gather-heavy set where a cumulative count is free.)
+- **C-replication is frontier-shaped, not ring-shaped.**  Owners
+  propose their own commands strictly in order and command bodies are
+  deterministic functions of (owner, cidx) (as everywhere in this
+  suite: paxos's encode_cmd, chain's encode_val), so a replica's copy
+  of owner ``o``'s command log is fully described by a cumulative
+  count ``c_stored[me, o]``.  C-accepts carry go-back-N cumulative
+  indices per destination and heal drops in ~1 RTT; ``Quorum.ACK``
+  over C-instances becomes the MAJ-th order statistic of the cumulative
+  ack row (a sort over the tiny R axis replaces per-instance bitmasks).
+- The owner reports its *chosen* (majority-stored) frontier to everyone
+  (``oreq``, cumulative); every replica tracks ``o_seen[me, owner]`` so
+  any future sequencer can enqueue without a handoff.  The active
+  sequencer proposes one backlog token per step (deepest backlog
+  first) — the paxos kernel's closed-loop client replaced by the
+  ordering queue.
+- On winning the O-ballot, the new sequencer rebuilds its per-owner
+  token counts from the merged window plus its executed prefix
+  (``exec_c``); P1-quorum intersection guarantees every *committed*
+  token is visible to the merge, exactly the reference's recovery
+  argument.
+- Execution walks the committed O-prefix; a token of owner ``o``
+  applies command ``(o, exec_c[me, o])`` only when that body is locally
+  durable (``exec_c < c_stored``) — a missing body stalls execution
+  (liveness), never reorders it (safety).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim.ring import diag2, dst_major
+from paxi_tpu.sim.ring import pick_src as _pick_src
+from paxi_tpu.sim.ring import require_packable
+from paxi_tpu.sim.ring import shift_row as _shift_row
+from paxi_tpu.sim.ring import shift_window as _shift
+from paxi_tpu.sim.ring import take_replica as _take_replica
+from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+
+NO_CMD = -1    # empty O-log entry
+NOOP = -2      # hole filled by a recovering sequencer
+IDX_BITS = 20  # cidx field width in the executed command id
+
+
+def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
+    return {
+        # decentralized command replication (cumulative go-back-N)
+        "ca": ("cidx",),      # owner -> all: body of my command #cidx
+        "cack": ("n",),       # all -> owner: stored your [0, n)
+        "oreq": ("n",),       # owner -> all: my chosen frontier is n
+        # pull-side body recovery: an execution stalled on a body its
+        # (possibly dead) owner never delivered asks everyone; any
+        # holder relays.  Without this a perm-crashed owner whose
+        # chosen body missed the sequencer wedges ordering cluster-wide
+        "cneed": ("owner", "cidx"),   # staller -> all: I need (o, i)
+        "cr": ("owner", "cidx"),      # holder -> staller: relayed body
+        # centralized ordering: Multi-Paxos on owner tokens
+        "p1a": ("bal",),
+        "p1b": ("bal",),
+        "p2a": ("bal", "slot", "cmd"),
+        "p2b": ("bal", "slot"),
+        "p3": ("bal", "slot", "cmd", "upto"),
+    }
+
+
+def encode_cmd(owner, cidx):
+    """Executed command id for owner's cidx-th command (KV payload)."""
+    return (owner << IDX_BITS) | cidx
+
+
+def cmd_key(cmd, n_keys):
+    return fib_key(cmd, n_keys)
+
+
+def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
+    R, S, K, G = cfg.n_replicas, cfg.n_slots, cfg.n_keys, n_groups
+    del rng
+    require_packable(R)
+    i32 = jnp.int32
+    return dict(
+        # ---- C-plane (decentralized command replication) ----
+        c_next=jnp.zeros((R, G), i32),     # my proposed command count
+        c_stored=jnp.zeros((R, R, G), i32),  # [me, owner] stored count
+        c_ack=jnp.zeros((R, R, G), i32),   # [owner, dst] acked count
+        o_seen=jnp.zeros((R, R, G), i32),  # [me, owner] chosen frontier
+        o_enq=jnp.zeros((R, R, G), i32),   # [seqr, owner] tokens ordered
+        exec_c=jnp.zeros((R, R, G), i32),  # [me, owner] tokens executed
+        # ---- O-log (centralized ordering; paxos ring machinery) ----
+        ballot=jnp.zeros((R, G), i32),
+        active=jnp.zeros((R, G), bool),
+        p1_acks=jnp.zeros((R, G), i32),
+        base=jnp.zeros((R, G), i32),
+        log_bal=jnp.zeros((R, S, G), i32),
+        log_cmd=jnp.full((R, S, G), NO_CMD, i32),   # owner token / NOOP
+        log_commit=jnp.zeros((R, S, G), bool),
+        log_acks=jnp.zeros((R, S, G), i32),
+        proposed=jnp.zeros((R, S, G), bool),
+        next_slot=jnp.zeros((R, G), i32),
+        execute=jnp.zeros((R, G), i32),
+        kv=jnp.zeros((R, K, G), i32),
+        timer=jnp.broadcast_to(
+            (jnp.arange(R, dtype=i32) * cfg.election_timeout)[:, None],
+            (R, G)),
+        stuck=jnp.zeros((R, G), i32),
+    )
+
+
+def step(state, inbox, ctx: StepCtx):
+    cfg = ctx.cfg
+    R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
+    MAJ, STRIDE = cfg.majority, cfg.ballot_stride
+    RETAIN = max(S // 2, 1)
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    src_bit = (jnp.int32(1) << ridx)[:, None, None]   # also self-bit for
+    self_bit2 = (jnp.int32(1) << ridx)[:, None]       # (R, S, G) planes
+    own_diag = ridx[:, None, None] == ridx[None, :, None]   # (R, R, 1)
+
+    c_next = state["c_next"]
+    c_stored = state["c_stored"]
+    c_ack = state["c_ack"]
+    o_seen = state["o_seen"]
+    o_enq = state["o_enq"]
+    exec_c = state["exec_c"]
+    ballot = state["ballot"]
+    active = state["active"]
+    p1_acks = state["p1_acks"]
+    base = state["base"]
+    log_bal = state["log_bal"]
+    log_cmd = state["log_cmd"]
+    log_commit = state["log_commit"]
+    log_acks = state["log_acks"]
+    proposed = state["proposed"]
+    next_slot = state["next_slot"]
+    execute = state["execute"]
+    kv = state["kv"]
+    G = ballot.shape[-1]
+
+    T = dst_major                         # (src, dst, G) -> (me, src, G)
+
+    # ================= C-plane: decentralized replication ===============
+    # receive command bodies, in order (cumulative take)
+    m = inbox["ca"]
+    take = T(m["valid"]) & (T(m["cidx"]) == c_stored)    # (me, owner, G)
+    c_stored = c_stored + take
+
+    # receive relayed bodies (pull-side recovery; any src may relay any
+    # owner's next-needed body — dedup'd by the cumulative-take rule)
+    m = inbox["cr"]
+    rv, ro, rc = T(m["valid"]), T(m["owner"]), T(m["cidx"])  # (me, src, G)
+    rhit = (rv[:, :, None, :]
+            & (ro[:, :, None, :] == ridx[None, None, :, None])
+            & (rc[:, :, None, :] == c_stored[:, None, :, :]))
+    c_stored = c_stored + jnp.any(rhit, axis=1)          # (me, owner, G)
+
+    # serve body-need requests: respond if I hold the asked index
+    m = inbox["cneed"]
+    nv = T(m["valid"])                                   # (me, staller, G)
+    no = jnp.clip(T(m["owner"]), 0, R - 1)
+    nc = T(m["cidx"])
+    stored_at = jnp.zeros_like(nc)
+    for o in range(R):
+        stored_at = jnp.where(no == o, c_stored[:, o, :][:, None, :],
+                              stored_at)
+    # (me, staller, G) is already the (src, dst, G) outbox orientation
+    out_cr = {
+        "valid": nv & (nc >= 0) & (nc < stored_at),
+        "owner": no,
+        "cidx": nc,
+    }
+
+    # receive cumulative store-acks for my commands
+    m = inbox["cack"]
+    c_ack = jnp.maximum(
+        c_ack, jnp.where(T(m["valid"]), T(m["n"]), 0))   # (owner, dst, G)
+
+    # chosen = MAJ-th largest of my ack row (self-store included)
+    ack_row = jnp.where(own_diag, c_next[:, None, :], c_ack)
+    chosen = jnp.sort(ack_row, axis=1)[:, R - MAJ, :]    # (owner, G)
+
+    # learn everyone's chosen frontiers (cumulative, crash-survivable)
+    m = inbox["oreq"]
+    o_seen = jnp.maximum(
+        o_seen, jnp.where(T(m["valid"]), T(m["n"]), 0))  # (me, owner, G)
+    o_seen = jnp.maximum(o_seen, jnp.where(own_diag, chosen[:, None, :], 0))
+
+    # propose a new command of my own (closed-loop, bounded backlog)
+    my_exec = diag2(exec_c)                              # (R, G)
+    c_do = (c_next - my_exec) < S
+    c_next = c_next + c_do
+    c_stored = c_stored + (own_diag & c_do[:, None, :])  # self-store
+
+    # C-accept out: per-destination go-back-N (what I think dst needs);
+    # a duplicate is an ignored no-op at the receiver
+    out_ca = {
+        "valid": c_ack < c_next[:, None, :],             # (owner, dst, G)
+        "cidx": jnp.maximum(jnp.minimum(c_ack, c_next[:, None, :] - 1), 0),
+    }
+    # cumulative acks + chosen-frontier gossip, every step (cheap heal);
+    # c_stored[me, owner] is exactly the (src=me, dst=owner) plane
+    out_cack = {
+        "valid": jnp.ones((R, R, G), bool),
+        "n": c_stored,
+    }
+    out_oreq = {
+        "valid": jnp.ones((R, R, G), bool),
+        "n": jnp.broadcast_to(chosen[:, None, :], (R, R, G)),
+    }
+
+    # ================= O-log: Multi-Paxos over owner tokens =============
+    # ---------------- P1a: promise to the highest proposer --------------
+    m = inbox["p1a"]
+    b_in = jnp.where(m["valid"], m["bal"], 0)
+    p1a_bal = jnp.max(b_in, axis=0)
+    p1a_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)
+    promote = p1a_bal > ballot
+    ballot = jnp.maximum(ballot, p1a_bal)
+    active = active & ~promote
+    p1_acks = jnp.where(promote, 0, p1_acks)
+    p1b_valid = promote[:, None, :] & (ridx[None, :, None]
+                                       == p1a_src[:, None, :])
+    out_p1b = {"valid": p1b_valid,
+               "bal": jnp.broadcast_to(ballot[:, None, :], (R, R, G))}
+
+    own_bal = (ballot > 0) & (ballot % STRIDE == ridx[:, None])
+
+    # ---------------- P1b: collect phase-1 acks -------------------------
+    m = inbox["p1b"]
+    cond = m["valid"] & (m["bal"] == ballot[None, :, :]) \
+        & own_bal[None, :, :]
+    p1_acks = p1_acks | jnp.sum(jnp.where(cond, src_bit, 0), axis=0)
+    p1_win = own_bal & ~active \
+        & (jax.lax.population_count(p1_acks) >= MAJ)
+    amask = ((p1_acks[:, None, :] >> ridx[None, :, None]) & 1).astype(bool)
+
+    # ---------------- phase-1 win: state transfer from best acker -------
+    exec_am = jnp.where(amask, execute[None, :, :], -1)
+    f_src = jnp.argmax(exec_am, axis=1).astype(jnp.int32)
+    front = jnp.max(exec_am, axis=1)
+    el_ad = p1_win & (front > execute)
+    kv = jnp.where(el_ad[:, None, :], _take_replica(kv, f_src), kv)
+    exec_c = jnp.where(el_ad[:, None, :], _take_replica(exec_c, f_src),
+                       exec_c)
+    execute = jnp.where(el_ad, front, execute)
+    next_slot = jnp.where(el_ad, jnp.maximum(next_slot, front), next_slot)
+    f_base = _take_replica(base, f_src)
+    adv_el = jnp.where(el_ad, jnp.maximum(f_base - base, 0), 0)
+    base = jnp.where(el_ad, jnp.maximum(f_base, base), base)
+    log_bal = _shift(log_bal, adv_el, 0)
+    log_cmd = _shift(log_cmd, adv_el, NO_CMD)
+    log_commit = _shift(log_commit, adv_el, False)
+    proposed = _shift(proposed, adv_el, False)
+    log_acks = _shift(log_acks, adv_el, 0)
+
+    # ---------------- phase-1 win: merge ackers' O-logs -----------------
+    best_bal = jnp.full_like(log_bal, -1)
+    merged_cmd = jnp.full_like(log_cmd, NO_CMD)
+    merged_commit = jnp.zeros_like(log_commit)
+    committed_cmd = jnp.full_like(log_cmd, NO_CMD)
+    for s in range(R):
+        sel_s = amask[:, s, :]
+        adv_s = base - base[s][None, :]
+        lb_s = _shift_row(log_bal[s], adv_s, -1)
+        lc_s = _shift_row(log_cmd[s], adv_s, NO_CMD)
+        lm_s = _shift_row(log_commit[s], adv_s, False)
+        lb_s = jnp.where(sel_s[:, None, :], lb_s, -1)
+        lm_s = lm_s & sel_s[:, None, :]
+        upd = lb_s > best_bal
+        best_bal = jnp.where(upd, lb_s, best_bal)
+        merged_cmd = jnp.where(upd, lc_s, merged_cmd)
+        committed_cmd = jnp.where(lm_s & ~merged_commit, lc_s,
+                                  committed_cmd)
+        merged_commit = merged_commit | lm_s
+    abs_ = base[:, None, :] + sidx[None, :, None]
+    has_acc = (best_bal > 0) | merged_commit
+    top = jnp.max(jnp.where(has_acc, abs_ + 1, 0), axis=1)
+    new_next = jnp.maximum(next_slot, top)
+    in_win = abs_ < new_next[:, None, :]
+    w = p1_win[:, None, :]
+    adopt_cmd = jnp.where(merged_commit, committed_cmd,
+                          jnp.where(best_bal > 0, merged_cmd, NOOP))
+    log_cmd = jnp.where(w & in_win, adopt_cmd, log_cmd)
+    log_bal = jnp.where(w & in_win, ballot[:, None, :], log_bal)
+    log_commit = jnp.where(w & in_win, merged_commit | log_commit,
+                           log_commit)
+    proposed = jnp.where(w, in_win & (merged_commit | log_commit), proposed)
+    log_acks = jnp.where(w, jnp.where(in_win, src_bit, 0), log_acks)
+    next_slot = jnp.where(p1_win, new_next, next_slot)
+    active = active | p1_win
+
+    # ---------------- phase-1 win: rebuild per-owner token counts -------
+    # tokens ordered for owner o = tokens executed (exec_c) + o's tokens
+    # in my window at or above the execute frontier (everything not yet
+    # executed is in-window: the ring slides only past executed slots)
+    at_or_above = (abs_ >= execute[:, None, :]) \
+        & (abs_ < next_slot[:, None, :])
+    rebuilt = jnp.zeros_like(o_enq)
+    for o in range(R):
+        cnt = jnp.sum(at_or_above & (log_cmd == o), axis=1)     # (R, G)
+        rebuilt = jnp.where(ridx[None, :, None] == o,
+                            (exec_c[:, o, :] + cnt)[:, None, :], rebuilt)
+    o_enq = jnp.where(p1_win[:, None, :], rebuilt, o_enq)
+
+    # ---------------- P2a: accept from the highest-ballot leader --------
+    m = inbox["p2a"]
+    b_in = jnp.where(m["valid"], m["bal"], -1)
+    a_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)
+    a_bal = jnp.max(b_in, axis=0)
+    a_has = a_bal > 0
+    a_slot = _pick_src(m["slot"], a_src)
+    a_cmd = _pick_src(m["cmd"], a_src)
+    acc_ok = a_has & (a_bal >= ballot)
+    demote = acc_ok & (a_bal > ballot)
+    ballot = jnp.where(acc_ok, a_bal, ballot)
+    active = active & ~demote
+    p1_acks = jnp.where(demote, 0, p1_acks)
+    a_rel = a_slot - base
+    a_inw = (a_rel >= 0) & (a_rel < S)
+    oh = acc_ok[:, None, :] & (sidx[None, :, None] == a_rel[:, None, :])
+    writable = oh & (log_bal <= a_bal[:, None, :]) & ~log_commit
+    log_bal = jnp.where(writable, a_bal[:, None, :], log_bal)
+    log_cmd = jnp.where(writable, a_cmd[:, None, :], log_cmd)
+    out_p2b = {
+        "valid": (acc_ok & a_inw)[:, None, :]
+        & (ridx[None, :, None] == a_src[:, None, :]),
+        "bal": jnp.broadcast_to(a_bal[:, None, :], (R, R, G)),
+        "slot": jnp.broadcast_to(a_slot[:, None, :], (R, R, G)),
+    }
+
+    own_bal = (ballot > 0) & (ballot % STRIDE == ridx[:, None])
+
+    # ---------------- P2b: sequencer tallies acks, commits --------------
+    m = inbox["p2b"]
+    okb = m["valid"] & (m["bal"] == ballot[None, :, :]) \
+        & (active & own_bal)[None, :, :]
+    brel = m["slot"] - base[None, :, :]
+    for s in range(R):
+        oh_s = okb[s][:, None, :] \
+            & (sidx[None, :, None] == brel[s][:, None, :])
+        log_acks = log_acks | jnp.where(oh_s, jnp.int32(1) << s, 0)
+    acks_n = jax.lax.population_count(log_acks)
+    newly = ((active & own_bal)[:, None, :] & (acks_n >= MAJ)
+             & ~log_commit & (log_cmd != NO_CMD) & proposed)
+    log_commit = log_commit | newly
+
+    # ---------------- P3: commit notifications --------------------------
+    m = inbox["p3"]
+    b_in = jnp.where(m["valid"], m["bal"], -1)
+    c_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)
+    c_bal = jnp.max(b_in, axis=0)
+    c_has = c_bal > 0
+    c_slot = _pick_src(m["slot"], c_src)
+    c_cmd = _pick_src(m["cmd"], c_src)
+    c_upto = _pick_src(m["upto"], c_src)
+    abs_ = base[:, None, :] + sidx[None, :, None]
+    c_rel = c_slot - base
+    oh = c_has[:, None, :] & (sidx[None, :, None] == c_rel[:, None, :])
+    log_cmd = jnp.where(oh, c_cmd[:, None, :], log_cmd)
+    log_bal = jnp.where(oh, jnp.maximum(log_bal, c_bal[:, None, :]),
+                        log_bal)
+    log_commit = log_commit | oh
+    ohu = (c_has[:, None, :] & (abs_ < c_upto[:, None, :])
+           & (log_bal == c_bal[:, None, :]) & (log_cmd != NO_CMD))
+    log_commit = log_commit | ohu
+
+    # ---------------- P3: snapshot catch-up for deep laggards -----------
+    src_base = _take_replica(base, c_src)
+    adopt = c_has & (execute < src_base)
+    adv_a = jnp.where(adopt, src_base - base, 0)
+    my_bal = _shift(log_bal, adv_a, 0)
+    my_cmd = _shift(log_cmd, adv_a, NO_CMD)
+    my_com = _shift(log_commit, adv_a, False)
+    s_bal = _take_replica(log_bal, c_src)
+    s_cmd = _take_replica(log_cmd, c_src)
+    s_com = _take_replica(log_commit, c_src)
+    a2 = adopt[:, None, :]
+    log_bal = jnp.where(a2, jnp.where(s_com, s_bal, my_bal), log_bal)
+    log_cmd = jnp.where(a2, jnp.where(s_com, s_cmd, my_cmd), log_cmd)
+    log_commit = jnp.where(a2, s_com | my_com, log_commit)
+    proposed = jnp.where(a2, False, proposed)
+    log_acks = jnp.where(a2, 0, log_acks)
+    kv = jnp.where(adopt[:, None, :], _take_replica(kv, c_src), kv)
+    exec_c = jnp.where(adopt[:, None, :], _take_replica(exec_c, c_src),
+                       exec_c)
+    execute = jnp.where(adopt, _take_replica(execute, c_src), execute)
+    next_slot = jnp.where(adopt, jnp.maximum(next_slot, execute), next_slot)
+    base = jnp.where(adopt, src_base, base)
+    abs_ = base[:, None, :] + sidx[None, :, None]
+
+    # ---------------- sequencer proposes (backlog or re-proposal) -------
+    is_leader = active & own_bal
+    mask_re = (~log_commit) & (~proposed) & (abs_ < next_slot[:, None, :])
+    first_re = jnp.argmin(jnp.where(mask_re, sidx[None, :, None], S),
+                          axis=1)
+    has_re = jnp.any(mask_re, axis=1)
+    can_new = (next_slot - base) < S
+    rel_next = jnp.clip(next_slot - base, 0, S - 1)
+    prop_rel = jnp.where(has_re, first_re, rel_next).astype(jnp.int32)
+    prop_slot = base + prop_rel
+    # ordering queue: deepest-backlog owner's token (replaces the paxos
+    # kernel's self-generated client command)
+    backlog = jnp.maximum(o_seen - o_enq, 0)             # (seqr, owner, G)
+    pick_o = jnp.argmax(backlog, axis=1).astype(jnp.int32)   # (seqr, G)
+    has_bl = jnp.any(backlog > 0, axis=1)
+    is_new = ~has_re & can_new & has_bl
+    oh_p = sidx[None, :, None] == prop_rel[:, None, :]
+    re_cmd = jnp.sum(jnp.where(oh_p, log_cmd, 0), axis=1)
+    re_cmd = jnp.where(re_cmd == NO_CMD, NOOP, re_cmd)
+    prop_cmd = jnp.where(is_new, pick_o, re_cmd)
+    do = is_leader & (has_re | is_new)
+    oh = do[:, None, :] & oh_p
+    log_bal = jnp.where(oh, ballot[:, None, :], log_bal)
+    log_cmd = jnp.where(oh & ~log_commit, prop_cmd[:, None, :], log_cmd)
+    proposed = proposed | oh
+    log_acks = log_acks | jnp.where(oh, src_bit, 0)
+    next_slot = next_slot + (is_new & do)
+    enq_bump = (is_new & do)[:, None, :] \
+        & (ridx[None, :, None] == pick_o[:, None, :])
+    o_enq = o_enq + enq_bump
+    out_p2a = {
+        "valid": jnp.broadcast_to(do[:, None, :], (R, R, G)),
+        "bal": jnp.broadcast_to(ballot[:, None, :], (R, R, G)),
+        "slot": jnp.broadcast_to(prop_slot[:, None, :], (R, R, G)),
+        "cmd": jnp.broadcast_to(prop_cmd[:, None, :], (R, R, G)),
+    }
+
+    # ---------------- execute committed O-prefix (body-gated) -----------
+    advanced = jnp.zeros_like(execute)
+    running = jnp.ones_like(active)
+    need_own = jnp.full_like(execute, -1)
+    need_idx = jnp.zeros_like(execute)
+    kidx = jnp.arange(K, dtype=jnp.int32)
+    for e in range(cfg.exec_window):
+        rel = execute + e - base
+        oh_e = sidx[None, :, None] == rel[:, None, :]
+        com = jnp.any(oh_e & log_commit, axis=1)
+        cmd_e = jnp.sum(jnp.where(oh_e, log_cmd, 0), axis=1)
+        is_tok = cmd_e >= 0
+        own_e = jnp.clip(cmd_e, 0, R - 1)
+        stored_e = _pick_src(jnp.swapaxes(c_stored, 0, 1), own_e)
+        ec_e = _pick_src(jnp.swapaxes(exec_c, 0, 1), own_e)
+        body_ok = ec_e < stored_e
+        # first body-stall of this step: ask everyone for my next-NEEDED
+        # body — cumulative c_stored, NOT exec_c: adoption can jump
+        # exec_c ahead of the local store, and relays are only
+        # acceptable in cumulative order, draining the gap one body per
+        # round trip
+        blk = running & com & is_tok & ~body_ok
+        first_blk = blk & (need_own < 0)
+        need_own = jnp.where(first_blk, own_e, need_own)
+        need_idx = jnp.where(first_blk, stored_e, need_idx)
+        runnable = com & (~is_tok | body_ok)
+        running = running & runnable
+        wr = running & is_tok
+        full_e = encode_cmd(own_e, ec_e)   # (owner, position) -> command
+        bump = wr[:, None, :] & (ridx[None, :, None] == own_e[:, None, :])
+        exec_c = exec_c + bump
+        key_e = cmd_key(full_e, K)
+        ohk = wr[:, None, :] & (kidx[None, :, None] == key_e[:, None, :])
+        kv = jnp.where(ohk, full_e[:, None, :], kv)
+        advanced = advanced + running
+    new_execute = execute + advanced
+    out_cneed = {
+        "valid": jnp.broadcast_to((need_own >= 0)[:, None, :], (R, R, G)),
+        "owner": jnp.broadcast_to(need_own[:, None, :], (R, R, G)),
+        "cidx": jnp.broadcast_to(need_idx[:, None, :], (R, R, G)),
+    }
+
+    # ---------------- P3 out: newly committed + frontier retransmit -----
+    low_new = jnp.argmin(jnp.where(newly, sidx[None, :, None], S), axis=1)
+    any_new = jnp.any(newly, axis=1)
+    span = jnp.maximum(new_execute - base, 1)
+    rr = ctx.t % span
+    p3_rel = jnp.where(any_new, low_new, rr).astype(jnp.int32)
+    p3_rel = jnp.clip(p3_rel, 0, S - 1)
+    oh_3 = sidx[None, :, None] == p3_rel[:, None, :]
+    p3_committed = jnp.any(oh_3 & log_commit, axis=1)
+    p3_cmd = jnp.sum(jnp.where(oh_3, log_cmd, 0), axis=1)
+    p3_do = is_leader & p3_committed
+    out_p3 = {
+        "valid": jnp.broadcast_to(p3_do[:, None, :], (R, R, G)),
+        "bal": jnp.broadcast_to(ballot[:, None, :], (R, R, G)),
+        "slot": jnp.broadcast_to((base + p3_rel)[:, None, :], (R, R, G)),
+        "cmd": jnp.broadcast_to(p3_cmd[:, None, :], (R, R, G)),
+        "upto": jnp.broadcast_to(new_execute[:, None, :], (R, R, G)),
+    }
+
+    # ---------------- stuck-frontier retry (go-back-N) ------------------
+    # A dropped P2a/P2b leaves its slot unproposable forever (P2a is
+    # sent once); on a stall re-open EVERY uncommitted in-flight slot so
+    # the proposer re-proposes one per step instead of one per timeout —
+    # a deep uncommitted backlog under sustained drops drains in O(N)
+    # steps, not O(N * retry_timeout)
+    stalled = is_leader & (new_execute == execute) \
+        & (next_slot > new_execute)
+    stuck = jnp.where(stalled, state["stuck"] + 1, 0)
+    retry = stuck >= cfg.retry_timeout
+    ohr = (retry[:, None, :] & ~log_commit
+           & (abs_ >= new_execute[:, None, :])
+           & (abs_ < next_slot[:, None, :]))
+    proposed = proposed & ~ohr
+    stuck = jnp.where(retry, 0, stuck)
+
+    # ---------------- election timer ------------------------------------
+    heard = promote | acc_ok | (c_has & (c_bal >= ballot))
+    k_jit = jr.fold_in(ctx.rng, 17)
+    jitter = jr.randint(k_jit, ballot.shape, 0, cfg.backoff + 1)
+    timer = jnp.where(heard | active,
+                      cfg.election_timeout + jitter,
+                      state["timer"] - 1)
+    fire = ~active & (timer <= 0)
+    new_bal = (jnp.max(ballot, axis=0)[None, :] // STRIDE + 1) * STRIDE \
+        + ridx[:, None]
+    ballot = jnp.where(fire, new_bal, ballot)
+    p1_acks = jnp.where(fire, self_bit2, p1_acks)
+    timer = jnp.where(fire, cfg.election_timeout + jitter, timer)
+    out_p1a = {
+        "valid": jnp.broadcast_to(fire[:, None, :], (R, R, G)),
+        "bal": jnp.broadcast_to(ballot[:, None, :], (R, R, G)),
+    }
+
+    # ---------------- slide the O-ring window ---------------------------
+    new_base = jnp.maximum(base, new_execute - RETAIN)
+    adv = new_base - base
+    log_bal = _shift(log_bal, adv, 0)
+    log_cmd = _shift(log_cmd, adv, NO_CMD)
+    log_commit = _shift(log_commit, adv, False)
+    proposed = _shift(proposed, adv, False)
+    log_acks = _shift(log_acks, adv, 0)
+
+    new_state = dict(
+        c_next=c_next, c_stored=c_stored, c_ack=c_ack, o_seen=o_seen,
+        o_enq=o_enq, exec_c=exec_c,
+        ballot=ballot, active=active, p1_acks=p1_acks, base=new_base,
+        log_bal=log_bal, log_cmd=log_cmd, log_commit=log_commit,
+        log_acks=log_acks, proposed=proposed, next_slot=next_slot,
+        execute=new_execute, kv=kv, timer=timer, stuck=stuck,
+    )
+    outbox = {"ca": out_ca, "cack": out_cack, "oreq": out_oreq,
+              "cneed": out_cneed, "cr": out_cr,
+              "p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
+              "p2b": out_p2b, "p3": out_p3}
+    return new_state, outbox
+
+
+def metrics(state, cfg: SimConfig):
+    return {
+        "committed_slots": jnp.sum(jnp.max(state["execute"], axis=0)),
+        "min_execute": jnp.sum(jnp.min(state["execute"], axis=0)),
+        "commands_proposed": jnp.sum(state["c_next"]),
+        "has_sequencer": jnp.sum(jnp.any(state["active"], axis=0)
+                                 .astype(jnp.int32)),
+    }
+
+
+def invariants(old, new, cfg: SimConfig) -> jax.Array:
+    """The paxos O-log oracle (agreement / stability / ballot
+    monotonicity / executed-prefix-committed) — token->command binding
+    is a pure function of the agreed O-log, so O-log agreement IS
+    execution-order agreement — plus monotone C-plane frontiers.
+    (exec_c <= c_stored is NOT asserted: snapshot adoption legally
+    jumps exec_c ahead of the local store until go-back-N heals it;
+    live execution is body-gated regardless.)"""
+    BIG = jnp.int32(2**30)
+    S = cfg.n_slots
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    base, c, cmd = new["base"], new["log_commit"], new["log_cmd"]
+
+    align = jnp.max(base, axis=0)[None, :] - base
+    a_c = _shift(c, align, False)
+    a_cmd = _shift(cmd, align, NO_CMD)
+    mx = jnp.max(jnp.where(a_c, a_cmd, -BIG), axis=0)
+    mn = jnp.min(jnp.where(a_c, a_cmd, BIG), axis=0)
+    n_c = jnp.sum(a_c, axis=0)
+    v_agree = jnp.sum((n_c >= 1) & (mx != mn))
+
+    adv = base - old["base"]
+    o_c = _shift(old["log_commit"], adv, False)
+    o_cmd = _shift(old["log_cmd"], adv, NO_CMD)
+    v_stable = jnp.sum(o_c & (~c | (cmd != o_cmd)))
+    v_stable = v_stable + jnp.sum(new["execute"] < base)
+
+    v_bal = jnp.sum(new["ballot"] < old["ballot"])
+
+    abs_ = base[:, None, :] + sidx[None, :, None]
+    v_exec = jnp.sum((abs_ < new["execute"][:, None, :]) & ~c)
+
+    v_cmono = jnp.sum(new["c_stored"] < old["c_stored"])
+    v_cmono = v_cmono + jnp.sum(new["c_next"] < old["c_next"])
+    v_cmono = v_cmono + jnp.sum(new["exec_c"] < old["exec_c"])
+
+    return (v_agree + v_stable + v_bal + v_exec
+            + v_cmono).astype(jnp.int32)
+
+
+PROTOCOL = SimProtocol(
+    name="sdpaxos",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=step,
+    metrics=metrics,
+    invariants=invariants,
+    batched=True,
+)

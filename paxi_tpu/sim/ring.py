@@ -73,6 +73,17 @@ def shift_row(row, adv, fill):
     return jnp.where(valid, jnp.take_along_axis(src, idxc, axis=1), fill)
 
 
+def pick_src(field, src_idx):
+    """out[d, g] = field[src_idx[d, g], d, g] — select each
+    destination's chosen sender's message from a (src, dst, G) mailbox
+    plane, unrolled over the tiny src axis (masked selects instead of
+    an XLA gather)."""
+    acc = jnp.zeros_like(field[0])
+    for s in range(field.shape[0]):
+        acc = jnp.where(src_idx == s, field[s], acc)
+    return acc
+
+
 def take_replica(x, idx):
     """out[r, ..., g] = x[idx[r, g], ..., g] — adopt another replica's
     row of a (R, ..., G) state array, unrolled over the tiny R axis

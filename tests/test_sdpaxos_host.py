@@ -1,0 +1,143 @@
+"""Host-runtime integration tests for SDPaxos (decentralized command
+leaders + central sequencer)."""
+
+import asyncio
+
+import pytest
+
+from paxi_tpu.core.command import Command, Reply, Request
+from paxi_tpu.host.simulation import Cluster
+
+pytestmark = pytest.mark.host
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def do(replica, key, value=b"", cid="c1", cmd_id=1, timeout=5.0):
+    fut = asyncio.get_running_loop().create_future()
+    replica.handle_client_request(Request(
+        command=Command(key, value, cid, cmd_id), reply_to=fut))
+    rep: Reply = await asyncio.wait_for(fut, timeout)
+    assert rep.err is None, rep.err
+    return rep.value
+
+
+def test_any_replica_leads_its_commands():
+    """The SDPaxos point: a request commits from whichever replica it
+    arrives at (no forwarding to a command leader), while one sequencer
+    orders everything globally."""
+    async def main():
+        c = Cluster("sdpaxos", n=3, http=False)
+        await c.start()
+        try:
+            await do(c["1.1"], 1, b"a", cid="c1", cmd_id=1)
+            await do(c["1.2"], 2, b"b", cid="c2", cmd_id=1)
+            await do(c["1.3"], 3, b"c", cid="c3", cmd_id=1)
+            await asyncio.sleep(0.1)
+            for i in c.ids:
+                assert c[i].db.get(1) == b"a", i
+                assert c[i].db.get(2) == b"b", i
+                assert c[i].db.get(3) == b"c", i
+            # exactly one active sequencer
+            seqs = [i for i in c.ids if c[i].is_sequencer()]
+            assert len(seqs) == 1, seqs
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_reads_are_ordered_through_the_olog():
+    async def main():
+        c = Cluster("sdpaxos", n=3, http=False)
+        await c.start()
+        try:
+            await do(c["1.2"], 7, b"x", cid="c1", cmd_id=1)
+            assert await do(c["1.3"], 7, cid="c2", cmd_id=1) == b"x"
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_execution_order_identical_everywhere():
+    """Interleaved writers on one key: every replica must apply the
+    same O-log order (last committed value agrees everywhere)."""
+    async def main():
+        c = Cluster("sdpaxos", n=3, http=False)
+        await c.start()
+        try:
+            for n in range(6):
+                owner = c[c.ids[n % 3]]
+                await do(owner, 5, f"v{n}".encode(),
+                         cid=f"c{n % 3}", cmd_id=n // 3 + 1)
+            await asyncio.sleep(0.15)
+            vals = {i: c[i].db.get(5) for i in c.ids}
+            assert len(set(vals.values())) == 1, vals
+            execs = {i: c[i].execute for i in c.ids}
+            assert len(set(execs.values())) == 1, execs
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_sequencer_crash_failover():
+    """Killing the sequencer must elect a survivor that re-merges the
+    O-log; stalled ordering requests retry and commit."""
+    async def main():
+        c = Cluster("sdpaxos", n=3, http=False)
+        await c.start()
+        try:
+            await do(c["1.1"], 1, b"pre", cid="c1", cmd_id=1)
+            seq = next(i for i in c.ids if c[i].is_sequencer())
+            c[seq].socket.crash(10.0)
+            others = [i for i in c.ids if i != seq]
+            v = await do(c[others[0]], 2, b"post", cid="c2", cmd_id=1,
+                         timeout=8.0)
+            assert v == b""
+            await asyncio.sleep(0.1)
+            for i in others:
+                assert c[i].db.get(2) == b"post", i
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_dropped_caccept_heals_via_watchdog():
+    """Body loss to one peer stalls that peer's execution until the
+    owner's retry loop re-replicates it."""
+    async def main():
+        c = Cluster("sdpaxos", n=3, http=False)
+        await c.start()
+        try:
+            c["1.1"].socket.drop("1.3", 0.2)
+            await do(c["1.1"], 4, b"v", cid="c1", cmd_id=1)
+            await asyncio.sleep(0.5)     # past the drop window + retry
+            assert c["1.3"].db.get(4) == b"v"
+            assert c["1.3"].execute == c["1.1"].execute
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_olog_gc_bounded_by_watermark():
+    """The O-log compacts below the gossiped cluster-wide execute
+    watermark: after well over GC_MARGIN commands, every replica's
+    in-memory log is bounded by the live window, not the history."""
+    async def main():
+        c = Cluster("sdpaxos", n=3, http=False)
+        for i in c.ids:
+            c[i].GC_MARGIN = 16       # keep the test fast
+        await c.start()
+        try:
+            for n in range(60):
+                await do(c[c.ids[n % 3]], n % 8, b"v%d" % n,
+                         cid=f"c{n % 3}", cmd_id=n // 3 + 1)
+            await asyncio.sleep(0.3)  # frontier gossip + GC ticks
+            for i in c.ids:
+                assert c[i].gc_base > 0, (i, c[i].gc_base, c[i].execute)
+                assert len(c[i].olog) < 60, (i, len(c[i].olog))
+                assert min(c[i].olog) >= c[i].gc_base
+        finally:
+            await c.stop()
+    run(main())
