@@ -24,26 +24,12 @@ TPU re-design (not a translation):
   (paxos ballot.go packs n<<16|id the same way).
 - ``Quorum.ACK`` becomes a **bit-packed int32 ack mask** per (leader,
   slot) with ``lax.population_count`` for ``Majority()`` (quorum.go
-  [driver]) — p1_acks (R, G), log_acks (R, S, G); the bool planes the
-  group-major kernel kept ((G, R, S, R)) were the worst padding
-  offenders on TPU.
-- Replica-indexed gathers (pick the argmax-ballot sender's message,
-  adopt another replica's log) are unrolled over the tiny R axis as
-  masked selects — no XLA gather on the hot path; only the slot-axis
-  ring shift uses ``take_along_axis``.
-- Messages carry ABSOLUTE slot numbers; receivers mask them against
-  their own window (out-of-window = silently ignored, like a TCP
-  segment for a closed connection).
-- P1b log payloads are passed *by reference*: on winning phase-1 the
-  new leader merges the current logs of its ackers, base-aligned via a
-  per-(leader, acker) shifted select.  A laggard winner first adopts
-  the most advanced acker's (kv, execute, base) — the state-transfer/
-  log-compaction analog of the host runtime's P1b snapshot.
-- P3 carries (slot, cmd) plus a commit frontier ``upto``: a follower
-  commits any in-window slot < upto accepted at the leader's exact
-  ballot.  A follower whose frontier fell below the leader's window
-  base adopts the leader's (kv, execute, base) wholesale (snapshot
-  catch-up) and keeps any of its own still-in-window commits.
+  [driver]) — p1_acks (R, G), log_acks (R, S, G).
+- The ballot/ring consensus core (P1a/P1b promise+tally, by-reference
+  P1b merge with laggard state transfer, P2a/P2b, P3 commit + snapshot
+  catch-up, go-back-N stuck retry, jittered elections, window slide)
+  lives in **sim/ballot_ring.py**, shared with the sdpaxos kernel —
+  this module contributes the client-load model and execution.
 - Client load: the leader proposes one new command per step while the
   window has room (closed-loop stream with window flow control);
   commands encode (ballot, slot) so the agreement oracle can detect
@@ -56,18 +42,16 @@ from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
-import jax.random as jr
 
 from paxi_tpu.ops.hashing import fib_key
-from paxi_tpu.sim.ring import pick_src as _pick_src
+from paxi_tpu.sim import ballot_ring as br
+from paxi_tpu.sim.ballot_ring import NO_CMD, NOOP
 from paxi_tpu.sim.ring import require_packable
-from paxi_tpu.sim.ring import shift_row as _shift_row
 from paxi_tpu.sim.ring import shift_window as _shift
-from paxi_tpu.sim.ring import take_replica as _take_replica
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
-NO_CMD = -1    # empty log entry
-NOOP = -2      # hole filled by a recovering leader
+# the ballot-ring planes ballot_ring.py owns; this kernel adds kv
+BR_KEYS = br.KEYS
 
 
 def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
@@ -124,248 +108,47 @@ def step(state, inbox, ctx: StepCtx):
     R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
     MAJ, STRIDE = cfg.majority, cfg.ballot_stride
     RETAIN = max(S // 2, 1)
-    ridx = jnp.arange(R, dtype=jnp.int32)
     sidx = jnp.arange(S, dtype=jnp.int32)
-    src_bit = (jnp.int32(1) << ridx)[:, None, None]   # (src, 1, 1)
-    self_bit2 = (jnp.int32(1) << ridx)[:, None]       # (R, 1) for (R, G)
-    self_bit3 = (jnp.int32(1) << ridx)[:, None, None]  # (R, 1, 1) for (R,S,G)
+    kidx = jnp.arange(K, dtype=jnp.int32)
 
-    ballot = state["ballot"]          # (R, G)
-    active = state["active"]
-    p1_acks = state["p1_acks"]
-    base = state["base"]
-    log_bal = state["log_bal"]        # (R, S, G)
-    log_cmd = state["log_cmd"]
-    log_commit = state["log_commit"]
-    log_acks = state["log_acks"]
-    proposed = state["proposed"]
-    next_slot = state["next_slot"]
-    execute = state["execute"]
-    kv = state["kv"]                  # (R, K, G)
+    st = {k: state[k] for k in BR_KEYS}
+    kv = state["kv"]
 
-    # ---------------- P1a: promise to the highest proposer --------------
-    m = inbox["p1a"]                                     # planes (src,dst,G)
-    b_in = jnp.where(m["valid"], m["bal"], 0)
-    p1a_bal = jnp.max(b_in, axis=0)                      # (dst, G)
-    p1a_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)
-    promote = p1a_bal > ballot
-    ballot = jnp.maximum(ballot, p1a_bal)
-    active = active & ~promote
-    p1_acks = jnp.where(promote, 0, p1_acks)             # my old round died
-    # P1b out (log payload by reference; see module docstring)
-    p1b_valid = promote[:, None, :] & (ridx[None, :, None]
-                                       == p1a_src[:, None, :])
-    out_p1b = {"valid": p1b_valid,
-               "bal": jnp.broadcast_to(ballot[:, None, :], (R, R, ballot.shape[-1]))}
-
-    own_bal = (ballot > 0) & (ballot % STRIDE == ridx[:, None])
-
-    # ---------------- P1b: collect phase-1 acks (bitmask) ---------------
-    m = inbox["p1b"]
-    cond = m["valid"] & (m["bal"] == ballot[None, :, :]) \
-        & own_bal[None, :, :]                            # (src, ldr, G)
-    p1_acks = p1_acks | jnp.sum(jnp.where(cond, src_bit, 0), axis=0)
-    p1_win = own_bal & ~active \
-        & (jax.lax.population_count(p1_acks) >= MAJ)
-    # amask[ldr, s, g]: did s ack ldr's round (includes self)
-    amask = ((p1_acks[:, None, :] >> ridx[None, :, None]) & 1).astype(bool)
-
-    # ---------------- phase-1 win: state transfer from best acker -------
-    # A laggard winner's window may sit below its ackers' windows; adopt
-    # the most advanced acker's (kv, execute, base) first — by-reference
-    # equivalent of the host runtime's P1b (execute, snapshot) transfer.
-    exec_am = jnp.where(amask, execute[None, :, :], -1)  # (ldr, s, G)
-    f_src = jnp.argmax(exec_am, axis=1).astype(jnp.int32)  # (ldr, G)
-    front = jnp.max(exec_am, axis=1)
-    el_ad = p1_win & (front > execute)
-    kv = jnp.where(el_ad[:, None, :], _take_replica(kv, f_src), kv)
-    execute = jnp.where(el_ad, front, execute)
-    next_slot = jnp.where(el_ad, jnp.maximum(next_slot, front), next_slot)
-    # never adopt a LOWER base: a negative self-shift would drop my own
-    # top-of-window entries (possibly committed via P3).  The merge below
-    # tolerates ackers whose base is below mine (front-fill only).
-    f_base = _take_replica(base, f_src)
-    adv_el = jnp.where(el_ad, jnp.maximum(f_base - base, 0), 0)
-    base = jnp.where(el_ad, jnp.maximum(f_base, base), base)
-    log_bal = _shift(log_bal, adv_el, 0)
-    log_cmd = _shift(log_cmd, adv_el, NO_CMD)
-    log_commit = _shift(log_commit, adv_el, False)
-    proposed = _shift(proposed, adv_el, False)
-    log_acks = _shift(log_acks, adv_el, 0)
-
-    # ---------------- phase-1 win: merge ackers' logs (base-aligned) ----
-    # leader ring pos j <-> abs base[ldr]+j <-> acker ring pos j+off;
-    # unrolled over the R ackers, accumulating the highest-ballot value
-    # and any committed value per slot — O(R) passes over (R, S, G).
-    best_bal = jnp.full_like(log_bal, -1)
-    merged_cmd = jnp.full_like(log_cmd, NO_CMD)
-    merged_commit = jnp.zeros_like(log_commit)
-    committed_cmd = jnp.full_like(log_cmd, NO_CMD)
-    for s in range(R):
-        sel_s = amask[:, s, :]                           # (ldr, G)
-        adv_s = base - base[s][None, :]                  # (ldr, G)
-        lb_s = _shift_row(log_bal[s], adv_s, -1)         # (ldr, S, G)
-        lc_s = _shift_row(log_cmd[s], adv_s, NO_CMD)
-        lm_s = _shift_row(log_commit[s], adv_s, False)
-        lb_s = jnp.where(sel_s[:, None, :], lb_s, -1)
-        lm_s = lm_s & sel_s[:, None, :]
-        upd = lb_s > best_bal
-        best_bal = jnp.where(upd, lb_s, best_bal)
-        merged_cmd = jnp.where(upd, lc_s, merged_cmd)
-        committed_cmd = jnp.where(lm_s & ~merged_commit, lc_s,
-                                  committed_cmd)
-        merged_commit = merged_commit | lm_s
-    abs_ = base[:, None, :] + sidx[None, :, None]        # (R, S, G)
-    has_acc = (best_bal > 0) | merged_commit
-    top = jnp.max(jnp.where(has_acc, abs_ + 1, 0), axis=1)  # (ldr, G) abs
-    new_next = jnp.maximum(next_slot, top)
-    in_win = abs_ < new_next[:, None, :]                 # slots to own
-    w = p1_win[:, None, :]
-    # committed slots adopt the committed value; accepted adopt merged;
-    # holes below the frontier become NOOP re-proposals.
-    adopt_cmd = jnp.where(merged_commit, committed_cmd,
-                          jnp.where(best_bal > 0, merged_cmd, NOOP))
-    log_cmd = jnp.where(w & in_win, adopt_cmd, log_cmd)
-    log_bal = jnp.where(w & in_win, ballot[:, None, :], log_bal)
-    log_commit = jnp.where(w & in_win, merged_commit | log_commit,
-                           log_commit)
-    proposed = jnp.where(w, in_win & (merged_commit | log_commit), proposed)
-    log_acks = jnp.where(w, jnp.where(in_win, self_bit3, 0), log_acks)
-    next_slot = jnp.where(p1_win, new_next, next_slot)
-    active = active | p1_win
-
-    # ---------------- P2a: accept from the highest-ballot leader --------
-    m = inbox["p2a"]
-    b_in = jnp.where(m["valid"], m["bal"], -1)
-    a_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)   # (dst, G)
-    a_bal = jnp.max(b_in, axis=0)
-    a_has = a_bal > 0
-    a_slot = _pick_src(m["slot"], a_src)                 # absolute
-    a_cmd = _pick_src(m["cmd"], a_src)
-    acc_ok = a_has & (a_bal >= ballot)
-    demote = acc_ok & (a_bal > ballot)                   # someone else leads
-    ballot = jnp.where(acc_ok, a_bal, ballot)
-    active = active & ~demote
-    p1_acks = jnp.where(demote, 0, p1_acks)
-    a_rel = a_slot - base                                # ring position
-    a_inw = (a_rel >= 0) & (a_rel < S)
-    oh = acc_ok[:, None, :] & (sidx[None, :, None] == a_rel[:, None, :])
-    writable = oh & (log_bal <= a_bal[:, None, :]) & ~log_commit
-    log_bal = jnp.where(writable, a_bal[:, None, :], log_bal)
-    log_cmd = jnp.where(writable, a_cmd[:, None, :], log_cmd)
-    # ack ONLY what we durably stored: a slot outside our window was
-    # dropped, and acking it would let the leader commit an entry no
-    # majority actually holds (lost acceptance after a leader change)
-    G = ballot.shape[-1]
-    out_p2b = {
-        "valid": (acc_ok & a_inw)[:, None, :]
-        & (ridx[None, :, None] == a_src[:, None, :]),
-        "bal": jnp.broadcast_to(a_bal[:, None, :], (R, R, G)),
-        "slot": jnp.broadcast_to(a_slot[:, None, :], (R, R, G)),
-    }
-
-    own_bal = (ballot > 0) & (ballot % STRIDE == ridx[:, None])
-
-    # ---------------- P2b: leader tallies acks, commits -----------------
-    m = inbox["p2b"]
-    okb = m["valid"] & (m["bal"] == ballot[None, :, :]) \
-        & (active & own_bal)[None, :, :]                 # (src, ldr, G)
-    brel = m["slot"] - base[None, :, :]                  # (src, ldr, G) ring
-    for s in range(R):
-        oh_s = okb[s][:, None, :] \
-            & (sidx[None, :, None] == brel[s][:, None, :])  # (ldr, S, G)
-        log_acks = log_acks | jnp.where(oh_s, jnp.int32(1) << s, 0)
-    acks_n = jax.lax.population_count(log_acks)          # (ldr, S, G)
-    newly = ((active & own_bal)[:, None, :] & (acks_n >= MAJ)
-             & ~log_commit & (log_cmd != NO_CMD) & proposed)
-    log_commit = log_commit | newly
-
-    # ---------------- P3: commit notifications --------------------------
-    m = inbox["p3"]
-    b_in = jnp.where(m["valid"], m["bal"], -1)
-    c_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)
-    c_bal = jnp.max(b_in, axis=0)
-    c_has = c_bal > 0
-    c_slot = _pick_src(m["slot"], c_src)                 # absolute
-    c_cmd = _pick_src(m["cmd"], c_src)
-    c_upto = _pick_src(m["upto"], c_src)
-    abs_ = base[:, None, :] + sidx[None, :, None]
-    c_rel = c_slot - base
-    oh = c_has[:, None, :] & (sidx[None, :, None] == c_rel[:, None, :])
-    log_cmd = jnp.where(oh, c_cmd[:, None, :], log_cmd)
-    log_bal = jnp.where(oh, jnp.maximum(log_bal, c_bal[:, None, :]),
-                        log_bal)
-    log_commit = log_commit | oh
-    # frontier commit: slots < upto accepted at the leader's exact ballot
-    ohu = (c_has[:, None, :] & (abs_ < c_upto[:, None, :])
-           & (log_bal == c_bal[:, None, :]) & (log_cmd != NO_CMD))
-    log_commit = log_commit | ohu
-
-    # ---------------- P3: snapshot catch-up for deep laggards -----------
-    # My frontier fell below the sender's window base: the slots I still
-    # need were recycled everywhere ahead of me.  Adopt the sender's
-    # (kv, execute, base) by reference and keep my own in-window commits.
-    src_base = _take_replica(base, c_src)
-    adopt = c_has & (execute < src_base)
-    adv_a = jnp.where(adopt, src_base - base, 0)
-    my_bal = _shift(log_bal, adv_a, 0)
-    my_cmd = _shift(log_cmd, adv_a, NO_CMD)
-    my_com = _shift(log_commit, adv_a, False)
-    s_bal = _take_replica(log_bal, c_src)
-    s_cmd = _take_replica(log_cmd, c_src)
-    s_com = _take_replica(log_commit, c_src)
-    a2 = adopt[:, None, :]
-    log_bal = jnp.where(a2, jnp.where(s_com, s_bal, my_bal), log_bal)
-    log_cmd = jnp.where(a2, jnp.where(s_com, s_cmd, my_cmd), log_cmd)
-    log_commit = jnp.where(a2, s_com | my_com, log_commit)
-    proposed = jnp.where(a2, False, proposed)
-    log_acks = jnp.where(a2, 0, log_acks)
-    kv = jnp.where(adopt[:, None, :], _take_replica(kv, c_src), kv)
-    execute = jnp.where(adopt, _take_replica(execute, c_src), execute)
-    next_slot = jnp.where(adopt, jnp.maximum(next_slot, execute), next_slot)
-    base = jnp.where(adopt, src_base, base)
-    abs_ = base[:, None, :] + sidx[None, :, None]
+    # ---------------- ballot/ring consensus core (shared) ---------------
+    st, out_p1b, promote = br.promise_p1a(st, inbox["p1a"])
+    st, p1_win, amask = br.tally_p1b(st, inbox["p1b"], MAJ, STRIDE)
+    st, ex = br.adopt_best_acker(st, amask, p1_win, {"kv": kv})
+    kv = ex["kv"]
+    st = br.merge_acker_logs(st, amask, p1_win)
+    st, out_p2b, acc_ok, _ = br.accept_p2a(st, inbox["p2a"])
+    st, newly = br.tally_p2b(st, inbox["p2b"], MAJ, STRIDE)
+    st, ex, c_has, c_bal = br.apply_p3(st, inbox["p3"], {"kv": kv})
+    kv = ex["kv"]
 
     # ---------------- leader proposes (new cmd or re-proposal) ----------
-    is_leader = active & own_bal
-    mask_re = (~log_commit) & (~proposed) & (abs_ < next_slot[:, None, :])
-    first_re = jnp.argmin(jnp.where(mask_re, sidx[None, :, None], S),
-                          axis=1)
-    has_re = jnp.any(mask_re, axis=1)
-    can_new = (next_slot - base) < S                     # window flow control
-    rel_next = jnp.clip(next_slot - base, 0, S - 1)
-    prop_rel = jnp.where(has_re, first_re, rel_next).astype(jnp.int32)
-    prop_slot = base + prop_rel                          # absolute
+    # the closed-loop client: one fresh command per step, window
+    # permitting — this block is what distinguishes this kernel from
+    # other ballot_ring users
+    is_leader = st["active"] & br.own_bal_mask(st, STRIDE)
+    has_re, can_new, prop_rel, prop_slot, oh_p, re_cmd = \
+        br.repropose_target(st)
     is_new = ~has_re & can_new
-    new_cmd = encode_cmd(ballot, prop_slot)
-    oh_p = sidx[None, :, None] == prop_rel[:, None, :]   # (R, S, G) one-hot
-    re_cmd = jnp.sum(jnp.where(oh_p, log_cmd, 0), axis=1)
-    re_cmd = jnp.where(re_cmd == NO_CMD, NOOP, re_cmd)
-    prop_cmd = jnp.where(is_new, new_cmd, re_cmd)
+    prop_cmd = jnp.where(is_new, encode_cmd(st["ballot"], prop_slot),
+                         re_cmd)
     do = is_leader & (has_re | can_new)
-    oh = do[:, None, :] & oh_p
-    log_bal = jnp.where(oh, ballot[:, None, :], log_bal)
-    log_cmd = jnp.where(oh & ~log_commit, prop_cmd[:, None, :], log_cmd)
-    proposed = proposed | oh
-    log_acks = log_acks | jnp.where(oh, self_bit3, 0)
-    next_slot = next_slot + (is_new & do)
-    out_p2a = {
-        "valid": jnp.broadcast_to(do[:, None, :], (R, R, G)),
-        "bal": jnp.broadcast_to(ballot[:, None, :], (R, R, G)),
-        "slot": jnp.broadcast_to(prop_slot[:, None, :], (R, R, G)),
-        "cmd": jnp.broadcast_to(prop_cmd[:, None, :], (R, R, G)),
-    }
+    st, out_p2a = br.propose_write(st, do, is_new, prop_cmd, prop_slot,
+                                   oh_p)
 
     # ---------------- execute committed prefix, apply to KV -------------
+    execute = st["execute"]
     advanced = jnp.zeros_like(execute)
-    running = jnp.ones_like(active)
-    kidx = jnp.arange(K, dtype=jnp.int32)
+    running = jnp.ones_like(st["active"])
     for e in range(cfg.exec_window):
-        rel = execute + e - base                         # ring position
+        rel = execute + e - st["base"]                   # ring position
         oh_e = sidx[None, :, None] == rel[:, None, :]    # no hit if rel >= S
-        com = jnp.any(oh_e & log_commit, axis=1)
+        com = jnp.any(oh_e & st["log_commit"], axis=1)
         running = running & com
-        cmd_e = jnp.sum(jnp.where(oh_e, log_cmd, 0), axis=1)
+        cmd_e = jnp.sum(jnp.where(oh_e, st["log_cmd"], 0), axis=1)
         key_e = cmd_key(cmd_e, K)
         wr = running & (cmd_e >= 0)
         ohk = wr[:, None, :] & (kidx[None, :, None] == key_e[:, None, :])
@@ -373,72 +156,14 @@ def step(state, inbox, ctx: StepCtx):
         advanced = advanced + running
     new_execute = execute + advanced
 
-    # ---------------- P3 out: newly committed + frontier retransmit -----
-    low_new = jnp.argmin(jnp.where(newly, sidx[None, :, None], S), axis=1)
-    any_new = jnp.any(newly, axis=1)
-    # otherwise cycle retransmits through my in-window committed prefix
-    # (laggards behind the window are healed by snapshot adoption)
-    span = jnp.maximum(new_execute - base, 1)
-    rr = ctx.t % span
-    p3_rel = jnp.where(any_new, low_new, rr).astype(jnp.int32)
-    p3_rel = jnp.clip(p3_rel, 0, S - 1)
-    oh_3 = sidx[None, :, None] == p3_rel[:, None, :]
-    p3_committed = jnp.any(oh_3 & log_commit, axis=1)
-    p3_cmd = jnp.sum(jnp.where(oh_3, log_cmd, 0), axis=1)
-    p3_do = is_leader & p3_committed
-    out_p3 = {
-        "valid": jnp.broadcast_to(p3_do[:, None, :], (R, R, G)),
-        "bal": jnp.broadcast_to(ballot[:, None, :], (R, R, G)),
-        "slot": jnp.broadcast_to((base + p3_rel)[:, None, :], (R, R, G)),
-        "cmd": jnp.broadcast_to(p3_cmd[:, None, :], (R, R, G)),
-        "upto": jnp.broadcast_to(new_execute[:, None, :], (R, R, G)),
-    }
+    # ---------------- wrap-up: P3 out, retry, election, slide -----------
+    out_p3 = br.p3_out(st, newly, new_execute, is_leader, ctx.t)
+    st = br.retry_stuck(st, new_execute, is_leader, cfg.retry_timeout)
+    heard = promote | acc_ok | (c_has & (c_bal >= st["ballot"]))
+    st, out_p1a = br.election_tick(st, heard, ctx.rng, cfg)
+    st = br.slide_window(st, new_execute, RETAIN)
 
-    # ---------------- stuck-frontier retry (lost P2a/P2b) ---------------
-    stalled = is_leader & (new_execute == execute) \
-        & (next_slot > new_execute)
-    stuck = jnp.where(stalled, state["stuck"] + 1, 0)
-    retry = stuck >= cfg.retry_timeout
-    rel_e = jnp.clip(new_execute - base, 0, S - 1)
-    ohr = retry[:, None, :] & (sidx[None, :, None] == rel_e[:, None, :])
-    proposed = proposed & ~ohr
-    stuck = jnp.where(retry, 0, stuck)
-
-    # ---------------- election timer ------------------------------------
-    heard = promote | acc_ok | (c_has & (c_bal >= ballot))
-    k_jit = jr.fold_in(ctx.rng, 17)
-    jitter = jr.randint(k_jit, ballot.shape, 0, cfg.backoff + 1)
-    timer = jnp.where(heard | active,
-                      cfg.election_timeout + jitter,
-                      state["timer"] - 1)
-    fire = ~active & (timer <= 0)
-    new_bal = (jnp.max(ballot, axis=0)[None, :] // STRIDE + 1) * STRIDE \
-        + ridx[:, None]
-    ballot = jnp.where(fire, new_bal, ballot)
-    p1_acks = jnp.where(fire, self_bit2, p1_acks)
-    timer = jnp.where(fire, cfg.election_timeout + jitter, timer)
-    out_p1a = {
-        "valid": jnp.broadcast_to(fire[:, None, :], (R, R, G)),
-        "bal": jnp.broadcast_to(ballot[:, None, :], (R, R, G)),
-    }
-
-    # ---------------- slide the ring window (slot recycling) ------------
-    # keep the last RETAIN executed slots resident for P3 retransmits;
-    # anything older is only reachable via snapshot adoption
-    new_base = jnp.maximum(base, new_execute - RETAIN)
-    adv = new_base - base
-    log_bal = _shift(log_bal, adv, 0)
-    log_cmd = _shift(log_cmd, adv, NO_CMD)
-    log_commit = _shift(log_commit, adv, False)
-    proposed = _shift(proposed, adv, False)
-    log_acks = _shift(log_acks, adv, 0)
-
-    new_state = dict(
-        ballot=ballot, active=active, p1_acks=p1_acks, base=new_base,
-        log_bal=log_bal, log_cmd=log_cmd, log_commit=log_commit,
-        log_acks=log_acks, proposed=proposed, next_slot=next_slot,
-        execute=new_execute, kv=kv, timer=timer, stuck=stuck,
-    )
+    new_state = dict(st, kv=kv)
     outbox = {"p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
               "p2b": out_p2b, "p3": out_p3}
     return new_state, outbox

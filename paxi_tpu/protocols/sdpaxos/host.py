@@ -182,6 +182,7 @@ class SDPaxosReplica(Node):
         self.committed: Set[Tuple[str, int]] = set()  # pairs commit-known
         self.executed: Set[Tuple[str, int]] = set()  # at-most-once dedup
         self.queue: list = []                      # pairs awaiting a slot
+        self.queued: Set[Tuple[str, int]] = set()  # O(1) queue membership
         self.seq_quorum = Quorum(cfg.ids)
         self.seq1b_logs: Dict[ID, Dict[int, list]] = {}
         self.ctab: Dict[str, Tuple[int, bytes]] = {}
@@ -311,18 +312,27 @@ class SDPaxosReplica(Node):
     def handle_oreq(self, m: OReq) -> None:
         pair = (m.owner, m.cidx)
         if pair in self.committed or pair in self.ordered \
-                or pair in self.queue:
+                or pair in self.queued:
             return
         self.queue.append(pair)
+        self.queued.add(pair)
         self._drain_queue()
 
     def _drain_queue(self) -> None:
         if not self.is_sequencer():
             return
         queue, self.queue = self.queue, []
+        self.queued.clear()
         for pair in queue:
             if pair not in self.ordered:
                 self._propose_o(pair)
+
+    def _unqueue(self, pair: Tuple[str, int]) -> None:
+        """Drop a now-committed pair from a bystander's request queue —
+        without this, non-sequencer queues grow with command history."""
+        if pair in self.queued:
+            self.queued.discard(pair)
+            self.queue.remove(pair)
 
     def _propose_o(self, pair: Tuple[str, int],
                    at_slot: Optional[int] = None) -> None:
@@ -448,6 +458,7 @@ class SDPaxosReplica(Node):
         e.commit = True
         if e.pair != NOOP_PAIR:
             self.committed.add(e.pair)
+            self._unqueue(e.pair)
         self.socket.broadcast(OCommit(self.ballot, slot, e.pair[0],
                                       e.pair[1]))
         self._exec()
@@ -460,6 +471,7 @@ class SDPaxosReplica(Node):
         if pair != NOOP_PAIR:
             self.ordered.add(pair)
             self.committed.add(pair)
+            self._unqueue(pair)
         self.oslot = max(self.oslot, m.slot)
         self._exec()
 
@@ -490,6 +502,14 @@ class SDPaxosReplica(Node):
                 self.ordered.discard(e.pair)
                 self.committed.discard(e.pair)
                 self.executed.discard(e.pair)
+                self._unqueue(e.pair)
+                # the command BODIES dominate memory: a slot below the
+                # watermark executed on every replica, so its body (and
+                # my own C-quorum bookkeeping) can never be needed again
+                self.cstore.pop(e.pair, None)
+                if e.pair[0] == str(self.id):
+                    self.cquorum.pop(e.pair[1], None)
+                    self.cchosen.discard(e.pair[1])
         self.gc_base = new_base
 
     def handle_ofetch(self, m: OFetch) -> None:

@@ -12,10 +12,11 @@ with ordinary Paxos ballots, so a sequencer crash costs one election,
 not availability.
 
 TPU re-design (lane-major layout — see sim/lanes.py; not a translation):
-- **O-log = the Multi-Paxos ring machinery** (protocols/paxos/sim.py):
-  ballot election with jittered timers, P1 merge by reference, P2
-  acceptance under bit-packed ack masks, P3 commit + frontier, snapshot
-  catch-up, and a sliding window over absolute slots.
+- **O-log = the shared Multi-Paxos ring machinery** (sim/ballot_ring.py,
+  also driven by protocols/paxos/sim.py): ballot election with jittered
+  timers, P1 merge by reference, P2 acceptance under bit-packed ack
+  masks, P3 commit + frontier, snapshot catch-up, go-back-N stuck
+  retry, and a sliding window over absolute slots.
 - **O-entries are owner tokens, bound positionally.**  The reference
   names (owner, index) pairs in O-instances; here an O-entry carries
   only the owner id, and the t-th committed token of owner ``o`` maps
@@ -50,7 +51,9 @@ TPU re-design (lane-major layout — see sim/lanes.py; not a translation):
 - Execution walks the committed O-prefix; a token of owner ``o``
   applies command ``(o, exec_c[me, o])`` only when that body is locally
   durable (``exec_c < c_stored``) — a missing body stalls execution
-  (liveness), never reorders it (safety).
+  (liveness), never reorders it (safety).  Stalls broadcast a ``cneed``
+  body request that any holder answers (``cr``), so a perm-crashed
+  owner's chosen bodies cannot wedge the cluster.
 """
 
 from __future__ import annotations
@@ -59,20 +62,21 @@ from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
-import jax.random as jr
 
 from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim import ballot_ring as br
+from paxi_tpu.sim.ballot_ring import NO_CMD
 from paxi_tpu.sim.ring import diag2, dst_major
 from paxi_tpu.sim.ring import pick_src as _pick_src
 from paxi_tpu.sim.ring import require_packable
-from paxi_tpu.sim.ring import shift_row as _shift_row
 from paxi_tpu.sim.ring import shift_window as _shift
-from paxi_tpu.sim.ring import take_replica as _take_replica
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
-NO_CMD = -1    # empty O-log entry
-NOOP = -2      # hole filled by a recovering sequencer
 IDX_BITS = 20  # cidx field width in the executed command id
+
+# the ballot-ring planes ballot_ring.py owns (the O-log); this kernel
+# adds the C-plane and kv
+BR_KEYS = br.KEYS
 
 
 def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
@@ -118,7 +122,7 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
         o_seen=jnp.zeros((R, R, G), i32),  # [me, owner] chosen frontier
         o_enq=jnp.zeros((R, R, G), i32),   # [seqr, owner] tokens ordered
         exec_c=jnp.zeros((R, R, G), i32),  # [me, owner] tokens executed
-        # ---- O-log (centralized ordering; paxos ring machinery) ----
+        # ---- O-log (centralized ordering; shared ring machinery) ----
         ballot=jnp.zeros((R, G), i32),
         active=jnp.zeros((R, G), bool),
         p1_acks=jnp.zeros((R, G), i32),
@@ -145,29 +149,18 @@ def step(state, inbox, ctx: StepCtx):
     RETAIN = max(S // 2, 1)
     ridx = jnp.arange(R, dtype=jnp.int32)
     sidx = jnp.arange(S, dtype=jnp.int32)
-    src_bit = (jnp.int32(1) << ridx)[:, None, None]   # also self-bit for
-    self_bit2 = (jnp.int32(1) << ridx)[:, None]       # (R, S, G) planes
+    kidx = jnp.arange(K, dtype=jnp.int32)
     own_diag = ridx[:, None, None] == ridx[None, :, None]   # (R, R, 1)
 
+    st = {k: state[k] for k in BR_KEYS}
     c_next = state["c_next"]
     c_stored = state["c_stored"]
     c_ack = state["c_ack"]
     o_seen = state["o_seen"]
     o_enq = state["o_enq"]
     exec_c = state["exec_c"]
-    ballot = state["ballot"]
-    active = state["active"]
-    p1_acks = state["p1_acks"]
-    base = state["base"]
-    log_bal = state["log_bal"]
-    log_cmd = state["log_cmd"]
-    log_commit = state["log_commit"]
-    log_acks = state["log_acks"]
-    proposed = state["proposed"]
-    next_slot = state["next_slot"]
-    execute = state["execute"]
     kv = state["kv"]
-    G = ballot.shape[-1]
+    G = c_next.shape[-1]
 
     T = dst_major                         # (src, dst, G) -> (me, src, G)
 
@@ -240,234 +233,63 @@ def step(state, inbox, ctx: StepCtx):
         "n": jnp.broadcast_to(chosen[:, None, :], (R, R, G)),
     }
 
-    # ================= O-log: Multi-Paxos over owner tokens =============
-    # ---------------- P1a: promise to the highest proposer --------------
-    m = inbox["p1a"]
-    b_in = jnp.where(m["valid"], m["bal"], 0)
-    p1a_bal = jnp.max(b_in, axis=0)
-    p1a_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)
-    promote = p1a_bal > ballot
-    ballot = jnp.maximum(ballot, p1a_bal)
-    active = active & ~promote
-    p1_acks = jnp.where(promote, 0, p1_acks)
-    p1b_valid = promote[:, None, :] & (ridx[None, :, None]
-                                       == p1a_src[:, None, :])
-    out_p1b = {"valid": p1b_valid,
-               "bal": jnp.broadcast_to(ballot[:, None, :], (R, R, G))}
-
-    own_bal = (ballot > 0) & (ballot % STRIDE == ridx[:, None])
-
-    # ---------------- P1b: collect phase-1 acks -------------------------
-    m = inbox["p1b"]
-    cond = m["valid"] & (m["bal"] == ballot[None, :, :]) \
-        & own_bal[None, :, :]
-    p1_acks = p1_acks | jnp.sum(jnp.where(cond, src_bit, 0), axis=0)
-    p1_win = own_bal & ~active \
-        & (jax.lax.population_count(p1_acks) >= MAJ)
-    amask = ((p1_acks[:, None, :] >> ridx[None, :, None]) & 1).astype(bool)
-
-    # ---------------- phase-1 win: state transfer from best acker -------
-    exec_am = jnp.where(amask, execute[None, :, :], -1)
-    f_src = jnp.argmax(exec_am, axis=1).astype(jnp.int32)
-    front = jnp.max(exec_am, axis=1)
-    el_ad = p1_win & (front > execute)
-    kv = jnp.where(el_ad[:, None, :], _take_replica(kv, f_src), kv)
-    exec_c = jnp.where(el_ad[:, None, :], _take_replica(exec_c, f_src),
-                       exec_c)
-    execute = jnp.where(el_ad, front, execute)
-    next_slot = jnp.where(el_ad, jnp.maximum(next_slot, front), next_slot)
-    f_base = _take_replica(base, f_src)
-    adv_el = jnp.where(el_ad, jnp.maximum(f_base - base, 0), 0)
-    base = jnp.where(el_ad, jnp.maximum(f_base, base), base)
-    log_bal = _shift(log_bal, adv_el, 0)
-    log_cmd = _shift(log_cmd, adv_el, NO_CMD)
-    log_commit = _shift(log_commit, adv_el, False)
-    proposed = _shift(proposed, adv_el, False)
-    log_acks = _shift(log_acks, adv_el, 0)
-
-    # ---------------- phase-1 win: merge ackers' O-logs -----------------
-    best_bal = jnp.full_like(log_bal, -1)
-    merged_cmd = jnp.full_like(log_cmd, NO_CMD)
-    merged_commit = jnp.zeros_like(log_commit)
-    committed_cmd = jnp.full_like(log_cmd, NO_CMD)
-    for s in range(R):
-        sel_s = amask[:, s, :]
-        adv_s = base - base[s][None, :]
-        lb_s = _shift_row(log_bal[s], adv_s, -1)
-        lc_s = _shift_row(log_cmd[s], adv_s, NO_CMD)
-        lm_s = _shift_row(log_commit[s], adv_s, False)
-        lb_s = jnp.where(sel_s[:, None, :], lb_s, -1)
-        lm_s = lm_s & sel_s[:, None, :]
-        upd = lb_s > best_bal
-        best_bal = jnp.where(upd, lb_s, best_bal)
-        merged_cmd = jnp.where(upd, lc_s, merged_cmd)
-        committed_cmd = jnp.where(lm_s & ~merged_commit, lc_s,
-                                  committed_cmd)
-        merged_commit = merged_commit | lm_s
-    abs_ = base[:, None, :] + sidx[None, :, None]
-    has_acc = (best_bal > 0) | merged_commit
-    top = jnp.max(jnp.where(has_acc, abs_ + 1, 0), axis=1)
-    new_next = jnp.maximum(next_slot, top)
-    in_win = abs_ < new_next[:, None, :]
-    w = p1_win[:, None, :]
-    adopt_cmd = jnp.where(merged_commit, committed_cmd,
-                          jnp.where(best_bal > 0, merged_cmd, NOOP))
-    log_cmd = jnp.where(w & in_win, adopt_cmd, log_cmd)
-    log_bal = jnp.where(w & in_win, ballot[:, None, :], log_bal)
-    log_commit = jnp.where(w & in_win, merged_commit | log_commit,
-                           log_commit)
-    proposed = jnp.where(w, in_win & (merged_commit | log_commit), proposed)
-    log_acks = jnp.where(w, jnp.where(in_win, src_bit, 0), log_acks)
-    next_slot = jnp.where(p1_win, new_next, next_slot)
-    active = active | p1_win
+    # ============ O-log: shared Multi-Paxos core over owner tokens ======
+    st, out_p1b, promote = br.promise_p1a(st, inbox["p1a"])
+    st, p1_win, amask = br.tally_p1b(st, inbox["p1b"], MAJ, STRIDE)
+    st, ex = br.adopt_best_acker(st, amask, p1_win,
+                                 {"kv": kv, "exec_c": exec_c})
+    kv, exec_c = ex["kv"], ex["exec_c"]
+    st = br.merge_acker_logs(st, amask, p1_win)
 
     # ---------------- phase-1 win: rebuild per-owner token counts -------
     # tokens ordered for owner o = tokens executed (exec_c) + o's tokens
     # in my window at or above the execute frontier (everything not yet
     # executed is in-window: the ring slides only past executed slots)
-    at_or_above = (abs_ >= execute[:, None, :]) \
-        & (abs_ < next_slot[:, None, :])
+    abs_ = st["base"][:, None, :] + sidx[None, :, None]
+    at_or_above = (abs_ >= st["execute"][:, None, :]) \
+        & (abs_ < st["next_slot"][:, None, :])
     rebuilt = jnp.zeros_like(o_enq)
     for o in range(R):
-        cnt = jnp.sum(at_or_above & (log_cmd == o), axis=1)     # (R, G)
+        cnt = jnp.sum(at_or_above & (st["log_cmd"] == o), axis=1)  # (R, G)
         rebuilt = jnp.where(ridx[None, :, None] == o,
                             (exec_c[:, o, :] + cnt)[:, None, :], rebuilt)
     o_enq = jnp.where(p1_win[:, None, :], rebuilt, o_enq)
 
-    # ---------------- P2a: accept from the highest-ballot leader --------
-    m = inbox["p2a"]
-    b_in = jnp.where(m["valid"], m["bal"], -1)
-    a_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)
-    a_bal = jnp.max(b_in, axis=0)
-    a_has = a_bal > 0
-    a_slot = _pick_src(m["slot"], a_src)
-    a_cmd = _pick_src(m["cmd"], a_src)
-    acc_ok = a_has & (a_bal >= ballot)
-    demote = acc_ok & (a_bal > ballot)
-    ballot = jnp.where(acc_ok, a_bal, ballot)
-    active = active & ~demote
-    p1_acks = jnp.where(demote, 0, p1_acks)
-    a_rel = a_slot - base
-    a_inw = (a_rel >= 0) & (a_rel < S)
-    oh = acc_ok[:, None, :] & (sidx[None, :, None] == a_rel[:, None, :])
-    writable = oh & (log_bal <= a_bal[:, None, :]) & ~log_commit
-    log_bal = jnp.where(writable, a_bal[:, None, :], log_bal)
-    log_cmd = jnp.where(writable, a_cmd[:, None, :], log_cmd)
-    out_p2b = {
-        "valid": (acc_ok & a_inw)[:, None, :]
-        & (ridx[None, :, None] == a_src[:, None, :]),
-        "bal": jnp.broadcast_to(a_bal[:, None, :], (R, R, G)),
-        "slot": jnp.broadcast_to(a_slot[:, None, :], (R, R, G)),
-    }
-
-    own_bal = (ballot > 0) & (ballot % STRIDE == ridx[:, None])
-
-    # ---------------- P2b: sequencer tallies acks, commits --------------
-    m = inbox["p2b"]
-    okb = m["valid"] & (m["bal"] == ballot[None, :, :]) \
-        & (active & own_bal)[None, :, :]
-    brel = m["slot"] - base[None, :, :]
-    for s in range(R):
-        oh_s = okb[s][:, None, :] \
-            & (sidx[None, :, None] == brel[s][:, None, :])
-        log_acks = log_acks | jnp.where(oh_s, jnp.int32(1) << s, 0)
-    acks_n = jax.lax.population_count(log_acks)
-    newly = ((active & own_bal)[:, None, :] & (acks_n >= MAJ)
-             & ~log_commit & (log_cmd != NO_CMD) & proposed)
-    log_commit = log_commit | newly
-
-    # ---------------- P3: commit notifications --------------------------
-    m = inbox["p3"]
-    b_in = jnp.where(m["valid"], m["bal"], -1)
-    c_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)
-    c_bal = jnp.max(b_in, axis=0)
-    c_has = c_bal > 0
-    c_slot = _pick_src(m["slot"], c_src)
-    c_cmd = _pick_src(m["cmd"], c_src)
-    c_upto = _pick_src(m["upto"], c_src)
-    abs_ = base[:, None, :] + sidx[None, :, None]
-    c_rel = c_slot - base
-    oh = c_has[:, None, :] & (sidx[None, :, None] == c_rel[:, None, :])
-    log_cmd = jnp.where(oh, c_cmd[:, None, :], log_cmd)
-    log_bal = jnp.where(oh, jnp.maximum(log_bal, c_bal[:, None, :]),
-                        log_bal)
-    log_commit = log_commit | oh
-    ohu = (c_has[:, None, :] & (abs_ < c_upto[:, None, :])
-           & (log_bal == c_bal[:, None, :]) & (log_cmd != NO_CMD))
-    log_commit = log_commit | ohu
-
-    # ---------------- P3: snapshot catch-up for deep laggards -----------
-    src_base = _take_replica(base, c_src)
-    adopt = c_has & (execute < src_base)
-    adv_a = jnp.where(adopt, src_base - base, 0)
-    my_bal = _shift(log_bal, adv_a, 0)
-    my_cmd = _shift(log_cmd, adv_a, NO_CMD)
-    my_com = _shift(log_commit, adv_a, False)
-    s_bal = _take_replica(log_bal, c_src)
-    s_cmd = _take_replica(log_cmd, c_src)
-    s_com = _take_replica(log_commit, c_src)
-    a2 = adopt[:, None, :]
-    log_bal = jnp.where(a2, jnp.where(s_com, s_bal, my_bal), log_bal)
-    log_cmd = jnp.where(a2, jnp.where(s_com, s_cmd, my_cmd), log_cmd)
-    log_commit = jnp.where(a2, s_com | my_com, log_commit)
-    proposed = jnp.where(a2, False, proposed)
-    log_acks = jnp.where(a2, 0, log_acks)
-    kv = jnp.where(adopt[:, None, :], _take_replica(kv, c_src), kv)
-    exec_c = jnp.where(adopt[:, None, :], _take_replica(exec_c, c_src),
-                       exec_c)
-    execute = jnp.where(adopt, _take_replica(execute, c_src), execute)
-    next_slot = jnp.where(adopt, jnp.maximum(next_slot, execute), next_slot)
-    base = jnp.where(adopt, src_base, base)
-    abs_ = base[:, None, :] + sidx[None, :, None]
+    st, out_p2b, acc_ok, _ = br.accept_p2a(st, inbox["p2a"])
+    st, newly = br.tally_p2b(st, inbox["p2b"], MAJ, STRIDE)
+    st, ex, c_has, c_bal = br.apply_p3(st, inbox["p3"],
+                                       {"kv": kv, "exec_c": exec_c})
+    kv, exec_c = ex["kv"], ex["exec_c"]
 
     # ---------------- sequencer proposes (backlog or re-proposal) -------
-    is_leader = active & own_bal
-    mask_re = (~log_commit) & (~proposed) & (abs_ < next_slot[:, None, :])
-    first_re = jnp.argmin(jnp.where(mask_re, sidx[None, :, None], S),
-                          axis=1)
-    has_re = jnp.any(mask_re, axis=1)
-    can_new = (next_slot - base) < S
-    rel_next = jnp.clip(next_slot - base, 0, S - 1)
-    prop_rel = jnp.where(has_re, first_re, rel_next).astype(jnp.int32)
-    prop_slot = base + prop_rel
     # ordering queue: deepest-backlog owner's token (replaces the paxos
     # kernel's self-generated client command)
+    is_leader = st["active"] & br.own_bal_mask(st, STRIDE)
+    has_re, can_new, prop_rel, prop_slot, oh_p, re_cmd = \
+        br.repropose_target(st)
     backlog = jnp.maximum(o_seen - o_enq, 0)             # (seqr, owner, G)
     pick_o = jnp.argmax(backlog, axis=1).astype(jnp.int32)   # (seqr, G)
     has_bl = jnp.any(backlog > 0, axis=1)
     is_new = ~has_re & can_new & has_bl
-    oh_p = sidx[None, :, None] == prop_rel[:, None, :]
-    re_cmd = jnp.sum(jnp.where(oh_p, log_cmd, 0), axis=1)
-    re_cmd = jnp.where(re_cmd == NO_CMD, NOOP, re_cmd)
     prop_cmd = jnp.where(is_new, pick_o, re_cmd)
     do = is_leader & (has_re | is_new)
-    oh = do[:, None, :] & oh_p
-    log_bal = jnp.where(oh, ballot[:, None, :], log_bal)
-    log_cmd = jnp.where(oh & ~log_commit, prop_cmd[:, None, :], log_cmd)
-    proposed = proposed | oh
-    log_acks = log_acks | jnp.where(oh, src_bit, 0)
-    next_slot = next_slot + (is_new & do)
+    st, out_p2a = br.propose_write(st, do, is_new, prop_cmd, prop_slot,
+                                   oh_p)
     enq_bump = (is_new & do)[:, None, :] \
         & (ridx[None, :, None] == pick_o[:, None, :])
     o_enq = o_enq + enq_bump
-    out_p2a = {
-        "valid": jnp.broadcast_to(do[:, None, :], (R, R, G)),
-        "bal": jnp.broadcast_to(ballot[:, None, :], (R, R, G)),
-        "slot": jnp.broadcast_to(prop_slot[:, None, :], (R, R, G)),
-        "cmd": jnp.broadcast_to(prop_cmd[:, None, :], (R, R, G)),
-    }
 
     # ---------------- execute committed O-prefix (body-gated) -----------
+    execute = st["execute"]
     advanced = jnp.zeros_like(execute)
-    running = jnp.ones_like(active)
+    running = jnp.ones_like(st["active"])
     need_own = jnp.full_like(execute, -1)
     need_idx = jnp.zeros_like(execute)
-    kidx = jnp.arange(K, dtype=jnp.int32)
     for e in range(cfg.exec_window):
-        rel = execute + e - base
+        rel = execute + e - st["base"]
         oh_e = sidx[None, :, None] == rel[:, None, :]
-        com = jnp.any(oh_e & log_commit, axis=1)
-        cmd_e = jnp.sum(jnp.where(oh_e, log_cmd, 0), axis=1)
+        com = jnp.any(oh_e & st["log_commit"], axis=1)
+        cmd_e = jnp.sum(jnp.where(oh_e, st["log_cmd"], 0), axis=1)
         is_tok = cmd_e >= 0
         own_e = jnp.clip(cmd_e, 0, R - 1)
         stored_e = _pick_src(jnp.swapaxes(c_stored, 0, 1), own_e)
@@ -499,76 +321,15 @@ def step(state, inbox, ctx: StepCtx):
         "cidx": jnp.broadcast_to(need_idx[:, None, :], (R, R, G)),
     }
 
-    # ---------------- P3 out: newly committed + frontier retransmit -----
-    low_new = jnp.argmin(jnp.where(newly, sidx[None, :, None], S), axis=1)
-    any_new = jnp.any(newly, axis=1)
-    span = jnp.maximum(new_execute - base, 1)
-    rr = ctx.t % span
-    p3_rel = jnp.where(any_new, low_new, rr).astype(jnp.int32)
-    p3_rel = jnp.clip(p3_rel, 0, S - 1)
-    oh_3 = sidx[None, :, None] == p3_rel[:, None, :]
-    p3_committed = jnp.any(oh_3 & log_commit, axis=1)
-    p3_cmd = jnp.sum(jnp.where(oh_3, log_cmd, 0), axis=1)
-    p3_do = is_leader & p3_committed
-    out_p3 = {
-        "valid": jnp.broadcast_to(p3_do[:, None, :], (R, R, G)),
-        "bal": jnp.broadcast_to(ballot[:, None, :], (R, R, G)),
-        "slot": jnp.broadcast_to((base + p3_rel)[:, None, :], (R, R, G)),
-        "cmd": jnp.broadcast_to(p3_cmd[:, None, :], (R, R, G)),
-        "upto": jnp.broadcast_to(new_execute[:, None, :], (R, R, G)),
-    }
+    # ---------------- wrap-up: P3 out, retry, election, slide -----------
+    out_p3 = br.p3_out(st, newly, new_execute, is_leader, ctx.t)
+    st = br.retry_stuck(st, new_execute, is_leader, cfg.retry_timeout)
+    heard = promote | acc_ok | (c_has & (c_bal >= st["ballot"]))
+    st, out_p1a = br.election_tick(st, heard, ctx.rng, cfg)
+    st = br.slide_window(st, new_execute, RETAIN)
 
-    # ---------------- stuck-frontier retry (go-back-N) ------------------
-    # A dropped P2a/P2b leaves its slot unproposable forever (P2a is
-    # sent once); on a stall re-open EVERY uncommitted in-flight slot so
-    # the proposer re-proposes one per step instead of one per timeout —
-    # a deep uncommitted backlog under sustained drops drains in O(N)
-    # steps, not O(N * retry_timeout)
-    stalled = is_leader & (new_execute == execute) \
-        & (next_slot > new_execute)
-    stuck = jnp.where(stalled, state["stuck"] + 1, 0)
-    retry = stuck >= cfg.retry_timeout
-    ohr = (retry[:, None, :] & ~log_commit
-           & (abs_ >= new_execute[:, None, :])
-           & (abs_ < next_slot[:, None, :]))
-    proposed = proposed & ~ohr
-    stuck = jnp.where(retry, 0, stuck)
-
-    # ---------------- election timer ------------------------------------
-    heard = promote | acc_ok | (c_has & (c_bal >= ballot))
-    k_jit = jr.fold_in(ctx.rng, 17)
-    jitter = jr.randint(k_jit, ballot.shape, 0, cfg.backoff + 1)
-    timer = jnp.where(heard | active,
-                      cfg.election_timeout + jitter,
-                      state["timer"] - 1)
-    fire = ~active & (timer <= 0)
-    new_bal = (jnp.max(ballot, axis=0)[None, :] // STRIDE + 1) * STRIDE \
-        + ridx[:, None]
-    ballot = jnp.where(fire, new_bal, ballot)
-    p1_acks = jnp.where(fire, self_bit2, p1_acks)
-    timer = jnp.where(fire, cfg.election_timeout + jitter, timer)
-    out_p1a = {
-        "valid": jnp.broadcast_to(fire[:, None, :], (R, R, G)),
-        "bal": jnp.broadcast_to(ballot[:, None, :], (R, R, G)),
-    }
-
-    # ---------------- slide the O-ring window ---------------------------
-    new_base = jnp.maximum(base, new_execute - RETAIN)
-    adv = new_base - base
-    log_bal = _shift(log_bal, adv, 0)
-    log_cmd = _shift(log_cmd, adv, NO_CMD)
-    log_commit = _shift(log_commit, adv, False)
-    proposed = _shift(proposed, adv, False)
-    log_acks = _shift(log_acks, adv, 0)
-
-    new_state = dict(
-        c_next=c_next, c_stored=c_stored, c_ack=c_ack, o_seen=o_seen,
-        o_enq=o_enq, exec_c=exec_c,
-        ballot=ballot, active=active, p1_acks=p1_acks, base=new_base,
-        log_bal=log_bal, log_cmd=log_cmd, log_commit=log_commit,
-        log_acks=log_acks, proposed=proposed, next_slot=next_slot,
-        execute=new_execute, kv=kv, timer=timer, stuck=stuck,
-    )
+    new_state = dict(st, c_next=c_next, c_stored=c_stored, c_ack=c_ack,
+                     o_seen=o_seen, o_enq=o_enq, exec_c=exec_c, kv=kv)
     outbox = {"ca": out_ca, "cack": out_cack, "oreq": out_oreq,
               "cneed": out_cneed, "cr": out_cr,
               "p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,

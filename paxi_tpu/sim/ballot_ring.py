@@ -1,0 +1,435 @@
+"""Shared Multi-Paxos ring machinery for lane-major sim kernels.
+
+One audited copy of the ballot/ring consensus core that several
+protocol kernels run on: the paxos kernel drives it with self-generated
+client commands (protocols/paxos/sim.py), the sdpaxos kernel with
+sequencer-ordered owner tokens (protocols/sdpaxos/sim.py).  Reference:
+paxi paxos/paxos.go HandleP1a/P1b/P2a/P2b/P3 [driver] — see the paxos
+kernel docstring for the full TPU re-design rationale (masked handlers,
+bit-packed acks, sliding ring over absolute slots, by-reference P1b
+merge, P3 snapshot catch-up).
+
+Conventions:
+- ``st`` is the protocol's state dict; these helpers read/write the 13
+  standard keys (ballot, active, p1_acks, base, log_bal, log_cmd,
+  log_commit, log_acks, proposed, next_slot, execute, timer, stuck) and
+  leave every other key untouched.
+- ``extras`` is a dict of additional ``(R, ..., G)`` planes that must
+  travel with state transfer (election adoption and P3 snapshot
+  catch-up): the KV store for paxos, KV + per-owner execution counters
+  for sdpaxos.
+- Mailbox planes are ``(src, dst, G)``; handlers consume them
+  receiver-major via masked selects (ring.pick_src), never gathers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from paxi_tpu.sim.ring import pick_src
+from paxi_tpu.sim.ring import shift_row as _shift_row
+from paxi_tpu.sim.ring import shift_window as _shift
+from paxi_tpu.sim.ring import take_replica as _take_replica
+
+NO_CMD = -1    # empty log entry
+NOOP = -2      # hole filled by a recovering leader
+
+# the 13 state planes this module owns; kernels build their state dicts
+# with these keys plus their protocol-specific extras
+KEYS = ("ballot", "active", "p1_acks", "base", "log_bal", "log_cmd",
+        "log_commit", "log_acks", "proposed", "next_slot", "execute",
+        "timer", "stuck")
+
+
+def _ridx(st):
+    R = st["log_bal"].shape[0]
+    return jnp.arange(R, dtype=jnp.int32)
+
+
+def _sidx(st):
+    S = st["log_bal"].shape[1]
+    return jnp.arange(S, dtype=jnp.int32)
+
+
+def own_bal_mask(st, stride):
+    """Replicas whose current ballot is their own (ballot.ID() == me)."""
+    ridx = _ridx(st)
+    return (st["ballot"] > 0) & (st["ballot"] % stride == ridx[:, None])
+
+
+def promise_p1a(st, m):
+    """P1a handler: promise to the highest proposer; emit P1b to it.
+    Returns (st', out_p1b, promote)."""
+    R = st["log_bal"].shape[0]
+    ridx = _ridx(st)
+    G = st["ballot"].shape[-1]
+    b_in = jnp.where(m["valid"], m["bal"], 0)
+    p1a_bal = jnp.max(b_in, axis=0)                      # (dst, G)
+    p1a_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)
+    promote = p1a_bal > st["ballot"]
+    ballot = jnp.maximum(st["ballot"], p1a_bal)
+    out_p1b = {
+        "valid": promote[:, None, :] & (ridx[None, :, None]
+                                        == p1a_src[:, None, :]),
+        "bal": jnp.broadcast_to(ballot[:, None, :], (R, R, G)),
+    }
+    st = {**st, "ballot": ballot,
+          "active": st["active"] & ~promote,
+          "p1_acks": jnp.where(promote, 0, st["p1_acks"])}
+    return st, out_p1b, promote
+
+
+def tally_p1b(st, m, majority, stride):
+    """P1b handler: collect phase-1 acks into the bit-packed mask.
+    Returns (st', p1_win, amask) where amask[ldr, s, g] marks s as an
+    acker of ldr's round (self included)."""
+    ridx = _ridx(st)
+    src_bit = (jnp.int32(1) << ridx)[:, None, None]
+    ob = own_bal_mask(st, stride)
+    cond = m["valid"] & (m["bal"] == st["ballot"][None, :, :]) \
+        & ob[None, :, :]                                 # (src, ldr, G)
+    p1_acks = st["p1_acks"] | jnp.sum(jnp.where(cond, src_bit, 0), axis=0)
+    p1_win = ob & ~st["active"] \
+        & (jax.lax.population_count(p1_acks) >= majority)
+    amask = ((p1_acks[:, None, :] >> ridx[None, :, None]) & 1).astype(bool)
+    return {**st, "p1_acks": p1_acks}, p1_win, amask
+
+
+def adopt_best_acker(st, amask, p1_win, extras):
+    """Phase-1 win, step 1: a laggard winner adopts the most advanced
+    acker's (extras, execute, base) by reference — the state-transfer /
+    log-compaction analog of the host runtime's P1b snapshot.  Returns
+    (st', extras')."""
+    el_exec = jnp.where(amask, st["execute"][None, :, :], -1)
+    f_src = jnp.argmax(el_exec, axis=1).astype(jnp.int32)
+    front = jnp.max(el_exec, axis=1)
+    el_ad = p1_win & (front > st["execute"])
+    ex = {k: jnp.where(el_ad[(slice(None),)
+                             + (None,) * (v.ndim - 2) + (slice(None),)],
+                       _take_replica(v, f_src), v)
+          for k, v in extras.items()}
+    execute = jnp.where(el_ad, front, st["execute"])
+    next_slot = jnp.where(el_ad, jnp.maximum(st["next_slot"], front),
+                          st["next_slot"])
+    # never adopt a LOWER base: a negative self-shift would drop my own
+    # top-of-window entries (possibly committed via P3); the merge
+    # tolerates ackers whose base is below mine (front-fill only)
+    f_base = _take_replica(st["base"], f_src)
+    adv_el = jnp.where(el_ad, jnp.maximum(f_base - st["base"], 0), 0)
+    base = jnp.where(el_ad, jnp.maximum(f_base, st["base"]), st["base"])
+    st = {**st, "execute": execute, "next_slot": next_slot, "base": base,
+          "log_bal": _shift(st["log_bal"], adv_el, 0),
+          "log_cmd": _shift(st["log_cmd"], adv_el, NO_CMD),
+          "log_commit": _shift(st["log_commit"], adv_el, False),
+          "proposed": _shift(st["proposed"], adv_el, False),
+          "log_acks": _shift(st["log_acks"], adv_el, 0)}
+    return st, ex
+
+
+def merge_acker_logs(st, amask, p1_win):
+    """Phase-1 win, step 2: merge the ackers' current logs base-aligned
+    — per slot adopt any committed value, else the highest-ballot
+    accepted value, else NOOP-fill below the frontier; own the window
+    under my ballot.  Returns st' (active set for winners)."""
+    R = st["log_bal"].shape[0]
+    sidx = _sidx(st)
+    ridx = _ridx(st)
+    self_bit3 = (jnp.int32(1) << ridx)[:, None, None]
+    base = st["base"]
+    log_bal, log_cmd = st["log_bal"], st["log_cmd"]
+    log_commit, proposed = st["log_commit"], st["proposed"]
+    best_bal = jnp.full_like(log_bal, -1)
+    merged_cmd = jnp.full_like(log_cmd, NO_CMD)
+    merged_commit = jnp.zeros_like(log_commit)
+    committed_cmd = jnp.full_like(log_cmd, NO_CMD)
+    for s in range(R):
+        sel_s = amask[:, s, :]                           # (ldr, G)
+        adv_s = base - base[s][None, :]
+        lb_s = _shift_row(log_bal[s], adv_s, -1)
+        lc_s = _shift_row(log_cmd[s], adv_s, NO_CMD)
+        lm_s = _shift_row(log_commit[s], adv_s, False)
+        lb_s = jnp.where(sel_s[:, None, :], lb_s, -1)
+        lm_s = lm_s & sel_s[:, None, :]
+        upd = lb_s > best_bal
+        best_bal = jnp.where(upd, lb_s, best_bal)
+        merged_cmd = jnp.where(upd, lc_s, merged_cmd)
+        committed_cmd = jnp.where(lm_s & ~merged_commit, lc_s,
+                                  committed_cmd)
+        merged_commit = merged_commit | lm_s
+    abs_ = base[:, None, :] + sidx[None, :, None]
+    has_acc = (best_bal > 0) | merged_commit
+    top = jnp.max(jnp.where(has_acc, abs_ + 1, 0), axis=1)
+    new_next = jnp.maximum(st["next_slot"], top)
+    in_win = abs_ < new_next[:, None, :]
+    w = p1_win[:, None, :]
+    adopt_cmd = jnp.where(merged_commit, committed_cmd,
+                          jnp.where(best_bal > 0, merged_cmd, NOOP))
+    return {**st,
+            "log_cmd": jnp.where(w & in_win, adopt_cmd, log_cmd),
+            "log_bal": jnp.where(w & in_win, st["ballot"][:, None, :],
+                                 log_bal),
+            "log_commit": jnp.where(w & in_win,
+                                    merged_commit | log_commit,
+                                    log_commit),
+            "proposed": jnp.where(w, in_win
+                                  & (merged_commit | log_commit),
+                                  proposed),
+            "log_acks": jnp.where(w, jnp.where(in_win, self_bit3, 0),
+                                  st["log_acks"]),
+            "next_slot": jnp.where(p1_win, new_next, st["next_slot"]),
+            "active": st["active"] | p1_win}
+
+
+def accept_p2a(st, m):
+    """P2a handler: accept from the highest-ballot proposer; ack ONLY
+    what was durably stored in-window.  Returns (st', out_p2b, acc_ok,
+    demote)."""
+    R = st["log_bal"].shape[0]
+    S = st["log_bal"].shape[1]
+    sidx = _sidx(st)
+    ridx = _ridx(st)
+    G = st["ballot"].shape[-1]
+    b_in = jnp.where(m["valid"], m["bal"], -1)
+    a_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)
+    a_bal = jnp.max(b_in, axis=0)
+    a_has = a_bal > 0
+    a_slot = pick_src(m["slot"], a_src)                  # absolute
+    a_cmd = pick_src(m["cmd"], a_src)
+    acc_ok = a_has & (a_bal >= st["ballot"])
+    demote = acc_ok & (a_bal > st["ballot"])
+    ballot = jnp.where(acc_ok, a_bal, st["ballot"])
+    a_rel = a_slot - st["base"]
+    a_inw = (a_rel >= 0) & (a_rel < S)
+    oh = acc_ok[:, None, :] & (sidx[None, :, None] == a_rel[:, None, :])
+    writable = oh & (st["log_bal"] <= a_bal[:, None, :]) \
+        & ~st["log_commit"]
+    out_p2b = {
+        "valid": (acc_ok & a_inw)[:, None, :]
+        & (ridx[None, :, None] == a_src[:, None, :]),
+        "bal": jnp.broadcast_to(a_bal[:, None, :], (R, R, G)),
+        "slot": jnp.broadcast_to(a_slot[:, None, :], (R, R, G)),
+    }
+    st = {**st, "ballot": ballot,
+          "active": st["active"] & ~demote,
+          "p1_acks": jnp.where(demote, 0, st["p1_acks"]),
+          "log_bal": jnp.where(writable, a_bal[:, None, :], st["log_bal"]),
+          "log_cmd": jnp.where(writable, a_cmd[:, None, :], st["log_cmd"])}
+    return st, out_p2b, acc_ok, demote
+
+
+def tally_p2b(st, m, majority, stride):
+    """P2b handler: the leader tallies acks per (slot) bitmask and
+    commits at majority.  Returns (st', newly)."""
+    R = st["log_bal"].shape[0]
+    sidx = _sidx(st)
+    ob = own_bal_mask(st, stride)
+    okb = m["valid"] & (m["bal"] == st["ballot"][None, :, :]) \
+        & (st["active"] & ob)[None, :, :]
+    brel = m["slot"] - st["base"][None, :, :]
+    log_acks = st["log_acks"]
+    for s in range(R):
+        oh_s = okb[s][:, None, :] \
+            & (sidx[None, :, None] == brel[s][:, None, :])
+        log_acks = log_acks | jnp.where(oh_s, jnp.int32(1) << s, 0)
+    acks_n = jax.lax.population_count(log_acks)
+    newly = ((st["active"] & ob)[:, None, :] & (acks_n >= majority)
+             & ~st["log_commit"] & (st["log_cmd"] != NO_CMD)
+             & st["proposed"])
+    return {**st, "log_acks": log_acks,
+            "log_commit": st["log_commit"] | newly}, newly
+
+
+def apply_p3(st, m, extras):
+    """P3 handler: adopt the commit notification, frontier-commit below
+    ``upto`` at the sender's exact ballot, and snapshot-adopt (extras,
+    execute, base) when my frontier fell below the sender's window.
+    Returns (st', extras', c_has, c_bal)."""
+    sidx = _sidx(st)
+    c_src = jnp.argmax(jnp.where(m["valid"], m["bal"], -1), axis=0) \
+        .astype(jnp.int32)
+    c_bal = jnp.max(jnp.where(m["valid"], m["bal"], -1), axis=0)
+    c_has = c_bal > 0
+    c_slot = pick_src(m["slot"], c_src)
+    c_cmd = pick_src(m["cmd"], c_src)
+    c_upto = pick_src(m["upto"], c_src)
+    base = st["base"]
+    abs_ = base[:, None, :] + sidx[None, :, None]
+    c_rel = c_slot - base
+    oh = c_has[:, None, :] & (sidx[None, :, None] == c_rel[:, None, :])
+    log_cmd = jnp.where(oh, c_cmd[:, None, :], st["log_cmd"])
+    log_bal = jnp.where(oh, jnp.maximum(st["log_bal"],
+                                        c_bal[:, None, :]), st["log_bal"])
+    log_commit = st["log_commit"] | oh
+    ohu = (c_has[:, None, :] & (abs_ < c_upto[:, None, :])
+           & (log_bal == c_bal[:, None, :]) & (log_cmd != NO_CMD))
+    log_commit = log_commit | ohu
+
+    # snapshot catch-up for deep laggards
+    src_base = _take_replica(base, c_src)
+    adopt = c_has & (st["execute"] < src_base)
+    adv_a = jnp.where(adopt, src_base - base, 0)
+    my_bal = _shift(log_bal, adv_a, 0)
+    my_cmd = _shift(log_cmd, adv_a, NO_CMD)
+    my_com = _shift(log_commit, adv_a, False)
+    s_bal = _take_replica(log_bal, c_src)
+    s_cmd = _take_replica(log_cmd, c_src)
+    s_com = _take_replica(log_commit, c_src)
+    a2 = adopt[:, None, :]
+    ex = {k: jnp.where(adopt[(slice(None),)
+                             + (None,) * (v.ndim - 2) + (slice(None),)],
+                       _take_replica(v, c_src), v)
+          for k, v in extras.items()}
+    execute = jnp.where(adopt, _take_replica(st["execute"], c_src),
+                        st["execute"])
+    st = {**st,
+          "log_bal": jnp.where(a2, jnp.where(s_com, s_bal, my_bal),
+                               log_bal),
+          "log_cmd": jnp.where(a2, jnp.where(s_com, s_cmd, my_cmd),
+                               log_cmd),
+          "log_commit": jnp.where(a2, s_com | my_com, log_commit),
+          "proposed": jnp.where(a2, False, st["proposed"]),
+          "log_acks": jnp.where(a2, 0, st["log_acks"]),
+          "execute": execute,
+          "next_slot": jnp.where(adopt,
+                                 jnp.maximum(st["next_slot"], execute),
+                                 st["next_slot"]),
+          "base": jnp.where(adopt, src_base, base)}
+    return st, ex, c_has, c_bal
+
+
+def repropose_target(st):
+    """Shared proposal targeting: the first unproposed-uncommitted slot
+    below next_slot (re-proposal), else the next fresh slot (window
+    flow control).  Returns (has_re, can_new, prop_rel, prop_slot,
+    oh_p, re_cmd)."""
+    S = st["log_bal"].shape[1]
+    sidx = _sidx(st)
+    base, next_slot = st["base"], st["next_slot"]
+    abs_ = base[:, None, :] + sidx[None, :, None]
+    mask_re = (~st["log_commit"]) & (~st["proposed"]) \
+        & (abs_ < next_slot[:, None, :])
+    first_re = jnp.argmin(jnp.where(mask_re, sidx[None, :, None], S),
+                          axis=1)
+    has_re = jnp.any(mask_re, axis=1)
+    can_new = (next_slot - base) < S
+    rel_next = jnp.clip(next_slot - base, 0, S - 1)
+    prop_rel = jnp.where(has_re, first_re, rel_next).astype(jnp.int32)
+    oh_p = sidx[None, :, None] == prop_rel[:, None, :]
+    re_cmd = jnp.sum(jnp.where(oh_p, st["log_cmd"], 0), axis=1)
+    re_cmd = jnp.where(re_cmd == NO_CMD, NOOP, re_cmd)
+    return has_re, can_new, prop_rel, base + prop_rel, oh_p, re_cmd
+
+
+def propose_write(st, do, is_new, prop_cmd, prop_slot, oh_p):
+    """Apply a proposal to the leader's own log and emit P2a.
+    Returns (st', out_p2a)."""
+    R = st["log_bal"].shape[0]
+    ridx = _ridx(st)
+    G = st["ballot"].shape[-1]
+    self_bit3 = (jnp.int32(1) << ridx)[:, None, None]
+    oh = do[:, None, :] & oh_p
+    out_p2a = {
+        "valid": jnp.broadcast_to(do[:, None, :], (R, R, G)),
+        "bal": jnp.broadcast_to(st["ballot"][:, None, :], (R, R, G)),
+        "slot": jnp.broadcast_to(prop_slot[:, None, :], (R, R, G)),
+        "cmd": jnp.broadcast_to(prop_cmd[:, None, :], (R, R, G)),
+    }
+    return {**st,
+            "log_bal": jnp.where(oh, st["ballot"][:, None, :],
+                                 st["log_bal"]),
+            "log_cmd": jnp.where(oh & ~st["log_commit"],
+                                 prop_cmd[:, None, :], st["log_cmd"]),
+            "proposed": st["proposed"] | oh,
+            "log_acks": st["log_acks"]
+            | jnp.where(oh, self_bit3, 0),
+            "next_slot": st["next_slot"] + (is_new & do)}, out_p2a
+
+
+def p3_out(st, newly, new_execute, is_leader, t):
+    """Emit P3: the lowest newly committed slot, else round-robin
+    retransmit through the committed prefix (laggards behind the window
+    heal via snapshot adoption)."""
+    R = st["log_bal"].shape[0]
+    S = st["log_bal"].shape[1]
+    sidx = _sidx(st)
+    G = st["ballot"].shape[-1]
+    low_new = jnp.argmin(jnp.where(newly, sidx[None, :, None], S), axis=1)
+    any_new = jnp.any(newly, axis=1)
+    span = jnp.maximum(new_execute - st["base"], 1)
+    rr = t % span
+    p3_rel = jnp.where(any_new, low_new, rr).astype(jnp.int32)
+    p3_rel = jnp.clip(p3_rel, 0, S - 1)
+    oh_3 = sidx[None, :, None] == p3_rel[:, None, :]
+    p3_committed = jnp.any(oh_3 & st["log_commit"], axis=1)
+    p3_cmd = jnp.sum(jnp.where(oh_3, st["log_cmd"], 0), axis=1)
+    p3_do = is_leader & p3_committed
+    return {
+        "valid": jnp.broadcast_to(p3_do[:, None, :], (R, R, G)),
+        "bal": jnp.broadcast_to(st["ballot"][:, None, :], (R, R, G)),
+        "slot": jnp.broadcast_to((st["base"] + p3_rel)[:, None, :],
+                                 (R, R, G)),
+        "cmd": jnp.broadcast_to(p3_cmd[:, None, :], (R, R, G)),
+        "upto": jnp.broadcast_to(new_execute[:, None, :], (R, R, G)),
+    }
+
+
+def retry_stuck(st, new_execute, is_leader, retry_timeout):
+    """Stuck-frontier retry, go-back-N: a dropped P2a/P2b leaves its
+    slot unproposable forever (P2a is sent once); on a stall re-open
+    EVERY uncommitted in-flight slot so the proposer re-proposes one
+    per step — a deep uncommitted backlog under sustained drops drains
+    in O(N) steps, not O(N * retry_timeout)."""
+    sidx = _sidx(st)
+    abs_ = st["base"][:, None, :] + sidx[None, :, None]
+    stalled = is_leader & (new_execute == st["execute"]) \
+        & (st["next_slot"] > new_execute)
+    stuck = jnp.where(stalled, st["stuck"] + 1, 0)
+    retry = stuck >= retry_timeout
+    ohr = (retry[:, None, :] & ~st["log_commit"]
+           & (abs_ >= new_execute[:, None, :])
+           & (abs_ < st["next_slot"][:, None, :]))
+    return {**st, "proposed": st["proposed"] & ~ohr,
+            "stuck": jnp.where(retry, 0, stuck)}
+
+
+def election_tick(st, heard, rng, cfg):
+    """Election timer with jittered backoff: fire a fresh higher ballot
+    (P1a) when nothing leader-ish has been heard.  Returns (st',
+    out_p1a)."""
+    R = st["log_bal"].shape[0]
+    ridx = _ridx(st)
+    G = st["ballot"].shape[-1]
+    self_bit2 = (jnp.int32(1) << ridx)[:, None]
+    k_jit = jr.fold_in(rng, 17)
+    jitter = jr.randint(k_jit, st["ballot"].shape, 0, cfg.backoff + 1)
+    timer = jnp.where(heard | st["active"],
+                      cfg.election_timeout + jitter,
+                      st["timer"] - 1)
+    fire = ~st["active"] & (timer <= 0)
+    new_bal = (jnp.max(st["ballot"], axis=0)[None, :]
+               // cfg.ballot_stride + 1) * cfg.ballot_stride \
+        + ridx[:, None]
+    ballot = jnp.where(fire, new_bal, st["ballot"])
+    out_p1a = {
+        "valid": jnp.broadcast_to(fire[:, None, :], (R, R, G)),
+        "bal": jnp.broadcast_to(ballot[:, None, :], (R, R, G)),
+    }
+    return {**st, "ballot": ballot,
+            "p1_acks": jnp.where(fire, self_bit2, st["p1_acks"]),
+            "timer": jnp.where(fire, cfg.election_timeout + jitter,
+                               timer)}, out_p1a
+
+
+def slide_window(st, new_execute, retain):
+    """Slide the ring past the executed prefix, retaining ``retain``
+    executed slots for P3 retransmits (slot recycling)."""
+    new_base = jnp.maximum(st["base"], new_execute - retain)
+    adv = new_base - st["base"]
+    return {**st, "base": new_base, "execute": new_execute,
+            "log_bal": _shift(st["log_bal"], adv, 0),
+            "log_cmd": _shift(st["log_cmd"], adv, NO_CMD),
+            "log_commit": _shift(st["log_commit"], adv, False),
+            "proposed": _shift(st["proposed"], adv, False),
+            "log_acks": _shift(st["log_acks"], adv, 0)}

@@ -138,6 +138,10 @@ def test_olog_gc_bounded_by_watermark():
                 assert c[i].gc_base > 0, (i, c[i].gc_base, c[i].execute)
                 assert len(c[i].olog) < 60, (i, len(c[i].olog))
                 assert min(c[i].olog) >= c[i].gc_base
+                # command bodies below the watermark are pruned too
+                # (they dominate memory), as are bystander queues
+                assert len(c[i].cstore) < 60, (i, len(c[i].cstore))
+                assert len(c[i].queue) < 10, (i, len(c[i].queue))
         finally:
             await c.stop()
     run(main())
