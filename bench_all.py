@@ -1,8 +1,9 @@
 """Per-protocol benchmark sweep — BASELINE.md configs 1-5 + extras.
 
 Prints ONE JSON line PER config (paxos anchor, epaxos conflict-heavy,
-wpaxos 3x3 locality grid, abd, chain, fuzzed paxos, sdpaxos tokens) and
-writes the collected list to BENCH_PROTOCOLS.json next to this file.
+wpaxos 3x3 locality grid, abd, chain, fuzzed paxos, sdpaxos tokens,
+wankeeper zones) and writes the collected list to BENCH_PROTOCOLS.json
+next to this file.
 
 Runs on CPU by default (deterministic completion even when the
 accelerator tunnel is wedged — set BENCH_ALL_DEVICE=native to use the
@@ -75,6 +76,11 @@ def _cfgs():
         ("sdpaxos_tokens", "sdpaxos",
          SimConfig(n_replicas=5, n_slots=32, n_keys=16), FAULT_FREE,
          256 * s, 80, "committed_slots", "slots/s"),
+        # 7. wankeeper: hierarchical tokens, locality-skewed zones
+        ("wankeeper_zones", "wankeeper",
+         SimConfig(n_replicas=6, n_zones=2, n_objects=4, n_slots=16,
+                   locality=0.8), FAULT_FREE,
+         256 * s, 80, "committed_slots", "writes/s"),
     ]
 
 

@@ -23,6 +23,7 @@ _SIM_MODULES = {
     "kpaxos": "paxi_tpu.protocols.kpaxos.sim",
     "dynamo": "paxi_tpu.protocols.dynamo.sim",
     "sdpaxos": "paxi_tpu.protocols.sdpaxos.sim",
+    "wankeeper": "paxi_tpu.protocols.wankeeper.sim",
 }
 
 _HOST_MODULES = {
@@ -34,6 +35,7 @@ _HOST_MODULES = {
     "kpaxos": "paxi_tpu.protocols.kpaxos.host",
     "dynamo": "paxi_tpu.protocols.dynamo.host",
     "sdpaxos": "paxi_tpu.protocols.sdpaxos.host",
+    "wankeeper": "paxi_tpu.protocols.wankeeper.host",
 }
 
 

@@ -1,0 +1,524 @@
+"""WanKeeper (hierarchical token coordination) as a pure TPU kernel.
+
+Reference: the paxi lineage's wankeeper/ package (SURVEY §2.2 "others")
+— hierarchical leases for WAN coordination: a replicated **root** layer
+grants per-object **tokens** to zones; operations on an object execute
+in the zone currently holding its token (local-latency commits for
+zone-local workloads, like WPaxos's stealing but arbitrated centrally);
+token movements are serialized by the root, and object state travels
+with the token at handoff.
+
+TPU re-design (lane-major layout; not a translation):
+- **Root = the shared ballot_ring core** (sim/ballot_ring.py, the same
+  machinery behind the paxos and sdpaxos kernels): the root log is a
+  Multi-Paxos log over token-transfer commands, its leader elected and
+  recovered with ballots, replicated across ALL replicas (WanKeeper's
+  root is itself a Paxos group spanning zones).  Applying the
+  committed root prefix IS the token table — exclusivity is a pure
+  function of the agreed log, so root-log agreement (the ballot_ring
+  oracle) is token-exclusivity agreement.
+- **Two-entry transfers with version handoff.**  A transfer is
+  ``revoke(o)`` then ``grant(o, z, v)``: applying revoke puts the
+  token in transit (nobody writes) and records the releasing zone; the
+  releasing zone's leader then reports its final zone-committed
+  version (``rel``, every step until the grant lands — idempotent),
+  and the root proposes the grant only after that report, so the
+  receiving zone resumes exactly where the releasing zone committed —
+  the object-state-moves-with-the-token rule, with only a version
+  number travelling (object values are deterministic functions of
+  (object, version), as everywhere in this suite).  Root-local
+  bookkeeping (want/relv/pend) is soft state: after a root failover it
+  is rebuilt by retried ``treq``/``rel`` traffic, and a duplicate
+  revoke against an in-transit token is a no-op.
+- **Zone-level replication is frontier-shaped** (like sdpaxos's
+  C-plane): the holding zone's leader bumps its demanded object's
+  version once per step (gated on the previous version being
+  zone-committed), replicates (obj, ver) to zone members (``zrep``),
+  members apply strictly in order and echo acks (``zack``); the
+  zone-committed version is the zone-majority order statistic over
+  members' acked versions.  Zone leaders are static (lowest replica id
+  per zone) — intra-zone leader failover is the deployment runtime's
+  concern; the sim models zone and root faults via the fuzz schedule.
+- Workload: each zone leader demands a hashed object per step,
+  locality-skewed (``cfg.locality`` = P(home-zone object), home =
+  ``o % Z``) — non-home demands drive token requests (``treq``) and
+  therefore root traffic, exactly the knob the reference's WAN
+  evaluation turns.
+- Version fields carry 16 bits inside root commands (≈65k writes per
+  object per run) — ample for simulation horizons; the encoding is a
+  single positive int32.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from paxi_tpu.sim import ballot_ring as br
+from paxi_tpu.sim.ballot_ring import NO_CMD
+from paxi_tpu.sim.ring import dst_major
+from paxi_tpu.sim.ring import require_packable
+from paxi_tpu.sim.ring import shift_window as _shift
+from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+
+BR_KEYS = br.KEYS
+
+# root command encoding: kind(1) | obj(7) | zone(6) | ver(16), positive
+K_REVOKE = 0
+K_GRANT = 1
+
+
+def enc_revoke(obj):
+    return (K_REVOKE << 29) | (obj << 22)
+
+
+def enc_grant(obj, zone, ver):
+    return (K_GRANT << 29) | (obj << 22) | (zone << 16) | ver
+
+
+def dec_kind(cmd):
+    return (cmd >> 29) & 1
+
+
+def dec_obj(cmd):
+    return (cmd >> 22) & 0x7F
+
+
+def dec_zone(cmd):
+    return (cmd >> 16) & 0x3F
+
+
+def dec_ver(cmd):
+    return cmd & 0xFFFF
+
+
+def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
+    return {
+        # zone plane: in-order object writes + cumulative acks
+        "zrep": ("obj", "ver"),
+        "zack": ("obj", "ver"),
+        # root plane: token requests and release reports; ``gen`` is
+        # the root-log slot of the revoke being answered — the agreed
+        # log gives every replica the same generation tag for free, and
+        # it fences off stale reports from earlier transfers of the
+        # same object
+        "treq": ("obj",),
+        "rel": ("obj", "ver", "gen"),
+        # the root log (shared Multi-Paxos core)
+        "p1a": ("bal",),
+        "p1b": ("bal",),
+        "p2a": ("bal", "slot", "cmd"),
+        "p2b": ("bal", "slot"),
+        "p3": ("bal", "slot", "cmd", "upto"),
+    }
+
+
+def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
+    R, S, O, G = (cfg.n_replicas, cfg.n_slots, cfg.n_objects, n_groups)
+    Z = cfg.n_zones
+    assert R % Z == 0, "wankeeper: n_replicas must be divisible by n_zones"
+    # root command encoding widths (enc_revoke/enc_grant): overflowing
+    # them would silently corrupt the root log, so fail fast
+    assert O <= 128, "wankeeper: n_objects > 128 overflows the 7-bit field"
+    assert Z <= 64, "wankeeper: n_zones > 64 overflows the 6-bit field"
+    del rng
+    require_packable(R)
+    i32 = jnp.int32
+    oidx = jnp.arange(O, dtype=i32)
+    return dict(
+        # ---- token table + zone replication (derived from root log) ----
+        token_zone=jnp.broadcast_to((oidx % Z)[None, :, None],
+                                    (R, O, G)).astype(i32),
+        prev_zone=jnp.broadcast_to((oidx % Z)[None, :, None],
+                                   (R, O, G)).astype(i32),
+        ver=jnp.zeros((R, O, G), i32),       # my applied object versions
+        aver=jnp.zeros((R, R, O, G), i32),   # [ldr, member] acked vers
+        want=jnp.full((R, O, G), -1, i32),   # [root ldr] requesting zone
+        relv=jnp.full((R, O, G), -1, i32),   # reported rel ver (gen-gated)
+        pend=jnp.zeros((R, O, G), bool),     # [root ldr] revoke proposed
+        pgen=jnp.full((R, O, G), -1, i32),   # executed-revoke generation
+        rgen=jnp.full((R, O, G), -1, i32),   # my zone's release generation
+        gver=jnp.zeros((R, O, G), i32),      # oracle: last granted ver
+        viol_acc=jnp.zeros((G,), i32),       # oracle: grant regressions
+        writes=jnp.zeros((R, G), i32),       # leader write count
+        transfers=jnp.zeros((R, G), i32),
+        # ---- root log (shared ballot_ring planes) ----
+        ballot=jnp.zeros((R, G), i32),
+        active=jnp.zeros((R, G), bool),
+        p1_acks=jnp.zeros((R, G), i32),
+        base=jnp.zeros((R, G), i32),
+        log_bal=jnp.zeros((R, S, G), i32),
+        log_cmd=jnp.full((R, S, G), NO_CMD, i32),
+        log_commit=jnp.zeros((R, S, G), bool),
+        log_acks=jnp.zeros((R, S, G), i32),
+        proposed=jnp.zeros((R, S, G), bool),
+        next_slot=jnp.zeros((R, G), i32),
+        execute=jnp.zeros((R, G), i32),
+        timer=jnp.broadcast_to(
+            (jnp.arange(R, dtype=i32) * cfg.election_timeout)[:, None],
+            (R, G)),
+        stuck=jnp.zeros((R, G), i32),
+    )
+
+
+def step(state, inbox, ctx: StepCtx):
+    cfg = ctx.cfg
+    R, S, O = cfg.n_replicas, cfg.n_slots, cfg.n_objects
+    Z = cfg.n_zones
+    ZR = R // Z
+    ZMAJ = ZR // 2 + 1
+    MAJ, STRIDE = cfg.majority, cfg.ballot_stride
+    RETAIN = max(S // 2, 1)
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    oidx = jnp.arange(O, dtype=jnp.int32)
+    my_zone = ridx // ZR                                 # (R,)
+    is_zldr = (ridx % ZR) == 0
+    T = dst_major
+
+    st = {k: state[k] for k in BR_KEYS}
+    token_zone = state["token_zone"]
+    prev_zone = state["prev_zone"]
+    ver = state["ver"]
+    aver = state["aver"]
+    want = state["want"]
+    relv = state["relv"]
+    pend = state["pend"]
+    pgen = state["pgen"]
+    rgen = state["rgen"]
+    gver = state["gver"]
+    writes = state["writes"]
+    transfers = state["transfers"]
+    G = writes.shape[-1]
+
+    same_zone = my_zone[:, None] == my_zone[None, :]     # (me, src)
+
+    # ============ zone plane: apply leader writes, cumulative acks ======
+    # members apply their zone leader's (obj, ver) strictly in order
+    m = inbox["zrep"]
+    zv = T(m["valid"]) & same_zone[:, :, None]           # (me, ldr, G)
+    zo = jnp.clip(T(m["obj"]), 0, O - 1)
+    zn = T(m["ver"])
+    hit = (zv[:, :, None, :]
+           & (zo[:, :, None, :] == oidx[None, None, :, None])
+           & (zn[:, :, None, :] == ver[:, None, :, :] + 1))
+    ver = ver + jnp.any(hit, axis=1)
+    # remember what my leader just replicated (acked below)
+    got_rep = jnp.any(zv, axis=1)                        # (me, G)
+    rcv_obj = jnp.max(jnp.where(zv, zo, 0), axis=1)      # (me, G)
+
+    # leaders collect acks per object (max over time = cumulative)
+    m = inbox["zack"]
+    av = T(m["valid"]) & same_zone[:, :, None] & is_zldr[:, None, None]
+    ao = jnp.clip(T(m["obj"]), 0, O - 1)
+    an = T(m["ver"])
+    ahit = av[:, :, None, :] & (ao[:, :, None, :]
+                                == oidx[None, None, :, None])
+    aver = jnp.maximum(aver, jnp.where(ahit, an[:, :, None, :], 0))
+    # my own store is always current
+    self_d = (ridx[:, None, None] == ridx[None, :, None])[..., None]
+    aver = jnp.where(self_d, ver[:, None], aver)
+    # zone-committed version: ZMAJ-th largest over my zone's members
+    zsel = same_zone[:, :, None, None]
+    avz = jnp.where(zsel, aver, -1)
+    committed_v = jnp.maximum(
+        jnp.sort(avz, axis=1)[:, R - ZMAJ], 0)           # (ldr, O, G)
+
+    # ============ root log: shared Multi-Paxos core =====================
+    st, out_p1b, promote = br.promise_p1a(st, inbox["p1a"])
+    st, p1_win, amask = br.tally_p1b(st, inbox["p1b"], MAJ, STRIDE)
+    # token_zone/prev_zone are derived from the applied root prefix and
+    # travel with (execute) by REPLACEMENT; ver/gver are zone-local
+    # monotone counters, so state transfer MAX-MERGES them (another
+    # replica's view of my zone's objects may be stale — replacing
+    # would regress them)
+    extras = {"token_zone": token_zone, "prev_zone": prev_zone,
+              "ver": ver, "want": want, "relv": relv, "pend": pend,
+              "pgen": pgen, "rgen": rgen, "gver": gver}
+    st, ex = br.adopt_best_acker(st, amask, p1_win, extras)
+    token_zone, prev_zone, want, relv, pend, pgen, rgen = (
+        ex["token_zone"], ex["prev_zone"], ex["want"], ex["relv"],
+        ex["pend"], ex["pgen"], ex["rgen"])
+    ver = jnp.maximum(ver, ex["ver"])
+    gver = jnp.maximum(gver, ex["gver"])
+    st = br.merge_acker_logs(st, amask, p1_win)
+    # a fresh root starts with a clean proposal-dedup slate: a stale
+    # adopted pend (for a revoke the merge lost) would block the object
+    # forever, while a duplicate revoke is an idempotent no-op
+    pend = jnp.where(p1_win[:, None, :], False, pend)
+    st, out_p2b, acc_ok, _ = br.accept_p2a(st, inbox["p2a"])
+    st, newly = br.tally_p2b(st, inbox["p2b"], MAJ, STRIDE)
+    extras = {"token_zone": token_zone, "prev_zone": prev_zone,
+              "ver": ver, "want": want, "relv": relv, "pend": pend,
+              "pgen": pgen, "rgen": rgen, "gver": gver}
+    st, ex, c_has, c_bal = br.apply_p3(st, inbox["p3"], extras)
+    token_zone, prev_zone, want, relv, pend, pgen, rgen = (
+        ex["token_zone"], ex["prev_zone"], ex["want"], ex["relv"],
+        ex["pend"], ex["pgen"], ex["rgen"])
+    ver = jnp.maximum(ver, ex["ver"])
+    gver = jnp.maximum(gver, ex["gver"])
+
+    is_root = st["active"] & br.own_bal_mask(st, STRIDE)
+
+    # ---------------- root intake: token requests + release reports -----
+    m = inbox["treq"]
+    tv = T(m["valid"])                                   # (root, src, G)
+    to = jnp.clip(T(m["obj"]), 0, O - 1)
+    for s in range(R):
+        oh = tv[:, s, None, :] & (to[:, s, None, :] == oidx[None, :, None])
+        want = jnp.where(oh, my_zone[s], want)
+    m = inbox["rel"]
+    rv = T(m["valid"])                                   # (root, src, G)
+    ro = jnp.clip(T(m["obj"]), 0, O - 1)
+    rn = T(m["ver"])
+    rg = T(m["gen"])
+    for s in range(R):
+        oh = (rv[:, s, None, :]
+              & (ro[:, s, None, :] == oidx[None, :, None])
+              & (rg[:, s, None, :] == pgen) & (pgen >= 0))
+        relv = jnp.where(oh, jnp.maximum(relv, rn[:, s, None, :]), relv)
+
+    # ---------------- root proposes: revoke, then grant -----------------
+    has_re, can_new, prop_rel, prop_slot, oh_p, re_cmd = \
+        br.repropose_target(st)
+    # grant only for the EXECUTED revoke generation with an accepted,
+    # gen-matching release report (pgen/relv are log-derived and
+    # broadcast-replicated: failover-safe)
+    g_ready = (pgen >= 0) & (relv >= 0) & (want >= 0)
+    r_need = (~pend) & (pgen < 0) & (want >= 0) \
+        & (want != token_zone) & (token_zone >= 0)
+    pick_g = jnp.argmax(g_ready, axis=1).astype(jnp.int32)   # (root, G)
+    any_g = jnp.any(g_ready, axis=1)
+    pick_r = jnp.argmax(r_need, axis=1).astype(jnp.int32)
+    any_r = jnp.any(r_need, axis=1)
+    pick_o = jnp.where(any_g, pick_g, pick_r)
+    sel = oidx[None, :, None] == pick_o[:, None, :]      # (root, O, G)
+    sel_want = jnp.sum(jnp.where(sel, want, 0), axis=1)
+    sel_relv = jnp.sum(jnp.where(sel, relv, 0), axis=1)
+    new_cmd = jnp.where(
+        any_g, enc_grant(pick_o, jnp.clip(sel_want, 0, Z - 1),
+                         jnp.clip(sel_relv, 0, 0xFFFF)),
+        enc_revoke(pick_o))
+    is_new = ~has_re & can_new & (any_g | any_r)
+    prop_cmd = jnp.where(is_new, new_cmd, re_cmd)
+    do = is_root & (has_re | is_new)
+    st, out_p2a = br.propose_write(st, do, is_new, prop_cmd, prop_slot,
+                                   oh_p)
+    # soft bookkeeping for the entry just proposed (revoke-dedup and
+    # want-consumption; the handshake itself clears at EXECUTION)
+    bump = (is_new & do)[:, None, :] & sel
+    pend = jnp.where(bump, ~any_g[:, None, :], pend)
+    want = jnp.where(bump & any_g[:, None, :], -1, want)
+
+    # ---------------- execute the committed root prefix -----------------
+    execute = st["execute"]
+    advanced = jnp.zeros_like(execute)
+    running = jnp.ones_like(st["active"])
+    viol_gv = jnp.zeros((G,), jnp.int32)
+    for e in range(cfg.exec_window):
+        rel_pos = execute + e - st["base"]
+        oh_e = sidx[None, :, None] == rel_pos[:, None, :]
+        com = jnp.any(oh_e & st["log_commit"], axis=1)
+        running = running & com
+        cmd_e = jnp.sum(jnp.where(oh_e, st["log_cmd"], 0), axis=1)
+        wr = running & (cmd_e >= 0)
+        kind = dec_kind(cmd_e)
+        obj = jnp.clip(dec_obj(cmd_e), 0, O - 1)
+        zon = dec_zone(cmd_e)
+        v = dec_ver(cmd_e)
+        ohh = wr[:, None, :] & (oidx[None, :, None] == obj[:, None, :])
+        slot_e = execute + e                             # (R, G) absolute
+        # revoke: token in transit; remember the releasing zone and the
+        # generation (= this revoke's agreed slot number)
+        rv_ = ohh & (kind == K_REVOKE)[:, None, :]
+        prev_zone = jnp.where(rv_ & (token_zone >= 0), token_zone,
+                              prev_zone)
+        rgen = jnp.where(rv_ & (token_zone >= 0), slot_e[:, None, :],
+                         rgen)
+        pgen = jnp.where(rv_ & (token_zone >= 0), slot_e[:, None, :],
+                         pgen)
+        token_zone = jnp.where(rv_, -1, token_zone)
+        # grant: new holder zone; its members adopt the handoff version;
+        # the handshake registers clear deterministically with the log
+        gr = ohh & (kind == K_GRANT)[:, None, :]
+        token_zone = jnp.where(gr, zon[:, None, :], token_zone)
+        pgen = jnp.where(gr, -1, pgen)
+        relv = jnp.where(gr, -1, relv)
+        in_new = gr & (my_zone[:, None, None] == zon[:, None, :])
+        ver = jnp.where(in_new, jnp.maximum(ver, v[:, None, :]), ver)
+        # oracle: granted versions are monotone per object (a grant
+        # below a previous grant would fork object history)
+        viol_gv = viol_gv + jnp.sum(gr & (v[:, None, :] < gver),
+                                    axis=(0, 1))
+        gver = jnp.where(gr, jnp.maximum(gver, v[:, None, :]), gver)
+        transfers = transfers + (wr & (kind == K_GRANT))
+        advanced = advanced + running
+    new_execute = execute + advanced
+    viol_acc = state["viol_acc"] + viol_gv
+
+    # ============ zone leaders: demand, write, request ==================
+    # locality-skewed demand (same generator shape as the wpaxos kernel)
+    k1 = jr.fold_in(ctx.rng, 23)
+    k2 = jr.fold_in(ctx.rng, 29)
+    u = jr.uniform(k1, (R, G))
+    n_home = max(O // Z, 1)
+    pick_local = (jr.randint(k2, (R, G), 0, n_home) * Z
+                  + my_zone[:, None]) % O
+    pick_any = jr.randint(k2, (R, G), 0, O)
+    demand = jnp.clip(jnp.where(u < cfg.locality, pick_local, pick_any),
+                      0, O - 1).astype(jnp.int32)
+
+    dsel = oidx[None, :, None] == demand[:, None, :]     # (R, O, G)
+    d_holder = jnp.sum(jnp.where(dsel, token_zone, 0), axis=1)
+    held = d_holder == my_zone[:, None]
+    # write: bump my demanded object's version, gated on the previous
+    # version being zone-committed (pipeline never outruns acks by > 1)
+    d_ver = jnp.sum(jnp.where(dsel, ver, 0), axis=1)
+    d_cv = jnp.sum(jnp.where(dsel, committed_v, 0), axis=1)
+    w_do = is_zldr[:, None] & held & (d_ver - d_cv < 2)
+    ver = ver + (w_do[:, None, :] & dsel)
+    writes = writes + w_do
+
+    # zrep out: per-destination go-back-N (like sdpaxos's C-plane) —
+    # send each zone member the NEXT version it has not acked of my
+    # demanded object, not my latest: a member that dropped v would
+    # otherwise never match the in-order apply rule again and the
+    # object's write pipeline would wedge for the rest of the run
+    z_ver = jnp.sum(jnp.where(dsel, ver, 0), axis=1)     # (ldr, G) mine
+    av_d = jnp.sum(jnp.where(dsel[:, None, :, :], aver, 0), axis=2)
+    send_ver = jnp.minimum(av_d + 1, z_ver[:, None, :])  # (ldr, dst, G)
+    zmask_out = is_zldr[:, None, None] & same_zone[:, :, None]
+    out_zrep = {
+        "valid": jnp.broadcast_to(zmask_out, (R, R, G))
+        & (av_d < z_ver[:, None, :]),
+        "obj": jnp.broadcast_to(demand[:, None, :], (R, R, G)),
+        "ver": send_ver,
+    }
+    # zack out: echo what my leader just replicated; otherwise rotate
+    # through objects so every object's acks keep refreshing
+    ack_obj = jnp.where(got_rep, rcv_obj,
+                        (ctx.t + ridx[:, None]) % O).astype(jnp.int32)
+    ack_sel = oidx[None, :, None] == ack_obj[:, None, :]
+    ack_ver = jnp.sum(jnp.where(ack_sel, ver, 0), axis=1)
+    zldr_of_mine = (my_zone * ZR)[:, None]               # (R, 1)
+    out_zack = {
+        "valid": jnp.broadcast_to(
+            (ridx[None, :] == zldr_of_mine)[:, :, None], (R, R, G)),
+        "obj": jnp.broadcast_to(ack_obj[:, None, :], (R, R, G)),
+        "ver": jnp.broadcast_to(ack_ver[:, None, :], (R, R, G)),
+    }
+
+    # treq out: a zone leader demanding a non-held object asks the root
+    t_do = is_zldr[:, None] & ~held & (d_holder != my_zone[:, None])
+    out_treq = {
+        "valid": jnp.broadcast_to(t_do[:, None, :], (R, R, G)),
+        "obj": jnp.broadcast_to(demand[:, None, :], (R, R, G)),
+    }
+    # rel out: the RELEASING zone's leader reports its final committed
+    # version for any in-transit object it held, every step until the
+    # grant lands (idempotent: the root takes the max).  The report is
+    # floored at the version the token was GRANTED to this zone at
+    # (gver): right after a grant the zone's ack statistic may lag
+    # below the handoff version, and reporting below it would fork
+    # object history at the next transfer.
+    in_transit_mine = (token_zone == -1) \
+        & (prev_zone == my_zone[:, None, None]) & is_zldr[:, None, None]
+    rel_obj = jnp.argmax(in_transit_mine, axis=1).astype(jnp.int32)
+    any_rel = jnp.any(in_transit_mine, axis=1)           # (R, G)
+    rsel = oidx[None, :, None] == rel_obj[:, None, :]
+    rel_ver = jnp.maximum(
+        jnp.sum(jnp.where(rsel, committed_v, 0), axis=1),
+        jnp.sum(jnp.where(rsel, gver, 0), axis=1))
+    rel_gen = jnp.sum(jnp.where(rsel, rgen, 0), axis=1)
+    out_rel = {
+        "valid": jnp.broadcast_to(any_rel[:, None, :], (R, R, G)),
+        "obj": jnp.broadcast_to(rel_obj[:, None, :], (R, R, G)),
+        "ver": jnp.broadcast_to(rel_ver[:, None, :], (R, R, G)),
+        "gen": jnp.broadcast_to(rel_gen[:, None, :], (R, R, G)),
+    }
+
+    # self-delivery: the dense exchange has no loopback edge, and the
+    # root replica can itself be a requesting/releasing zone leader —
+    # fold my own treq/rel into my registries (lands next step, same as
+    # a delivered message)
+    self_treq = t_do[:, None, :] & dsel                  # (R, O, G)
+    want = jnp.where(self_treq, my_zone[:, None, None], want)
+    self_rel = any_rel[:, None, :] & rsel & (rgen == pgen) & (pgen >= 0)
+    relv = jnp.where(self_rel,
+                     jnp.maximum(relv, rel_ver[:, None, :]), relv)
+
+    # ---------------- wrap-up: P3 out, retry, election, slide -----------
+    out_p3 = br.p3_out(st, newly, new_execute, is_root, ctx.t)
+    st = br.retry_stuck(st, new_execute, is_root, cfg.retry_timeout)
+    heard = promote | acc_ok | (c_has & (c_bal >= st["ballot"]))
+    st, out_p1a = br.election_tick(st, heard, ctx.rng, cfg)
+    st = br.slide_window(st, new_execute, RETAIN)
+
+    new_state = dict(
+        st, token_zone=token_zone, prev_zone=prev_zone, ver=ver,
+        aver=aver, want=want, relv=relv, pend=pend, pgen=pgen,
+        rgen=rgen, gver=gver, viol_acc=viol_acc, writes=writes,
+        transfers=transfers)
+    outbox = {"zrep": out_zrep, "zack": out_zack, "treq": out_treq,
+              "rel": out_rel, "p1a": out_p1a, "p1b": out_p1b,
+              "p2a": out_p2a, "p2b": out_p2b, "p3": out_p3}
+    return new_state, outbox
+
+
+def metrics(state, cfg: SimConfig):
+    return {
+        "committed_slots": jnp.sum(state["writes"]),
+        "transfers": jnp.sum(jnp.max(state["transfers"], axis=0)),
+        "root_execute": jnp.sum(jnp.max(state["execute"], axis=0)),
+        "has_root": jnp.sum(jnp.any(state["active"], axis=0)
+                            .astype(jnp.int32)),
+    }
+
+
+def invariants(old, new, cfg: SimConfig) -> jax.Array:
+    """Root-log oracle (agreement / stability / ballot / exec-committed
+    — token exclusivity is a pure function of the agreed log) + object
+    version monotonicity + grant monotonicity (in-kernel counter)."""
+    BIG = jnp.int32(2**30)
+    S = cfg.n_slots
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    base, c, cmd = new["base"], new["log_commit"], new["log_cmd"]
+
+    align = jnp.max(base, axis=0)[None, :] - base
+    a_c = _shift(c, align, False)
+    a_cmd = _shift(cmd, align, NO_CMD)
+    mx = jnp.max(jnp.where(a_c, a_cmd, -BIG), axis=0)
+    mn = jnp.min(jnp.where(a_c, a_cmd, BIG), axis=0)
+    n_c = jnp.sum(a_c, axis=0)
+    v_agree = jnp.sum((n_c >= 1) & (mx != mn))
+
+    adv = base - old["base"]
+    o_c = _shift(old["log_commit"], adv, False)
+    o_cmd = _shift(old["log_cmd"], adv, NO_CMD)
+    v_stable = jnp.sum(o_c & (~c | (cmd != o_cmd)))
+    v_stable = v_stable + jnp.sum(new["execute"] < base)
+
+    v_bal = jnp.sum(new["ballot"] < old["ballot"])
+
+    abs_ = base[:, None, :] + sidx[None, :, None]
+    v_exec = jnp.sum((abs_ < new["execute"][:, None, :]) & ~c)
+
+    v_ver = jnp.sum(new["ver"] < old["ver"])
+    v_grant = jnp.sum(new["viol_acc"] - old["viol_acc"])
+
+    return (v_agree + v_stable + v_bal + v_exec
+            + v_ver + v_grant).astype(jnp.int32)
+
+
+PROTOCOL = SimProtocol(
+    name="wankeeper",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=step,
+    metrics=metrics,
+    invariants=invariants,
+    batched=True,
+)
