@@ -81,6 +81,11 @@ def _cfgs():
          SimConfig(n_replicas=6, n_zones=2, n_objects=4, n_slots=16,
                    locality=0.8), FAULT_FREE,
          256 * s, 80, "committed_slots", "writes/s"),
+        # 8. blockchain: longest-chain contrast case (fork churn under
+        #    the fuzz schedule; committed = max height = chain growth)
+        ("blockchain_forks", "blockchain",
+         SimConfig(n_replicas=5, n_slots=32, steal_threshold=4), FUZZ,
+         256 * s, 200, "committed_slots", "blocks/s"),
     ]
 
 

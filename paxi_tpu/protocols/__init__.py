@@ -24,6 +24,7 @@ _SIM_MODULES = {
     "dynamo": "paxi_tpu.protocols.dynamo.sim",
     "sdpaxos": "paxi_tpu.protocols.sdpaxos.sim",
     "wankeeper": "paxi_tpu.protocols.wankeeper.sim",
+    "blockchain": "paxi_tpu.protocols.blockchain.sim",
 }
 
 _HOST_MODULES = {
@@ -36,6 +37,7 @@ _HOST_MODULES = {
     "dynamo": "paxi_tpu.protocols.dynamo.host",
     "sdpaxos": "paxi_tpu.protocols.sdpaxos.host",
     "wankeeper": "paxi_tpu.protocols.wankeeper.host",
+    "blockchain": "paxi_tpu.protocols.blockchain.host",
 }
 
 

@@ -1,0 +1,208 @@
+"""Longest-chain blockchain toy as a pure TPU kernel.
+
+Reference: the paxi lineage's blockchain/ package (SURVEY §2.2 "others")
+— the longest-chain contrast case to the consensus protocols: replicas
+"mine" blocks by lottery, extend the longest chain they know, gossip
+heads, and adopt any longer chain they hear about; agreement is only
+eventual and probabilistic (forks happen and resolve by length), which
+is exactly what its oracle checks — and what distinguishes it from the
+quorum protocols whose oracles demand immediate agreement.
+
+TPU re-design (lane-major layout — see sim/lanes.py):
+- A chain is its **hash chain**: block id ``id' = mix(id, miner,
+  height)`` — ancestry is a pure function of the mining history, so
+  blocks carry no payload and "verify the chain" IS "recompute the
+  hash chain", which the per-step oracle does over the resident
+  window.
+- Each replica keeps the last ``n_slots`` block ids AND miner ids of
+  its adopted chain (rings indexed by height), so chain verification
+  and reorg accounting are windowed like every other kernel's log.
+- Gossip advertises ``(height, id)``; adoption copies the offering
+  replica's **live** (height, head, rings) by reference — the same
+  mechanism as the paxos kernel's P1b log merge.  The advertisement
+  picks WHO to adopt from; the adopted state is the sender's current,
+  internally-consistent chain (which, heights being monotone, is at
+  least as long as advertised — adopting it never regresses).
+- Mining: a per-(replica, step) PRNG lottery with P(block) =
+  ``1 / (n_replicas * difficulty)`` — ``cfg.steal_threshold`` doubles
+  as the difficulty knob, keeping SimConfig untouched.
+- Oracle (what a longest-chain system really promises):
+  1. height never decreases (fork choice only extends);
+  2. the resident window is hash-chain-consistent: every in-window
+     ``(parent, miner, height)`` recomputes to the stored id;
+  eventual convergence is a METRIC (``converged``), not an invariant —
+  forks are legal mid-run, and flagging them would be dishonest.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from paxi_tpu.sim.ring import take_replica as _take_replica
+from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+
+GENESIS = 7
+
+
+def mix(pid, miner, height):
+    """Deterministic 31-bit block id from (parent id, miner, height).
+    int32 multiplies wrap in XLA — that IS the scrambling."""
+    h = pid * jnp.int32(0x1E3779B1) + miner * jnp.int32(0x05EBCA77) \
+        + height * jnp.int32(0x42B2AE35)
+    h = h ^ (h >> 13)
+    return (h & jnp.int32(0x7FFFFFFF)) | jnp.int32(1)   # never 0
+
+
+def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
+    return {"head": ("height", "hid")}
+
+
+def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
+    R, S, G = cfg.n_replicas, cfg.n_slots, n_groups
+    del rng
+    i32 = jnp.int32
+    at0 = (jnp.arange(S) == 0)[None, :, None]
+    return dict(
+        height=jnp.zeros((R, G), i32),       # my head height (genesis=0)
+        head=jnp.full((R, G), GENESIS, i32),  # my head id
+        ring=jnp.where(at0, GENESIS, jnp.zeros((R, S, G), i32)),
+        miner_ring=jnp.zeros((R, S, G), i32),  # miner of block at height
+        mined=jnp.zeros((R, G), i32),        # blocks I mined
+        reorgs=jnp.zeros((R, G), i32),       # adoptions that rewound me
+    )
+
+
+def step(state, inbox, ctx: StepCtx):
+    cfg = ctx.cfg
+    R, S = cfg.n_replicas, cfg.n_slots
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    sidx = jnp.arange(S, dtype=jnp.int32)
+
+    height = state["height"]
+    head = state["head"]
+    ring = state["ring"]
+    miner_ring = state["miner_ring"]
+    mined = state["mined"]
+    G = height.shape[-1]
+
+    def ring_at(rg, h):
+        """rg value at absolute height h (garbage if h left the window;
+        callers mask)."""
+        oh = sidx[None, :, None] == (h % S)[:, None, :]
+        return jnp.sum(jnp.where(oh, rg, 0), axis=1)
+
+    # ---------------- fork choice over gossiped advertisements ----------
+    m = inbox["head"]
+    v = jnp.swapaxes(m["valid"], 0, 1)                   # (me, src, G)
+    gh = jnp.where(v, jnp.swapaxes(m["height"], 0, 1), -1)
+    gid = jnp.swapaxes(m["hid"], 0, 1)
+    best_h = jnp.max(gh, axis=1)                         # (me, G)
+    tie = gh == best_h[:, None, :]
+    best_id = jnp.min(jnp.where(tie & v, gid, jnp.int32(0x7FFFFFFF)),
+                      axis=1)
+    better = (best_h > height) \
+        | ((best_h == height) & (best_h >= 0) & (best_id < head))
+    pick = jnp.argmax(tie & v & (gid == best_id[:, None, :]),
+                      axis=1).astype(jnp.int32)
+    # adopt the offerer's LIVE chain (by reference): heights are
+    # monotone, so its current chain is >= the advertised one and its
+    # (height, head, rings) are mutually consistent
+    src_height = _take_replica(height, pick)
+    src_head = _take_replica(head, pick)
+    src_ring = _take_replica(ring, pick)
+    src_miner = _take_replica(miner_ring, pick)
+    # reorg accounting: the adopted chain's block at MY old height
+    # differs from my old head (or my old height already left the
+    # adopted window — a deep rewind)
+    in_win = height > src_height - S
+    diverged = better & (~in_win | (ring_at(src_ring, height) != head))
+    height_n = jnp.where(better, src_height, height)
+    head_n = jnp.where(better, src_head, head)
+    ring = jnp.where(better[:, None, :], src_ring, ring)
+    miner_ring = jnp.where(better[:, None, :], src_miner, miner_ring)
+    height, head = height_n, head_n
+    reorgs = state["reorgs"] + diverged
+
+    # ---------------- mine: PRNG lottery, extend my chain ---------------
+    diff = max(int(cfg.steal_threshold), 1)
+    k = jr.fold_in(ctx.rng, 41)
+    win = jr.uniform(k, (R, G)) < (1.0 / (R * diff))
+    new_h = height + 1
+    new_id = mix(head, ridx[:, None], new_h)
+    oh_n = sidx[None, :, None] == (new_h % S)[:, None, :]
+    ring = jnp.where(win[:, None, :] & oh_n, new_id[:, None, :], ring)
+    miner_ring = jnp.where(win[:, None, :] & oh_n,
+                           ridx[:, None, None], miner_ring)
+    height = jnp.where(win, new_h, height)
+    head = jnp.where(win, new_id, head)
+    mined = mined + win
+
+    # ---------------- gossip my head ------------------------------------
+    out_head = {
+        "valid": jnp.ones((R, R, G), bool),
+        "height": jnp.broadcast_to(height[:, None, :], (R, R, G)),
+        "hid": jnp.broadcast_to(head[:, None, :], (R, R, G)),
+    }
+
+    new_state = dict(height=height, head=head, ring=ring,
+                     miner_ring=miner_ring, mined=mined, reorgs=reorgs)
+    return new_state, {"head": out_head}
+
+
+def metrics(state, cfg: SimConfig):
+    h, hd = state["height"], state["head"]
+    conv = jnp.all(hd == hd[:1], axis=0) & jnp.all(h == h[:1], axis=0)
+    return {
+        "committed_slots": jnp.sum(jnp.max(h, axis=0)),  # chain growth
+        "mined": jnp.sum(state["mined"]),
+        "reorgs": jnp.sum(state["reorgs"]),
+        "converged": jnp.sum(conv.astype(jnp.int32)),    # groups agreed
+    }
+
+
+def invariants(old, new, cfg: SimConfig) -> jax.Array:
+    """1. Height monotonicity (fork choice only extends).
+    2. Windowed hash-chain verification: every resident (parent, miner,
+       height) triple recomputes to the stored id — 'verify the chain'
+       done literally, over the ring window.
+    3. The head slot holds the head.
+    Eventual convergence is a metric, not an invariant: forks are
+    legal mid-run in a longest-chain system."""
+    S = cfg.n_slots
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    height, head = new["height"], new["head"]
+    ring, miner = new["ring"], new["miner_ring"]
+
+    v1 = jnp.sum(new["height"] < old["height"])
+
+    # height assigned to ring slot s (the latest cycle at or below my
+    # height); verify id[h] == mix(id[h-1], miner[h], h) wherever both
+    # h and h-1 are resident and h >= 1
+    h_at = height[:, None, :] - ((height[:, None, :] - sidx[None, :, None])
+                                 % S)                    # (R, S, G)
+    checkable = (h_at >= 1) & (h_at > height[:, None, :] - S + 1)
+    # parent sits at slot (s - 1) mod S: a roll, not a gather
+    parent = jnp.roll(ring, 1, axis=1)
+    expect = mix(parent, miner, h_at)
+    v2 = jnp.sum(checkable & (ring != expect))
+
+    oh_h = sidx[None, :, None] == (height % S)[:, None, :]
+    at_head = jnp.sum(jnp.where(oh_h, ring, 0), axis=1)
+    v3 = jnp.sum(at_head != head)
+
+    return (v1 + v2 + v3).astype(jnp.int32)
+
+
+PROTOCOL = SimProtocol(
+    name="blockchain",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=step,
+    metrics=metrics,
+    invariants=invariants,
+    batched=True,
+)

@@ -1,0 +1,74 @@
+"""Host-runtime tests for the blockchain toy (longest-chain gossip)."""
+
+import asyncio
+
+import pytest
+
+from paxi_tpu.core.command import Command, Reply, Request
+from paxi_tpu.host.simulation import Cluster
+
+pytestmark = pytest.mark.host
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def do(replica, key, value=b"", cid="c1", cmd_id=1, timeout=8.0):
+    fut = asyncio.get_running_loop().create_future()
+    replica.handle_client_request(Request(
+        command=Command(key, value, cid, cmd_id), reply_to=fut))
+    rep: Reply = await asyncio.wait_for(fut, timeout)
+    assert rep.err is None, rep.err
+    return rep.value
+
+
+def test_write_confirms_and_propagates():
+    async def main():
+        c = Cluster("blockchain", n=3, http=False)
+        await c.start()
+        try:
+            await do(c["1.1"], 1, b"x", cmd_id=1)   # acked when buried
+            await asyncio.sleep(0.3)                # let gossip settle
+            vals = {i: c[i].db.get(1) for i in c.ids}
+            assert all(v == b"x" for v in vals.values()), vals
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_chains_converge():
+    async def main():
+        c = Cluster("blockchain", n=3, http=False)
+        await c.start()
+        try:
+            for n in range(3):
+                await do(c[c.ids[n]], n, f"v{n}".encode(),
+                         cid=f"c{n}", cmd_id=1)
+            await asyncio.sleep(0.5)
+            heads = {c[i].head for i in c.ids}
+            heights = {c[i]._height(c[i].head) for i in c.ids}
+            assert len(heads) == 1, heads           # one chain won
+            assert heights.pop() >= 2
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_missing_parent_is_fetched():
+    """A replica cut off during a block burst re-fetches ancestors and
+    catches up to the longest chain."""
+    async def main():
+        c = Cluster("blockchain", n=3, http=False)
+        await c.start()
+        try:
+            for i in c.ids:
+                if i != "1.3":
+                    c[i].socket.drop("1.3", 0.3)
+            await do(c["1.1"], 5, b"v", cmd_id=1)
+            await asyncio.sleep(0.8)                # heal + fetch
+            assert c["1.3"].db.get(5) == b"v"
+            assert c["1.3"].head == c["1.1"].head
+        finally:
+            await c.stop()
+    run(main())
