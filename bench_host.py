@@ -1,0 +1,90 @@
+"""Host-runtime benchmark sweep: every protocol through the real
+deployment stack (in-proc cluster + HTTP client + closed-loop
+benchmark + linearizability check) — the reference's primary user
+surface (bin/client against a -simulation cluster).
+
+Prints ONE JSON line per protocol and writes the collected list to
+BENCH_HOST.json next to this file.  ``anomalies`` is the
+linearizability checker's count: 0 expected for every protocol except
+the eventually-consistent ones (dynamo, blockchain), whose lines are
+labeled ``consistency: eventual`` and run without the check — flagging
+them would be testing the wrong promise.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+
+from paxi_tpu.core.config import Bconfig, local_config
+from paxi_tpu.host.benchmark import Benchmark
+from paxi_tpu.host.simulation import Cluster
+
+CONFIGS = [
+    # (protocol, n, zones, linearizable?)
+    ("paxos", 3, 1, True),
+    ("epaxos", 5, 1, True),
+    ("wpaxos", 6, 2, True),
+    ("abd", 5, 1, True),
+    ("chain", 3, 1, True),
+    ("kpaxos", 3, 1, True),
+    ("sdpaxos", 3, 1, True),
+    ("wankeeper", 6, 2, True),
+    ("dynamo", 3, 1, False),
+    ("blockchain", 3, 1, False),
+]
+
+
+async def bench_one(name: str, n: int, zones: int, lin: bool) -> dict:
+    cfg = local_config(n, zones=zones)
+    secs = int(os.environ.get("BENCH_HOST_T", "4"))
+    cfg.benchmark = Bconfig(T=secs, K=8, W=0.5, concurrency=4,
+                            linearizability_check=lin)
+    c = Cluster(name, cfg=cfg, http=True)
+    await c.start()
+    try:
+        t0 = time.perf_counter()
+        stats = await Benchmark(cfg, cfg.benchmark, seed=1).run()
+        dt = time.perf_counter() - t0
+        return {
+            "metric": f"{name}_host_ops_per_sec",
+            "value": round(stats.ops / max(stats.duration, 1e-9), 1),
+            "unit": "ops/s",
+            "protocol": name,
+            "replicas": n,
+            "zones": zones,
+            "ops": stats.ops,
+            "errors": stats.errors,
+            "anomalies": (stats.anomalies if lin else None),
+            "consistency": ("linearizable" if lin else "eventual"),
+            "wall_s": round(dt, 2),
+        }
+    finally:
+        await c.stop()
+
+
+def main() -> int:
+    results = []
+    worst = 0
+    for name, n, zones, lin in CONFIGS:
+        try:
+            r = asyncio.run(bench_one(name, n, zones, lin))
+        except Exception as e:                      # noqa: BLE001
+            r = {"metric": f"{name}_host_ops_per_sec", "value": 0,
+                 "protocol": name, "error": f"{type(e).__name__}: {e}"}
+            worst = 1
+        if r.get("errors") or (r.get("anomalies") or 0) > 0:
+            worst = 1
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_HOST.json")
+    with open(path, "w") as f:
+        json.dump(results, f, indent=1)
+    return worst
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -70,10 +70,22 @@ class Database:
             return dict(self._data)
 
     def restore(self, snap: Dict[Key, Value]) -> None:
-        """Adopt a snapshot (state transfer at leader change)."""
+        """Adopt a snapshot (state transfer at leader change).  Upsert
+        semantics: snapshots from a more-advanced replica are a
+        superset of the committed state, so absent keys need no
+        deletion.  Use :meth:`reset` when the new state REPLACES the
+        old (e.g. a blockchain reorg replay)."""
         with self._lock:
             for k, v in snap.items():
                 self.put(int(k), v)
+
+    def reset(self) -> None:
+        """Drop every key (and history): the caller is rebuilding the
+        state from scratch — a chain reorg replay, not a state
+        transfer."""
+        with self._lock:
+            self._data.clear()
+            self._history.clear()
 
     def history(self, key: Key) -> List[Value]:
         with self._lock:

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
+from paxi_tpu.sim.ring import dst_major
 from paxi_tpu.sim.ring import take_replica as _take_replica
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
@@ -97,9 +98,9 @@ def step(state, inbox, ctx: StepCtx):
 
     # ---------------- fork choice over gossiped advertisements ----------
     m = inbox["head"]
-    v = jnp.swapaxes(m["valid"], 0, 1)                   # (me, src, G)
-    gh = jnp.where(v, jnp.swapaxes(m["height"], 0, 1), -1)
-    gid = jnp.swapaxes(m["hid"], 0, 1)
+    v = dst_major(m["valid"])                            # (me, src, G)
+    gh = jnp.where(v, dst_major(m["height"]), -1)
+    gid = dst_major(m["hid"])
     best_h = jnp.max(gh, axis=1)                         # (me, G)
     tie = gh == best_h[:, None, :]
     best_id = jnp.min(jnp.where(tie & v, gid, jnp.int32(0x7FFFFFFF)),

@@ -72,3 +72,29 @@ def test_missing_parent_is_fetched():
         finally:
             await c.stop()
     run(main())
+
+
+def test_reorg_discards_orphaned_branch_writes():
+    """A key written only on an orphaned branch must disappear when a
+    longer competing chain is adopted (reorg = rebuild, not upsert)."""
+    async def main():
+        c = Cluster("blockchain", n=3, http=False)
+        await c.start()
+        try:
+            from paxi_tpu.protocols.blockchain.host import BlockMsg
+            r = c["1.3"]
+            r._tasks[-1].cancel()      # freeze 1.3's miner: manual blocks
+            # branch A: one block writing key 9
+            r.handle_block(BlockMsg("A1", "genesis", 1, "1.1",
+                                    [[9, b"orphaned", "cx", 1]]))
+            assert r.db.get(9) == b"orphaned"
+            # branch B: two blocks, no key 9 -> longer, wins, reorg
+            r.handle_block(BlockMsg("B1", "genesis", 1, "1.2",
+                                    [[2, b"kept", "cy", 1]]))
+            r.handle_block(BlockMsg("B2", "B1", 2, "1.2", []))
+            assert r.head == "B2"
+            assert r.db.get(9) is None, "orphaned write survived reorg"
+            assert r.db.get(2) == b"kept"
+        finally:
+            await c.stop()
+    run(main())
