@@ -129,8 +129,9 @@ class BlockchainReplica(Node):
 
     def _adopt(self, bid: str) -> None:
         # fast path: the new head EXTENDS my current chain — apply just
-        # the delta blocks (the overwhelming steady-state case; a full
-        # genesis replay per block would decay quadratically)
+        # the delta blocks and scan only the blocks whose burial depth
+        # crosses CONFIRM (a full genesis walk per block would decay
+        # quadratically; a reorg still pays one O(chain) rebuild)
         delta: List[BlockMsg] = []
         cur = bid
         while cur != GENESIS and cur != self.head:
@@ -138,44 +139,49 @@ class BlockchainReplica(Node):
             cur = self.blocks[cur].parent
         extends = cur == self.head
         self.head = bid
-        chain = self._chain(bid)
+        new_h = self._height(bid)
+        conf_frontier = new_h - CONFIRM       # heights <= this confirmed
+        confirmed: List[BlockMsg] = []
         if extends:
+            old_frontier = conf_frontier - len(delta)
             for b in reversed(delta):
                 for key, value, cid, cmid in b.txs:
                     self.db.execute(Command(int(key), value, cid,
                                             int(cmid)))
                     self.inchain.add((cid, int(cmid)))
+            # newly confirmed: heights (old_frontier, conf_frontier] —
+            # at most len(delta) + CONFIRM blocks from the tip
+            cur = bid
+            while cur != GENESIS:
+                b = self.blocks[cur]
+                if b.height <= old_frontier:
+                    break
+                if b.height <= conf_frontier:
+                    confirmed.append(b)
+                cur = b.parent
         else:
-            # true reorg: rebuild the state from scratch (rare; cost
-            # O(chain) per fork, not per block)
+            # true reorg: rebuild from scratch (rare; once per fork)
             self.db.reset()
             self.inchain = set()
-            for b in chain:
+            for b in self._chain(bid):
                 for key, value, cid, cmid in b.txs:
                     self.db.execute(Command(int(key), value, cid,
                                             int(cmid)))
                     self.inchain.add((cid, int(cmid)))
-        confirmed_txs = []
-        for depth, b in enumerate(chain):
-            buried = len(chain) - 1 - depth
-            if buried >= CONFIRM:
-                for key, value, cid, cmid in b.txs:
-                    confirmed_txs.append(
-                        (b.miner, Command(int(key), value, cid,
-                                          int(cmid))))
-        # acknowledge my own confirmed commands (once)
+                if b.height <= conf_frontier:
+                    confirmed.append(b)
+        # acknowledge my own newly confirmed commands (once)
+        mine_done = {(cid, int(cmid))
+                     for b in confirmed if b.miner == str(self.id)
+                     for _k, _v, cid, cmid in b.txs}
         still = []
         for cmd, req in self.mempool:
             tag = (cmd.client_id, cmd.command_id)
-            done = any(m == str(self.id)
-                       and c.client_id == cmd.client_id
-                       and c.command_id == cmd.command_id
-                       for m, c in confirmed_txs)
-            if done and tag not in self.replied:
+            if tag in mine_done and tag not in self.replied:
                 self.replied.add(tag)
                 if req is not None:
                     req.reply(Reply(cmd, value=b""))
-            elif not done:
+            elif tag not in mine_done:
                 still.append((cmd, req))
         self.mempool = still
 

@@ -341,20 +341,29 @@ def step(state, inbox, ctx: StepCtx):
         pgen = jnp.where(rv_ & (token_zone >= 0), slot_e[:, None, :],
                          pgen)
         token_zone = jnp.where(rv_, -1, token_zone)
-        # grant: new holder zone; its members adopt the handoff version;
-        # the handshake registers clear deterministically with the log
-        gr = ohh & (kind == K_GRANT)[:, None, :]
+        # grant: new holder zone; its members adopt the handoff version.
+        # A STALE grant (version below the last applied grant) is
+        # INERT: a log merge after partitions can legitimately
+        # resurrect a superseded transfer's accepted grant at its
+        # original (higher) slot — Paxos must re-adopt possibly-
+        # committed values — and applying it would move the token
+        # backward.  gver evolves identically along the agreed log at
+        # every replica, so the skip is deterministic.
+        gr_all = ohh & (kind == K_GRANT)[:, None, :]
+        gr = gr_all & (v[:, None, :] >= gver)
         token_zone = jnp.where(gr, zon[:, None, :], token_zone)
         pgen = jnp.where(gr, -1, pgen)
         relv = jnp.where(gr, -1, relv)
         in_new = gr & (my_zone[:, None, None] == zon[:, None, :])
         ver = jnp.where(in_new, jnp.maximum(ver, v[:, None, :]), ver)
-        # oracle: granted versions are monotone per object (a grant
-        # below a previous grant would fork object history)
+        # unreachable-guard self-check: APPLIED grants regressing gver
+        # is impossible while the freshness guard above stands; the
+        # counter revives if a future edit weakens `gr` (the
+        # independent gver-monotonicity check lives in invariants())
         viol_gv = viol_gv + jnp.sum(gr & (v[:, None, :] < gver),
                                     axis=(0, 1))
-        gver = jnp.where(gr, jnp.maximum(gver, v[:, None, :]), gver)
-        transfers = transfers + (wr & (kind == K_GRANT))
+        gver = jnp.where(gr_all, jnp.maximum(gver, v[:, None, :]), gver)
+        transfers = transfers + jnp.sum(gr, axis=1)
         advanced = advanced + running
     new_execute = execute + advanced
     viol_acc = state["viol_acc"] + viol_gv
@@ -508,9 +517,13 @@ def invariants(old, new, cfg: SimConfig) -> jax.Array:
 
     v_ver = jnp.sum(new["ver"] < old["ver"])
     v_grant = jnp.sum(new["viol_acc"] - old["viol_acc"])
+    # independent of the kernel's freshness guard: the applied-grant
+    # frontier itself must never regress (catches a bad state-transfer
+    # merge overwriting gver)
+    v_gmono = jnp.sum(new["gver"] < old["gver"])
 
     return (v_agree + v_stable + v_bal + v_exec
-            + v_ver + v_grant).astype(jnp.int32)
+            + v_ver + v_grant + v_gmono).astype(jnp.int32)
 
 
 PROTOCOL = SimProtocol(

@@ -362,9 +362,21 @@ def step(state, inbox, ctx: StepCtx):
     log_commit = log_commit | newly
 
     # ---------------- P3: commit notifications --------------------------
+    # Zombie fences (see sim/ballot_ring.py apply_p3): a higher-ballot
+    # P3 DEPOSES the receiving object owner (a partitioned stale owner
+    # that snapshot-adopts must stop broadcasting upto for a frontier
+    # it never committed), and the frontier-commit only fires for
+    # bal >= my promised object ballot (a stale in-flight P3 cannot
+    # commit same-stale-ballot never-chosen entries at a laggard).
     m = inbox["p3"]
     has3, b3_, src3, (slot3, cmd3, upto3, low3) = per_obj_best(
         m, ("slot", "cmd", "upto", "lowslot"))
+    fresh3 = has3 & (b3_ >= ballot)                    # (me, O, G)
+    promote3 = has3 & (b3_ > ballot)
+    ballot = jnp.where(promote3, b3_, ballot)
+    active = active & ~promote3
+    sk3 = jnp.any(promote3 & my_steal_oh, axis=1)
+    steal_obj = jnp.where(sk3, -1, steal_obj)
     rel3 = slot3 - base
     inw3 = (rel3 >= 0) & (rel3 < S)
     oh = ((has3 & inw3)[:, :, None, :]
@@ -374,7 +386,7 @@ def step(state, inbox, ctx: StepCtx):
                         log_bal)
     log_commit = log_commit | oh
     abs_ = base[:, :, None, :] + sidx[None, None, :, None]
-    ohu = (has3[:, :, None, :] & (abs_ < upto3[:, :, None, :])
+    ohu = (fresh3[:, :, None, :] & (abs_ < upto3[:, :, None, :])
            & (log_bal == b3_[:, :, None, :]) & (log_cmd != NO_CMD))
     log_commit = log_commit | ohu
 

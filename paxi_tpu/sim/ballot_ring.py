@@ -59,6 +59,16 @@ def own_bal_mask(st, stride):
     return (st["ballot"] > 0) & (st["ballot"] % stride == ridx[:, None])
 
 
+def depose(st, mask, bal):
+    """Adopt a higher ballot where ``mask``: raise the promise, drop
+    leadership, void any in-flight phase-1 round — the one demotion
+    rule every handler (P1a, P2a, P3) applies."""
+    return {**st,
+            "ballot": jnp.where(mask, bal, st["ballot"]),
+            "active": st["active"] & ~mask,
+            "p1_acks": jnp.where(mask, 0, st["p1_acks"])}
+
+
 def promise_p1a(st, m):
     """P1a handler: promise to the highest proposer; emit P1b to it.
     Returns (st', out_p1b, promote)."""
@@ -69,15 +79,12 @@ def promise_p1a(st, m):
     p1a_bal = jnp.max(b_in, axis=0)                      # (dst, G)
     p1a_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)
     promote = p1a_bal > st["ballot"]
-    ballot = jnp.maximum(st["ballot"], p1a_bal)
+    st = depose(st, promote, p1a_bal)
     out_p1b = {
         "valid": promote[:, None, :] & (ridx[None, :, None]
                                         == p1a_src[:, None, :]),
-        "bal": jnp.broadcast_to(ballot[:, None, :], (R, R, G)),
+        "bal": jnp.broadcast_to(st["ballot"][:, None, :], (R, R, G)),
     }
-    st = {**st, "ballot": ballot,
-          "active": st["active"] & ~promote,
-          "p1_acks": jnp.where(promote, 0, st["p1_acks"])}
     return st, out_p1b, promote
 
 
@@ -199,7 +206,7 @@ def accept_p2a(st, m):
     a_cmd = pick_src(m["cmd"], a_src)
     acc_ok = a_has & (a_bal >= st["ballot"])
     demote = acc_ok & (a_bal > st["ballot"])
-    ballot = jnp.where(acc_ok, a_bal, st["ballot"])
+    st = depose(st, demote, a_bal)
     a_rel = a_slot - st["base"]
     a_inw = (a_rel >= 0) & (a_rel < S)
     oh = acc_ok[:, None, :] & (sidx[None, :, None] == a_rel[:, None, :])
@@ -211,9 +218,7 @@ def accept_p2a(st, m):
         "bal": jnp.broadcast_to(a_bal[:, None, :], (R, R, G)),
         "slot": jnp.broadcast_to(a_slot[:, None, :], (R, R, G)),
     }
-    st = {**st, "ballot": ballot,
-          "active": st["active"] & ~demote,
-          "p1_acks": jnp.where(demote, 0, st["p1_acks"]),
+    st = {**st,
           "log_bal": jnp.where(writable, a_bal[:, None, :], st["log_bal"]),
           "log_cmd": jnp.where(writable, a_cmd[:, None, :], st["log_cmd"])}
     return st, out_p2b, acc_ok, demote
@@ -245,7 +250,18 @@ def apply_p3(st, m, extras):
     """P3 handler: adopt the commit notification, frontier-commit below
     ``upto`` at the sender's exact ballot, and snapshot-adopt (extras,
     execute, base) when my frontier fell below the sender's window.
-    Returns (st', extras', c_has, c_bal)."""
+    Returns (st', extras', c_has, c_bal).
+
+    Two zombie fences (a deposed leader partitioned through later
+    rounds stays active with a stale ballot): (1) a P3 with a higher
+    ballot DEPOSES the receiver — so the moment a zombie adopts the
+    new leader's state it stops leading, and never broadcasts an
+    ``upto`` covering a frontier it did not commit itself; (2) the
+    frontier-commit only fires for ``bal >= my promised ballot`` — an
+    in-flight stale P3 cannot commit a receiver's same-stale-ballot
+    accepted-but-never-chosen entries.  (Observed: a zombie's
+    post-adoption upto committed a never-chosen proposal at a fellow
+    laggard, diverging committed values across replicas.)"""
     sidx = _sidx(st)
     c_src = jnp.argmax(jnp.where(m["valid"], m["bal"], -1), axis=0) \
         .astype(jnp.int32)
@@ -254,6 +270,9 @@ def apply_p3(st, m, extras):
     c_slot = pick_src(m["slot"], c_src)
     c_cmd = pick_src(m["cmd"], c_src)
     c_upto = pick_src(m["upto"], c_src)
+    fresh3 = c_has & (c_bal >= st["ballot"])             # fence (2)
+    promote3 = c_has & (c_bal > st["ballot"])            # fence (1)
+    st = depose(st, promote3, c_bal)
     base = st["base"]
     abs_ = base[:, None, :] + sidx[None, :, None]
     c_rel = c_slot - base
@@ -262,7 +281,7 @@ def apply_p3(st, m, extras):
     log_bal = jnp.where(oh, jnp.maximum(st["log_bal"],
                                         c_bal[:, None, :]), st["log_bal"])
     log_commit = st["log_commit"] | oh
-    ohu = (c_has[:, None, :] & (abs_ < c_upto[:, None, :])
+    ohu = (fresh3[:, None, :] & (abs_ < c_upto[:, None, :])
            & (log_bal == c_bal[:, None, :]) & (log_cmd != NO_CMD))
     log_commit = log_commit | ohu
 

@@ -76,3 +76,18 @@ def test_deterministic():
 def test_fuzzed_safety(fuzz):
     res, _ = run(groups=8, steps=80, fuzz=fuzz, seed=3, locality=0.5)
     assert int(res.violations) == 0
+
+
+def test_partition_zombie_owner_fence():
+    """Regression (found by fuzz_soak.py): a deposed owner partitioned
+    through later rounds, after snapshot-adopting the new owner's
+    state, must not frontier-commit never-chosen entries at fellow
+    laggards via its stale-ballot P3 upto.  Seed 1 reproduced the
+    divergence before the P3 depose + frontier fence landed."""
+    fuzz = FuzzConfig(p_partition=0.3, p_crash=0.15, max_delay=2,
+                      window=8)
+    for seed in (0, 1, 2):
+        res, _ = run(groups=32, steps=140, n_replicas=6, n_zones=2,
+                     n_objects=4, steal_threshold=3, locality=0.8,
+                     fuzz=fuzz, seed=seed)
+        assert int(res.violations) == 0, seed
