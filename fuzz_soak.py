@@ -50,6 +50,19 @@ CASES = [
     ("wankeeper", SimConfig(n_replicas=6, n_zones=2, n_objects=4,
                             n_slots=16, locality=0.8),
      [DROP, PART, KILL], 32, 140, "committed_slots"),
+    # 3x3 zone-grid shapes, partition-stressed: the BASELINE geometry
+    # (grid_q2=1: Q1=3 zones, zone-local commits) and the reshaped
+    # q2=2 grid (Q1=2/Q2=2) both
+    ("wpaxos", SimConfig(n_replicas=9, n_zones=3, n_objects=6,
+                         n_slots=16, steal_threshold=3, locality=0.8),
+     [PART], 16, 140, "committed_slots"),
+    ("wpaxos", SimConfig(n_replicas=9, n_zones=3, n_objects=6,
+                         n_slots=16, steal_threshold=3, locality=0.8,
+                         grid_q2=2),
+     [PART], 16, 140, "committed_slots"),
+    ("wankeeper", SimConfig(n_replicas=9, n_zones=3, n_objects=6,
+                            n_slots=16, locality=0.8),
+     [PART], 16, 140, "committed_slots"),
     ("blockchain", SimConfig(n_replicas=5, n_slots=32,
                              steal_threshold=4),
      [DROP, DUP, PART], 64, 200, "committed_slots"),
@@ -57,7 +70,7 @@ CASES = [
 
 SCHED_NAMES = {id(DROP): "drop", id(DUP): "dup", id(PART): "partition",
                id(KILL): "perm_kill"}
-SEEDS = (0, 1, 2)
+SEEDS = (0, 1, 2, 3, 4)
 
 
 def main() -> int:
@@ -76,6 +89,9 @@ def main() -> int:
                     "protocol": name,
                     "schedule": SCHED_NAMES[id(fz)],
                     "seed": seed,
+                    "replicas": cfg.n_replicas,
+                    "zones": cfg.n_zones,
+                    "grid_q2": cfg.grid_q2,
                     "groups": groups,
                     "steps": steps,
                     "violations": v,
