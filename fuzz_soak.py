@@ -52,7 +52,7 @@ CASES = [
      [DROP, PART, KILL], 32, 140, "committed_slots"),
     # 3x3 zone-grid shapes, partition-stressed: the BASELINE geometry
     # (grid_q2=1: Q1=3 zones, zone-local commits) and the reshaped
-    # q2=2 grid (Q1=2/Q2=2) both
+    # q2=2 grid (Q1=2/Q2=2) must both stay violation-free
     ("wpaxos", SimConfig(n_replicas=9, n_zones=3, n_objects=6,
                          n_slots=16, steal_threshold=3, locality=0.8),
      [PART], 16, 140, "committed_slots"),

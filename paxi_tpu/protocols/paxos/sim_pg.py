@@ -249,6 +249,11 @@ def step(state, inbox, ctx: StepCtx):
     log_commit = log_commit | newly
 
     # ---------------- P3: commit notifications --------------------------
+    # Zombie fences (see sim/ballot_ring.py apply_p3): a higher-ballot
+    # P3 deposes the receiver, and the frontier commit only fires for
+    # bal >= my promised ballot — a deposed leader partitioned through
+    # later rounds must not commit never-chosen same-stale-ballot
+    # entries at fellow laggards via its post-adoption upto.
     m = inbox["p3"]
     b_in = jnp.where(m["valid"], m["bal"], -1)
     c_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)
@@ -257,6 +262,11 @@ def step(state, inbox, ctx: StepCtx):
     c_slot = m["slot"][c_src, ridx]                       # absolute
     c_cmd = m["cmd"][c_src, ridx]
     c_upto = m["upto"][c_src, ridx]
+    fresh3 = c_has & (c_bal >= ballot)
+    promote3 = c_has & (c_bal > ballot)
+    ballot = jnp.where(promote3, c_bal, ballot)
+    active = active & ~promote3
+    p1_acks = jnp.where(promote3[:, None], False, p1_acks)
     abs_ = base[:, None] + sidx[None, :]
     c_rel = c_slot - base
     oh = c_has[:, None] & (sidx[None, :] == c_rel[:, None])
@@ -264,7 +274,7 @@ def step(state, inbox, ctx: StepCtx):
     log_bal = jnp.where(oh, jnp.maximum(log_bal, c_bal[:, None]), log_bal)
     log_commit = log_commit | oh
     # frontier commit: slots < upto accepted at the leader's exact ballot
-    ohu = (c_has[:, None] & (abs_ < c_upto[:, None])
+    ohu = (fresh3[:, None] & (abs_ < c_upto[:, None])
            & (log_bal == c_bal[:, None]) & (log_cmd != NO_CMD))
     log_commit = log_commit | ohu
 
