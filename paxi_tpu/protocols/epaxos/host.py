@@ -685,6 +685,17 @@ class EPaxosReplica(Node):
                         break
                     elif on_stack.get(w):
                         low[v] = min(low[v], index[w])
+                    else:
+                        # cross-edge into a component already finished
+                        # THIS pass: if it was deferred (blocked on an
+                        # uncommitted dep), so is everything that
+                        # depends on it — without this, a read could
+                        # execute ahead of its deferred dependency and
+                        # return a stale value (observed under fault
+                        # injection: soak_host.py, epaxos, 718
+                        # anomalies)
+                        blocked[v] = blocked.get(v, False) \
+                            or blocked.get(w, False)
                 if advanced:
                     continue
                 work.pop()

@@ -1,0 +1,103 @@
+"""Host-runtime fault soak: the reference's fault-testing methodology
+(SURVEY §4: AdminClient-driven crash/drop injection DURING a
+linearizability-checked benchmark) automated as one artifact.
+
+For every linearizable protocol: start an in-proc cluster, run the
+closed-loop HTTP benchmark, and concurrently inject faults through the
+REAL AdminClient surface (/admin/crash, /admin/drop, /admin/flaky) —
+a follower crash, a dropped link, a flaky link, and (for the protocols
+with leader/sequencer/root recovery) a likely-leader crash.  Asserts
+**zero linearizability anomalies** and forward progress; op errors are
+recorded, not asserted (a crashed node's in-flight ops legitimately
+time out and the client retries elsewhere — socket.go semantics).
+
+Writes SOAK_HOST.json; exits nonzero on any anomaly or stalled run.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+from paxi_tpu.core.config import Bconfig, local_config
+from paxi_tpu.host.benchmark import Benchmark
+from paxi_tpu.host.client import AdminClient
+from paxi_tpu.host.simulation import Cluster
+
+# (protocol, n, zones, crash-likely-leader-too)
+CASES = [
+    ("paxos", 3, 1, True),
+    ("epaxos", 5, 1, True),       # leaderless: any crash is "a leader"
+    ("wpaxos", 6, 2, True),
+    ("kpaxos", 3, 1, False),      # static partition leaders by design
+    ("abd", 5, 1, True),          # crash-only register: any crash fine
+    ("chain", 3, 1, False),       # static chain by design
+    ("sdpaxos", 3, 1, True),
+    ("wankeeper", 6, 2, True),
+]
+
+
+async def inject(admin: AdminClient, ids, leader_too: bool) -> None:
+    """The fault schedule, through the admin HTTP surface."""
+    followers = [i for i in ids[1:]]
+    await asyncio.sleep(1.5)
+    await admin.crash(followers[0], 1.0)
+    await asyncio.sleep(1.0)
+    await admin.drop(followers[-1], ids[0], 0.8)
+    await asyncio.sleep(1.0)
+    await admin.flaky(ids[0], followers[0], 0.5, 1.0)
+    if leader_too:
+        await asyncio.sleep(1.0)
+        await admin.crash(ids[0], 1.2)
+
+
+async def soak_one(name: str, n: int, zones: int, leader_too: bool
+                   ) -> dict:
+    cfg = local_config(n, zones=zones)
+    secs = int(os.environ.get("SOAK_HOST_T", "8"))
+    cfg.benchmark = Bconfig(T=secs, K=8, W=0.5, concurrency=4,
+                            linearizability_check=True)
+    c = Cluster(name, cfg=cfg, http=True)
+    await c.start()
+    admin = AdminClient(cfg)
+    try:
+        bench = asyncio.create_task(Benchmark(cfg, cfg.benchmark,
+                                              seed=2).run())
+        injector = asyncio.create_task(inject(admin, cfg.ids,
+                                              leader_too))
+        stats = await bench
+        await injector
+        return {
+            "protocol": name, "replicas": n, "zones": zones,
+            "leader_crash": leader_too, "ops": stats.ops,
+            "errors": stats.errors, "anomalies": stats.anomalies,
+            "duration_s": round(stats.duration, 2),
+        }
+    finally:
+        admin.close()
+        await c.stop()
+
+
+def main() -> int:
+    results = []
+    bad = 0
+    for name, n, zones, leader_too in CASES:
+        try:
+            r = asyncio.run(soak_one(name, n, zones, leader_too))
+        except Exception as e:                      # noqa: BLE001
+            r = {"protocol": name,
+                 "error": f"{type(e).__name__}: {e}"}
+        if r.get("anomalies", 1) != 0 or r.get("ops", 0) <= 0:
+            bad = 1
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "SOAK_HOST.json")
+    with open(path, "w") as f:
+        json.dump(results, f, indent=1)
+    return bad
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -248,3 +248,43 @@ def test_recovery_preserves_fast_committed_value():
         finally:
             await c.stop()
     run(main())
+
+
+def test_executor_defers_cross_edge_into_blocked_component():
+    """Regression (found by soak_host.py fault injection): the iterative
+    Tarjan must propagate 'blocked on an uncommitted dep' across
+    cross-edges into components already finished this pass — without
+    that, an instance executes ahead of its deferred dependency and a
+    read returns a stale value (718 anomalies in the original soak)."""
+    async def main():
+        c = Cluster("epaxos", n=3, http=False)
+        await c.start()
+        try:
+            from paxi_tpu.protocols.epaxos.host import (
+                COMMITTED, EXECUTED, PREACCEPTED, Instance)
+            from paxi_tpu.core.command import Command
+            from paxi_tpu.core.ident import ID
+            r = c["1.1"]
+            # B=(1.1,0) committed, deps -> Z=(1.3,0) uncommitted;
+            # A=(1.2,0) committed, deps -> B.  Root order visits B's
+            # component first (deferred), then A via a cross-edge.
+            r.insts[ID("1.3")][0] = Instance(Command(1, b"z"), 1, {},
+                                             status=PREACCEPTED)
+            r.insts[ID("1.1")][0] = Instance(
+                Command(1, b"b"), 2, {ID("1.3"): 0}, status=COMMITTED)
+            r.insts[ID("1.2")][0] = Instance(
+                Command(1, b"a"), 3, {ID("1.1"): 0}, status=COMMITTED)
+            for o in ("1.1", "1.2", "1.3"):
+                r._live.add((ID(o), 0))
+            r._execute()
+            assert r.insts[ID("1.1")][0].status == COMMITTED  # deferred
+            assert r.insts[ID("1.2")][0].status == COMMITTED  # deferred
+            assert r.db.get(1) is None                        # nothing ran
+            # once Z commits, the whole chain drains in dep order
+            r.insts[ID("1.3")][0].status = COMMITTED
+            r._execute()
+            assert r.insts[ID("1.2")][0].status == EXECUTED
+            assert r.db.get(1) == b"a"                        # A last
+        finally:
+            await c.stop()
+    run(main())

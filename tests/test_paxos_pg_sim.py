@@ -50,3 +50,61 @@ def test_fuzzed_safety(fuzz):
     res, _ = run(groups=8, steps=120, fuzz=fuzz, seed=11)
     assert int(res.violations) == 0
     assert int(res.metrics["committed_slots"]) > 0
+
+
+def test_stale_p3_frontier_commit_is_fenced():
+    """Deterministic regression for the zombie-leader fences: a deposed
+    leader's stale-ballot P3 upto must not commit a receiver's
+    same-stale-ballot accepted-but-never-chosen entry once the receiver
+    has promised a higher ballot, and a higher-ballot P3 must depose an
+    active stale leader."""
+    import jax.numpy as jnp
+    import jax.random as jr
+    from paxi_tpu.sim.types import StepCtx
+
+    cfg = SimConfig(n_replicas=3, n_slots=8)
+    rng = jr.PRNGKey(0)
+    state = PG.init_state(cfg, rng)
+    R, S = 3, 8
+
+    def empty_inbox():
+        spec = PG.mailbox_spec(cfg)
+        box = {}
+        for name, fields in spec.items():
+            b = {"valid": jnp.zeros((R, R), bool)}
+            for f in fields:
+                b[f] = jnp.zeros((R, R), jnp.int32)
+            box[name] = b
+        return box
+
+    # receiver r2: promised the NEW leader's ballot 129 (round 2, r1),
+    # but still holds a never-chosen ballot-64 proposal at slot 0;
+    # zombie r0: still active at its old ballot 64
+    state["ballot"] = jnp.array([64, 129, 129], jnp.int32)
+    state["active"] = jnp.array([True, True, False])
+    state["log_bal"] = state["log_bal"].at[2, 0].set(64)
+    state["log_cmd"] = state["log_cmd"].at[2, 0].set(777)
+
+    inbox = empty_inbox()
+    # zombie r0 broadcasts a stale P3 with upto=5 (covering slot 0)
+    p3 = inbox["p3"]
+    p3["valid"] = p3["valid"].at[0, :].set(True)
+    p3["bal"] = p3["bal"].at[0, :].set(64)
+    p3["slot"] = p3["slot"].at[0, :].set(4)
+    p3["cmd"] = p3["cmd"].at[0, :].set(999)
+    p3["upto"] = p3["upto"].at[0, :].set(5)
+    # the real leader r1's P3 also reaches the zombie (deposes it)
+    p3["valid"] = p3["valid"].at[1, 0].set(True)
+    p3["bal"] = p3["bal"].at[1, 0].set(129)
+    p3["slot"] = p3["slot"].at[1, 0].set(0)
+    p3["cmd"] = p3["cmd"].at[1, 0].set(111)
+    p3["upto"] = p3["upto"].at[1, 0].set(0)
+
+    ctx = StepCtx(rng=jr.PRNGKey(1), t=jnp.int32(5), cfg=cfg)
+    new, _ = PG.step(state, inbox, ctx)
+    # fence (2): r2's never-chosen ballot-64 entry did NOT commit via
+    # the zombie's frontier (r2 promised 129 > 64)
+    assert not bool(new["log_commit"][2, 0])
+    # fence (1): the zombie was deposed by the higher-ballot P3
+    assert not bool(new["active"][0])
+    assert int(new["ballot"][0]) == 129
