@@ -8,10 +8,20 @@ nonzero if ANY run reports a violation.  Schedules: sustained loss with
 delay/reorder; duplication with deeper delay; flapping partitions with
 crash windows, plus a permanent leader-kill for the protocols with
 in-kernel recovery.
+
+A violation is an ARTIFACT, not just a counter: any violating run is
+re-executed in record mode and the violating group's fault schedule is
+dumped as a trace file under traces/ (see paxi_tpu/trace/) — replay it
+with ``python -m paxi_tpu trace replay``, minimize it with ``trace
+shrink``, project it onto the host runtime with ``trace host``.
+``--seed-bug`` appends the deliberately broken wankeeper_nofloor case
+to demo that pipeline end-to-end (its run is excluded from the exit
+code and from FUZZ_SOAK.json totals).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import time
@@ -72,12 +82,46 @@ SCHED_NAMES = {id(DROP): "drop", id(DUP): "dup", id(PART): "partition",
                id(KILL): "perm_kill"}
 SEEDS = (0, 1, 2, 3, 4)
 
+# the seeded-bug demo case (--seed-bug): EXPECTED to violate — it
+# exists to exercise the capture -> dump pipeline, never the oracle
+BUG_DEMO = ("wankeeper_nofloor",
+            SimConfig(n_replicas=6, n_zones=2, n_objects=2, n_slots=16,
+                      locality=0.1),
+            [DROP], 16, 80, "committed_slots")
 
-def main() -> int:
+
+def dump_trace(traces_dir, name, cfg, fz, seed, groups, steps):
+    """Record-mode rerun of a violating case -> trace file path."""
+    from paxi_tpu import trace as tr
+    t = tr.capture(sim_protocol(name), cfg, fz, seed, groups, steps,
+                   proto_name=name)
+    if t is None:
+        return None                      # not reproducible: report it
+    os.makedirs(traces_dir, exist_ok=True)
+    sched = SCHED_NAMES.get(id(fz), "sched")
+    # geometry in the name: several CASES share (protocol, schedule,
+    # seed) and must not overwrite each other's artifacts
+    geo = f"n{cfg.n_replicas}z{cfg.n_zones}q{cfg.grid_q2}"
+    return tr.save(os.path.join(
+        traces_dir, f"{name}_{geo}_{sched}_s{seed}"), t)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--no-capture", action="store_true",
+                    help="violations stay counters (skip trace dumps)")
+    ap.add_argument("--traces-dir", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "traces"))
+    ap.add_argument("--seed-bug", action="store_true",
+                    help="append the wankeeper_nofloor demo case")
+    args = ap.parse_args(argv)
+
+    cases = list(CASES) + ([BUG_DEMO] if args.seed_bug else [])
     results = []
     bad = 0
-    for name, cfg, scheds, groups, steps, pkey in CASES:
+    for name, cfg, scheds, groups, steps, pkey in cases:
         proto = sim_protocol(name)
+        demo = name == BUG_DEMO[0]
         for fz in scheds:
             run = make_run(proto, cfg, fz)
             compiled = run.lower(jr.PRNGKey(0), groups, steps).compile()
@@ -98,8 +142,13 @@ def main() -> int:
                     "progress": int(metrics[pkey]),
                     "wall_s": round(time.perf_counter() - t0, 3),
                 }
-                bad += v
-                results.append(rec)
+                if v and not args.no_capture:
+                    rec["trace"] = dump_trace(args.traces_dir, name,
+                                              cfg, fz, seed, groups,
+                                              steps)
+                if not demo:
+                    bad += v
+                    results.append(rec)
                 print(json.dumps(rec), flush=True)
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "FUZZ_SOAK.json")

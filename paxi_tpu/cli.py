@@ -172,6 +172,49 @@ def _run_sim(args, proto, cfg, fuzz) -> int:
     return 0 if out["invariant_violations"] == 0 else 1
 
 
+def cmd_trace(args) -> int:
+    """Trace artifacts: inspect, deterministically replay, minimize,
+    and project onto the host runtime (see paxi_tpu/trace/)."""
+    from paxi_tpu import trace as tr
+    t = tr.load(args.file)
+    if args.trace_cmd == "info":
+        print(json.dumps(dict(t.meta, steps=t.n_steps,
+                              events=t.n_events())))
+        return 0
+    if args.trace_cmd == "replay":
+        r = tr.check_determinism(t) if args.twice else tr.replay(t)
+        want = (t.meta.get("replay_state_hash")
+                if t.meta.get("shrunk") else
+                t.meta.get("capture_state_hash"))
+        ok = (r.violations == t.meta.get("group_violations", -1)
+              and (want is None or r.state_hash == want))
+        print(json.dumps({
+            "violations": r.violations,
+            "first_violation_step": r.first_violation_step(),
+            "state_hash": r.state_hash,
+            "reproduced": ok,
+        }))
+        return 0 if ok else 1
+    if args.trace_cmd == "shrink":
+        mini, stats = tr.shrink(t, max_trials=args.max_trials,
+                                log=lambda m: print(f"# {m}",
+                                                    flush=True))
+        out = args.out or (args.file.removesuffix(".npz") + ".min")
+        stats["out"] = tr.save(out, mini)
+        print(json.dumps(stats))
+        return 0
+    if args.trace_cmd == "host":
+        from paxi_tpu.core.config import local_config
+        from paxi_tpu.trace.host import directives_json, host_directives
+        cfg = t.sim_config()
+        ids = local_config(cfg.n_replicas, zones=cfg.n_zones).ids
+        dirs, stats = host_directives(t, ids, step_s=args.step_ms / 1e3)
+        print(json.dumps({"directives": directives_json(dirs),
+                          "stats": stats}))
+        return 0
+    raise AssertionError(args.trace_cmd)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="paxi_tpu",
@@ -228,6 +271,27 @@ def main(argv=None) -> int:
     m.add_argument("-profile", "--profile", default="",
                    help="write a JAX/XLA profiler trace to this dir")
     m.set_defaults(fn=cmd_sim)
+
+    t = sub.add_parser("trace", help="violation traces: replay/shrink")
+    tsub = t.add_subparsers(dest="trace_cmd", required=True)
+    ti = tsub.add_parser("info", help="print a trace's provenance")
+    ti.add_argument("file")
+    tre = tsub.add_parser("replay",
+                          help="pinned deterministic replay in the sim")
+    tre.add_argument("file")
+    tre.add_argument("-twice", "--twice", action="store_true",
+                     help="replay twice and assert identical outcomes")
+    tsh = tsub.add_parser("shrink", help="delta-debug a minimal witness")
+    tsh.add_argument("file")
+    tsh.add_argument("-o", "--out", default="")
+    tsh.add_argument("-max_trials", "--max-trials", dest="max_trials",
+                     type=int, default=200)
+    tho = tsub.add_parser("host",
+                          help="project onto host fault directives")
+    tho.add_argument("file")
+    tho.add_argument("-step_ms", "--step-ms", dest="step_ms",
+                     type=float, default=50.0)
+    t.set_defaults(fn=cmd_trace)
 
     args = p.parse_args(argv)
     return args.fn(args)

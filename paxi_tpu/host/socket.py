@@ -14,12 +14,37 @@ from __future__ import annotations
 import asyncio
 import random
 import time
-from typing import Any, Dict, Optional
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
 
 from paxi_tpu.core.config import Config
 from paxi_tpu.core.ident import ID
 from paxi_tpu.host.codec import Codec
 from paxi_tpu.host.transport import Transport, listen, new_transport
+
+
+@dataclass
+class MsgMatcher:
+    """A deterministic per-message fault: unlike the probabilistic
+    Crash/Drop/Slow/Flaky windows (wall-clock, whole-edge), a matcher
+    targets the n-th occurrence of a message TYPE on one edge — the
+    primitive the trace subsystem needs to replay a sim-captured fault
+    schedule ("drop the 2nd Grant for key 3 sent to 2.1") against the
+    asyncio runtime, bit-for-bit repeatably."""
+
+    to: ID
+    msg_type: str              # message class name, e.g. "Grant"
+    action: str                # "drop" | "delay"
+    delay_s: float = 0.0       # for action == "delay"
+    count: int = 1             # act on this many matching messages...
+    skip: int = 0              # ...after letting this many pass
+    key: Optional[int] = None  # further restrict to msg.key == key
+
+    def matches(self, to: ID, msg: Any) -> bool:
+        return (to == self.to
+                and type(msg).__name__ == self.msg_type
+                and (self.key is None
+                     or getattr(msg, "key", None) == self.key))
 
 
 class Socket:
@@ -36,6 +61,7 @@ class Socket:
         self._drop_until: Dict[ID, float] = {}
         self._slow: Dict[ID, tuple] = {}   # id -> (delay_s, until)
         self._flaky: Dict[ID, tuple] = {}  # id -> (p, until)
+        self._matchers: List[MsgMatcher] = []  # trace-driven faults
         self._rng = random.Random(hash(self.id) & 0xFFFF)
 
     # ---- lifecycle -----------------------------------------------------
@@ -68,6 +94,10 @@ class Socket:
             return
         if now < self._drop_until.get(to, 0.0):
             return
+        act = self._consume_match(to, msg)
+        if act == "drop":
+            return
+        extra = act[1] if isinstance(act, tuple) else 0.0
         p, until = self._flaky.get(to, (0.0, 0.0))
         if now < until and self._rng.random() < p:
             return
@@ -80,7 +110,8 @@ class Socket:
             self._peers[to] = t
             asyncio.ensure_future(self._dial_then(to, t))
         delay, until = self._slow.get(to, (0.0, 0.0))
-        if now < until and delay > 0:
+        delay = extra + (delay if now < until else 0.0)
+        if delay > 0:
             asyncio.get_event_loop().call_later(delay, t.send, msg)
         else:
             t.send(msg)
@@ -107,6 +138,45 @@ class Socket:
         for i in self.cfg.ids:
             if i != self.id and i.zone == zone:
                 self.send(i, msg)
+
+    # ---- deterministic trace-driven faults ------------------------------
+    def _consume_match(self, to: ID, msg: Any):
+        """Consult the matcher list on a send; first live matcher wins.
+        Returns "drop", ("delay", seconds), or None.  Spent matchers
+        (count exhausted) are pruned so the hot send path stays
+        O(live directives) however many schedules this socket has
+        replayed."""
+        act = None
+        for m in self._matchers:
+            if m.count <= 0 or not m.matches(to, msg):
+                continue
+            if m.skip > 0:
+                m.skip -= 1
+                continue
+            m.count -= 1
+            act = "drop" if m.action == "drop" else ("delay", m.delay_s)
+            break
+        if act is not None:
+            self._matchers = [m for m in self._matchers if m.count > 0]
+        return act
+
+    def add_matcher(self, m: MsgMatcher) -> None:
+        self._matchers.append(m)
+
+    def drop_next(self, to: ID, msg_type: str, count: int = 1,
+                  skip: int = 0, key: Optional[int] = None) -> None:
+        """Drop the next ``count`` messages of class ``msg_type`` sent to
+        ``to`` (after letting ``skip`` matching ones through)."""
+        self.add_matcher(MsgMatcher(ID(to), msg_type, "drop",
+                                    count=count, skip=skip, key=key))
+
+    def delay_next(self, to: ID, msg_type: str, delay_s: float,
+                   count: int = 1, skip: int = 0,
+                   key: Optional[int] = None) -> None:
+        """Delay (reorder) the next ``count`` matching messages."""
+        self.add_matcher(MsgMatcher(ID(to), msg_type, "delay",
+                                    delay_s=delay_s, count=count,
+                                    skip=skip, key=key))
 
     # ---- fault injection (socket.go Crash/Drop/Slow/Flaky) -------------
     def crash(self, t: float) -> None:

@@ -25,6 +25,12 @@ _SIM_MODULES = {
     "sdpaxos": "paxi_tpu.protocols.sdpaxos.sim",
     "wankeeper": "paxi_tpu.protocols.wankeeper.sim",
     "blockchain": "paxi_tpu.protocols.blockchain.sim",
+    # trace-subsystem plumbing (NOT correctness cases — both violate by
+    # design): the fragile demo kernel and the seeded WanKeeper bug
+    # twin that mirrors the host runtime's pre-fix dropped-Grant flaw.
+    # ":ATTR" selects a non-default protocol symbol in the module.
+    "fragile_counter": "paxi_tpu.trace.demo",
+    "wankeeper_nofloor": "paxi_tpu.protocols.wankeeper.sim:PROTOCOL_NOFLOOR",
 }
 
 _HOST_MODULES = {
@@ -46,7 +52,8 @@ def sim_protocol(name: str) -> SimProtocol:
     if name not in _SIM_MODULES:
         raise KeyError(f"unknown sim protocol {name!r}; "
                        f"have {sorted(_SIM_MODULES)}")
-    return importlib.import_module(_SIM_MODULES[name]).PROTOCOL
+    mod, _, attr = _SIM_MODULES[name].partition(":")
+    return getattr(importlib.import_module(mod), attr or "PROTOCOL")
 
 
 def host_replica(name: str) -> Callable:

@@ -148,6 +148,15 @@ class WanKeeperReplica(Node):
         self.tokens: Dict[int, int] = {}
         self.ver: Dict[int, int] = {}      # my applied version per key
         self.val: Dict[int, bytes] = {}
+        # durable grant floor (the sim kernel's gver, host form): last
+        # granted (ver, value) per key, tracked from the broadcast
+        # Grants by EVERY replica.  Grants and release reports are
+        # floored at it, so a dropped Grant can never make a later
+        # handoff resume below a committed, client-acked version.
+        self.granted: Dict[int, Tuple[int, bytes]] = {}
+        # highest Grant generation seen per key: fences out delayed /
+        # duplicate Grants from an earlier handoff of the same key
+        self.gen_seen: Dict[int, int] = {}
         # zone-leader state
         self.flushq: Dict[int, Quorum] = {}       # key -> current quorum
         self.pending: Dict[int, List[_Op]] = {}   # key -> queued ops
@@ -387,6 +396,15 @@ class WanKeeperReplica(Node):
     def handle_revoke(self, m: Revoke) -> None:
         if not self.is_zone_leader() or m.ballot < self.ballot:
             return
+        # generation fence, symmetric with handle_grant's: a delayed /
+        # duplicate Revoke from an EARLIER handoff must not overwrite a
+        # newer pending revocation (the holder would then retry Rel at
+        # the old gen forever while the root waits on the new one — a
+        # permanent wedge), nor re-open a handoff whose Grant already
+        # landed (gen_seen)
+        if m.gen <= self.gen_seen.get(m.key, -1) \
+                or m.gen < self.revoking.get(m.key, m.gen):
+            return
         if m.ballot > self.ballot:
             self.ballot = m.ballot
             self.active = False
@@ -396,7 +414,15 @@ class WanKeeperReplica(Node):
     def _try_release(self, k: int, gen: int) -> None:
         if k in self.flushq:
             return                       # still flushing: Rel after
-        msg = Rel(k, self.ver.get(k, 0), self.val.get(k, b""), gen)
+        # floor the report at the version the token was granted at
+        # (sim kernel's rel_ver gver floor): if the Grant that carried
+        # the state to my zone was lost, reporting my local ver would
+        # regress the object's history at the next handoff
+        ver, val = self.ver.get(k, 0), self.val.get(k, b"")
+        gv, gval = self.granted.get(k, (0, b""))
+        if ver < gv:
+            ver, val = gv, gval
+        msg = Rel(k, ver, val, gen)
         if self.is_root():
             self.handle_rel(msg)
         elif self.root is not None:
@@ -406,10 +432,27 @@ class WanKeeperReplica(Node):
         if not self.is_root():
             return
         t = self.transit.get(m.key)
-        if t is None or t[0] != m.gen:
-            return                       # stale generation: fenced off
-        zone = self.want.get(m.key, t[1])
-        self._grant(m.key, zone, m.ver, m.value, m.gen)
+        if t is not None:
+            if t[0] != m.gen:
+                return                   # stale generation: fenced off
+            zone = self.want.get(m.key, t[1])
+            self._grant(m.key, zone, m.ver, m.value, m.gen)
+            return
+        if (m.key, m.gen) in self.granted_log:
+            return                       # duplicate of a completed handoff
+        # no handshake in flight and an unknown generation: a holder is
+        # retrying the release of a DEAD root's revoke — the Grant that
+        # would answer it can never arrive, so without help the key
+        # wedges whenever the holder's OWN zone wants it (no TReq is
+        # sent for a held key, so no fresh Revoke re-keys the
+        # handshake).  Answer with a fresh Grant under MY generation:
+        # the holder resumes only via a root-issued Grant, never by
+        # unilaterally dropping its revoking entry — a failed
+        # candidate's Root1a bumps ballots without deposing the live
+        # root, so "gen predates my ballot" alone must NOT re-open the
+        # drain gate (two zones could end up draining concurrently).
+        self.gen += 1
+        self._grant(m.key, self.holder(m.key), m.ver, m.value, self.gen)
 
     def _grant(self, k: int, zone: int, ver: Optional[int],
                value: Optional[bytes], gen: int) -> None:
@@ -420,19 +463,37 @@ class WanKeeperReplica(Node):
         self.transit.pop(k, None)
         self.want.pop(k, None)
         self.tokens[k] = zone
-        g = Grant(k, zone,
-                  self.ver.get(k, 0) if ver is None else ver,
-                  self.val.get(k, b"") if value is None else value, gen,
-                  self.ballot)
+        if ver is None:
+            ver, value = self.ver.get(k, 0), self.val.get(k, b"")
+        # floor at the last granted (ver, value) — the sim kernel's
+        # gver floor at the root.  The re-grant path (handle_treq with
+        # holder == requester) lands here with my LOCAL state, which is
+        # stale whenever my zone didn't hold the key last; without the
+        # floor a single dropped Grant broadcast makes the re-grant
+        # regress the holder below committed, client-acked writes.
+        gv, gval = self.granted.get(k, (0, b""))
+        if ver < gv:
+            ver, value = gv, gval
+        g = Grant(k, zone, ver, value, gen, self.ballot)
         self.socket.broadcast(g)
         self.handle_grant(g)
 
     def handle_grant(self, m: Grant) -> None:
         if m.ballot < self.ballot:
             return                       # a deposed root's grant
+        # generation fence: a delayed or duplicate Grant from an
+        # EARLIER handoff of this key (same ballot — the slow-link path
+        # reorders) must not resurrect my holder state after a newer
+        # Revoke, or two zones end up holding the token concurrently
+        if m.gen < self.revoking.get(m.key, m.gen) \
+                or m.gen <= self.gen_seen.get(m.key, -1):
+            return
+        self.gen_seen[m.key] = m.gen
         if m.ballot > self.ballot:
             self.ballot = m.ballot
             self.active = False
+        if m.ver >= self.granted.get(m.key, (0, b""))[0]:
+            self.granted[m.key] = (m.ver, m.value)
         self.tokens[m.key] = m.zone
         self.revoking.pop(m.key, None)
         if m.zone == self.zone and m.ver > self.ver.get(m.key, 0):
@@ -446,3 +507,14 @@ class WanKeeperReplica(Node):
 
 def new_replica(id: ID, cfg: Config) -> WanKeeperReplica:
     return WanKeeperReplica(ID(id), cfg)
+
+
+# sim mailbox name -> host message class, for the cross-runtime trace
+# projection (trace/host.py).  The sim's root log (p2a/p3) carries the
+# grant/revoke commands that the host runtime sends as explicit Grant
+# messages, so log-plane faults project onto the Grant broadcast — a
+# schedule homomorphism, not a wire-level identity.
+TRACE_MSG_MAP = {
+    "zrep": "ZWrite", "zack": "ZAck", "treq": "TReq", "rel": "Rel",
+    "p1a": "Root1a", "p1b": "Root1b", "p2a": "Grant", "p3": "Grant",
+}

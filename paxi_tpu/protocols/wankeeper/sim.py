@@ -51,6 +51,7 @@ TPU re-design (lane-major layout; not a translation):
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Tuple
 
 import jax
@@ -164,7 +165,15 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
     )
 
 
-def step(state, inbox, ctx: StepCtx):
+def step(state, inbox, ctx: StepCtx, gver_floor: bool = True):
+    """``gver_floor=False`` is the SEEDED BUG twin (PROTOCOL_NOFLOOR):
+    it removes both halves of the granted-version floor — release
+    reports are not floored at gver and stale grants are applied
+    instead of skipped — reproducing in the sim kernel exactly the
+    linearizability flaw the round-5 advisor found in the host runtime
+    (a single dropped Grant regressing committed writes).  It exists so
+    the trace pipeline has a real, capturable violation to minimize and
+    to project cross-runtime; never soak it as a correctness case."""
     cfg = ctx.cfg
     R, S, O = cfg.n_replicas, cfg.n_slots, cfg.n_objects
     Z = cfg.n_zones
@@ -350,7 +359,7 @@ def step(state, inbox, ctx: StepCtx):
         # backward.  gver evolves identically along the agreed log at
         # every replica, so the skip is deterministic.
         gr_all = ohh & (kind == K_GRANT)[:, None, :]
-        gr = gr_all & (v[:, None, :] >= gver)
+        gr = gr_all & (v[:, None, :] >= gver) if gver_floor else gr_all
         token_zone = jnp.where(gr, zon[:, None, :], token_zone)
         pgen = jnp.where(gr, -1, pgen)
         relv = jnp.where(gr, -1, relv)
@@ -438,9 +447,10 @@ def step(state, inbox, ctx: StepCtx):
     rel_obj = jnp.argmax(in_transit_mine, axis=1).astype(jnp.int32)
     any_rel = jnp.any(in_transit_mine, axis=1)           # (R, G)
     rsel = oidx[None, :, None] == rel_obj[:, None, :]
-    rel_ver = jnp.maximum(
-        jnp.sum(jnp.where(rsel, committed_v, 0), axis=1),
-        jnp.sum(jnp.where(rsel, gver, 0), axis=1))
+    rel_ver = jnp.sum(jnp.where(rsel, committed_v, 0), axis=1)
+    if gver_floor:
+        rel_ver = jnp.maximum(
+            rel_ver, jnp.sum(jnp.where(rsel, gver, 0), axis=1))
     rel_gen = jnp.sum(jnp.where(rsel, rgen, 0), axis=1)
     out_rel = {
         "valid": jnp.broadcast_to(any_rel[:, None, :], (R, R, G)),
@@ -531,6 +541,19 @@ PROTOCOL = SimProtocol(
     mailbox_spec=mailbox_spec,
     init_state=init_state,
     step=step,
+    metrics=metrics,
+    invariants=invariants,
+    batched=True,
+)
+
+# the seeded-bug twin (see step's docstring): violates under fault
+# schedules that revoke a token before the receiving zone's acks catch
+# up — the trace subsystem's end-to-end WanKeeper reproduction case
+PROTOCOL_NOFLOOR = SimProtocol(
+    name="wankeeper_nofloor",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=functools.partial(step, gver_floor=False),
     metrics=metrics,
     invariants=invariants,
     batched=True,

@@ -2,10 +2,11 @@
 
 from paxi_tpu.sim.types import (FAULT_FREE, FuzzConfig, SimConfig,
                                 SimProtocol, StepCtx)
-from paxi_tpu.sim.runner import (SimResult, continue_run, make_run,
-                                 simulate)
+from paxi_tpu.sim.runner import (SimResult, continue_run, make_pinned_run,
+                                 make_recorded_run, make_run, simulate)
 from paxi_tpu.sim.checkpoint import load_carry, save_carry
 
 __all__ = ["SimConfig", "FuzzConfig", "FAULT_FREE", "SimProtocol",
            "StepCtx", "SimResult", "make_run", "simulate",
-           "continue_run", "save_carry", "load_carry"]
+           "continue_run", "make_recorded_run", "make_pinned_run",
+           "save_carry", "load_carry"]

@@ -38,6 +38,13 @@ import jax.random as jr
 from paxi_tpu.sim.mailbox import wheel_deliver  # noqa: F401  (layout-
 # agnostic: pops/rotates the leading delay axis; re-exported so batched
 # and per-group paths share one delivery implementation)
+from paxi_tpu.sim.mailbox import draw_edge_faults  # noqa: F401  (shape-
+# generic: planes take each outbox validity plane's shape, so the same
+# draw serves (src, dst) and lane-major (src, dst, G) layouts)
+from paxi_tpu.sim.mailbox import wheel_insert  # noqa: F401  (rank-
+# generic: the eye and crash masks grow a trailing group axis when the
+# outbox validity plane is (src, dst, G) — one implementation for both
+# layouts so the trace subsystem's replay guarantee can't drift)
 from paxi_tpu.sim.types import FuzzConfig, Mailboxes
 
 MailSpec = Dict[str, Tuple[str, ...]]
@@ -93,40 +100,3 @@ def fault_state_refresh(fs, rng, t, fuzz: FuzzConfig, n: int):
     return new
 
 
-def wheel_insert(wheel: Mailboxes, outbox: Mailboxes, fs, rng,
-                 fuzz: FuzzConfig) -> Mailboxes:
-    """Push this step's outbox into the wheel under the fault schedule.
-    Outbox planes are (src, dst, G)."""
-    d = fuzz.wheel
-    new_wheel = {}
-    names = sorted(outbox.keys())
-    keys = jr.split(rng, 3 * len(names))
-    for i, name in enumerate(names):
-        box, wbox = outbox[name], wheel[name]
-        n, _, g = box["valid"].shape
-        no_self = ~jnp.eye(n, dtype=bool)[:, :, None]
-        valid = (box["valid"] & no_self & fs["conn"]
-                 & ~fs["crashed"][:, None, :] & ~fs["crashed"][None, :, :])
-        kd, kdel, kdup = keys[3 * i], keys[3 * i + 1], keys[3 * i + 2]
-        if fuzz.p_drop > 0:
-            valid = valid & ~jr.bernoulli(kd, fuzz.p_drop, (n, n, g))
-        if d > 1:
-            delay = jr.randint(kdel, (n, n, g), 1, d + 1)  # arrive in 1..d
-        else:
-            delay = jnp.ones((n, n, g), jnp.int32)
-        dup = (jr.bernoulli(kdup, fuzz.p_dup, (n, n, g))
-               if fuzz.p_dup > 0 else jnp.zeros((n, n, g), bool))
-        dup_delay = jnp.minimum(delay + 1, d)
-
-        wvalid = wbox["valid"]
-        wfields = {k: v for k, v in wbox.items() if k != "valid"}
-        for slot in range(d):
-            put = valid & (delay == slot + 1)
-            if fuzz.p_dup > 0:
-                put = put | (valid & dup & (dup_delay == slot + 1))
-            wvalid = wvalid.at[slot].set(wvalid[slot] | put)
-            for f in wfields:
-                wfields[f] = wfields[f].at[slot].set(
-                    jnp.where(put, box[f], wfields[f][slot]))
-        new_wheel[name] = {"valid": wvalid, **wfields}
-    return new_wheel

@@ -101,39 +101,86 @@ def fault_state_refresh(fs, rng, t, fuzz: FuzzConfig, n: int):
     return new
 
 
-def wheel_insert(wheel: Mailboxes, outbox: Mailboxes, fs, rng,
-                 fuzz: FuzzConfig) -> Mailboxes:
-    """Push this step's outbox into the wheel under the fault schedule."""
+def draw_edge_faults(rng, outbox: Mailboxes, fuzz: FuzzConfig):
+    """Draw the per-edge fault planes wheel_insert consumes — one
+    ``{"drop", "delay", "dup"}`` triple per message type, each plane
+    shaped like the outbox validity plane ((src, dst) per-group or
+    (src, dst, G) lane-major, so one implementation serves both
+    layouts).  Factored out of wheel_insert so the trace subsystem can
+    materialize the schedule (capture) or substitute a recorded one
+    (pinned replay); the key-split structure is unchanged from the old
+    inline draws, so existing runs stay bit-for-bit identical."""
     d = fuzz.wheel
-    new_wheel = {}
     names = sorted(outbox.keys())
     keys = jr.split(rng, 3 * len(names))
+    faults = {}
     for i, name in enumerate(names):
+        shape = outbox[name]["valid"].shape
+        kd, kdel, kdup = keys[3 * i], keys[3 * i + 1], keys[3 * i + 2]
+        drop = (jr.bernoulli(kd, fuzz.p_drop, shape)
+                if fuzz.p_drop > 0 else jnp.zeros(shape, bool))
+        if d > 1:
+            delay = jr.randint(kdel, shape, 1, d + 1)  # arrive in 1..d steps
+        else:
+            delay = jnp.ones(shape, jnp.int32)
+        dup = (jr.bernoulli(kdup, fuzz.p_dup, shape)
+               if fuzz.p_dup > 0 else jnp.zeros(shape, bool))
+        faults[name] = {"drop": drop, "delay": delay, "dup": dup}
+    return faults
+
+
+def live_mask(fs, valid_ndim: int, n: int):
+    """The delivery-validity predicate (no self-edges, conn intact,
+    both endpoints alive) — ONE definition shared by wheel_insert and
+    the runner's record path, so the recorded-event neutralization can
+    never drift from what delivery actually masks (drift would make a
+    fresh capture replay to a different state hash).  Rank-generic:
+    ``valid_ndim`` is 3 for lane-major (src, dst, G) planes with
+    crashed (R, G), 2 for per-group (src, dst) with crashed (R,)."""
+    no_self = ~jnp.eye(n, dtype=bool)
+    if valid_ndim == 3:
+        no_self = no_self[:, :, None]
+        alive = ~fs["crashed"][:, None, :] & ~fs["crashed"][None, :, :]
+    else:
+        alive = ~fs["crashed"][:, None] & ~fs["crashed"][None, :]
+    return no_self & fs["conn"] & alive
+
+
+def wheel_insert(wheel: Mailboxes, outbox: Mailboxes, fs,
+                 fuzz: FuzzConfig, faults: Mailboxes) -> Mailboxes:
+    """Push this step's outbox into the wheel under the fault schedule.
+
+    ``faults`` comes from draw_edge_faults — or is a recorded schedule
+    during pinned replay; planes are applied unconditionally so a
+    replayed schedule can carry drops/dups even when the FuzzConfig
+    probabilities are zero.  Deliberately no internal draw fallback:
+    one draw site (the runner) keeps the capture/replay bit-for-bit
+    guarantee auditable.
+
+    Rank-generic over the two layouts (ONE implementation so the
+    replay guarantee can't drift between them): per-group planes are
+    (src, dst) with crashed (R,); lane-major planes are (src, dst, G)
+    with crashed (R, G) — the eye and crash masks grow a trailing
+    group axis, everything else is shape-polymorphic."""
+    d = fuzz.wheel
+    new_wheel = {}
+    for name in sorted(outbox.keys()):
         box, wbox = outbox[name], wheel[name]
         n = box["valid"].shape[0]
-        no_self = ~jnp.eye(n, dtype=bool)
-        valid = (box["valid"] & no_self & fs["conn"]
-                 & ~fs["crashed"][:, None] & ~fs["crashed"][None, :])
-        kd, kdel, kdup = keys[3 * i], keys[3 * i + 1], keys[3 * i + 2]
-        if fuzz.p_drop > 0:
-            valid = valid & ~jr.bernoulli(kd, fuzz.p_drop, (n, n))
-        if d > 1:
-            delay = jr.randint(kdel, (n, n), 1, d + 1)  # arrival in 1..d steps
-        else:
-            delay = jnp.ones((n, n), jnp.int32)
-        dup = (jr.bernoulli(kdup, fuzz.p_dup, (n, n))
-               if fuzz.p_dup > 0 else jnp.zeros((n, n), bool))
+        f = faults[name]
+        valid = (box["valid"] & live_mask(fs, box["valid"].ndim, n)
+                 & ~f["drop"])
+        delay, dup = f["delay"], f["dup"]
         dup_delay = jnp.minimum(delay + 1, d)
 
         wvalid = wbox["valid"]
         wfields = {k: v for k, v in wbox.items() if k != "valid"}
         for slot in range(d):
-            put = valid & (delay == slot + 1)
-            if fuzz.p_dup > 0:
-                put = put | (valid & dup & (dup_delay == slot + 1))
+            put = valid & ((delay == slot + 1)
+                           | (dup & (dup_delay == slot + 1)))
             wvalid = wvalid.at[slot].set(wvalid[slot] | put)
-            for f in wfields:
-                wfields[f] = wfields[f].at[slot].set(
-                    jnp.where(put, box[f], wfields[f][slot]))
+            for f_ in wfields:
+                wfields[f_] = wfields[f_].at[slot].set(
+                    jnp.where(put, box[f_], wfields[f_][slot]))
         new_wheel[name] = {"valid": wvalid, **wfields}
     return new_wheel

@@ -62,20 +62,96 @@ def init_carry(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
 
 
 def _group_step(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
-                carry_g, t):
+                carry_g, t, sched_t=None, pin_on=None, record=False):
     """One lock-step round: deliver -> step -> refresh faults -> insert
     -> check invariants.  ONE implementation for both layouts — only the
     exchange module differs (lane-major vs per-group planes); the caller
-    vmaps this over a leading group axis for non-batched protocols."""
+    vmaps this over a leading group axis for non-batched protocols.
+
+    Trace hooks (see paxi_tpu/trace/):
+    - ``sched_t``: this step's recorded single-group fault schedule
+      (``{"conn", "crashed", "faults"}``); it replaces the drawn
+      schedule for the pinned group — ``pin_on`` is a static group index
+      under the lane-major layout, a traced per-group boolean under
+      vmap.  The PRNG chain is split identically either way, so a
+      replay whose recorded schedule equals the drawn one is bit-for-bit
+      the original run.
+    - ``record=True``: additionally emit the materialized schedule and
+      (lane-major) per-group violations, so capture can slice out the
+      violating group's schedule.
+    """
     ops = lanes if proto.batched else mb
     state, wheel, fs, rng = carry_g
     rng, k_step, k_fault, k_ins = jr.split(rng, 4)
     inbox, wheel = ops.wheel_deliver(wheel)
     new_state, outbox = proto.step(state, inbox, StepCtx(k_step, t, cfg))
     fs = ops.fault_state_refresh(fs, k_fault, t, fuzz, cfg.n_replicas)
-    wheel = ops.wheel_insert(wheel, outbox, fs, k_ins, fuzz)
-    viol = proto.invariants(state, new_state, cfg)
+    faults = mb.draw_edge_faults(k_ins, outbox, fuzz)
+    if sched_t is not None:
+        if proto.batched:
+            g = pin_on
+            fs = dict(fs,
+                      conn=fs["conn"].at[:, :, g].set(sched_t["conn"]),
+                      crashed=fs["crashed"].at[:, g].set(
+                          sched_t["crashed"]))
+            faults = {
+                name: {k: v.at[:, :, g].set(sched_t["faults"][name][k])
+                       for k, v in f.items()}
+                for name, f in faults.items()}
+        else:
+            on = pin_on
+
+            def mix(drawn, rec):
+                return jnp.where(on, rec, drawn)
+
+            fs = dict(fs, conn=mix(fs["conn"], sched_t["conn"]),
+                      crashed=mix(fs["crashed"], sched_t["crashed"]))
+            faults = {
+                name: {k: mix(v, sched_t["faults"][name][k])
+                       for k, v in f.items()}
+                for name, f in faults.items()}
+    wheel = ops.wheel_insert(wheel, outbox, fs, fuzz, faults)
+    if record and proto.batched:
+        viol = per_group_invariants(proto, cfg, state, new_state)
+    else:
+        viol = proto.invariants(state, new_state, cfg)
+    if record:
+        # record only EFFECTIVE fault events: a drop/dup/delay on an
+        # edge wheel_insert would mask anyway (empty outbox, self-edge,
+        # severed conn, crashed endpoint) is a delivery no-op, so
+        # neutralizing it keeps replay bit-for-bit while making the
+        # recorded schedule sparse — which is what lets the shrinker
+        # and the host-runtime projection work on real events instead
+        # of PRNG noise
+        live = mb.live_mask(fs, 3 if proto.batched else 2,
+                            cfg.n_replicas)
+        rec_faults = {
+            name: {"drop": f["drop"] & outbox[name]["valid"] & live,
+                   "delay": jnp.where(outbox[name]["valid"] & live,
+                                      f["delay"], 1),
+                   "dup": f["dup"] & outbox[name]["valid"] & live}
+            for name, f in faults.items()}
+        sched = {"conn": fs["conn"], "crashed": fs["crashed"],
+                 "faults": rec_faults}
+        return (new_state, wheel, fs, rng), (viol, sched)
     return (new_state, wheel, fs, rng), viol
+
+
+def per_group_invariants(proto: SimProtocol, cfg: SimConfig, old, new):
+    """Per-group invariant violations for a lane-major kernel.  Batched
+    ``invariants`` return already-aggregated scalars and index arrays
+    assuming a trailing G axis, so vmapping them per group is not
+    possible; instead map over width-1 group slices (groups are
+    independent, so the slice totals sum to the aggregate)."""
+    G = jax.tree_util.tree_leaves(new)[0].shape[-1]
+
+    def one(g):
+        def sl(x):
+            return jax.lax.dynamic_slice_in_dim(x, g, 1, axis=-1)
+        return proto.invariants(jax.tree.map(sl, old),
+                                jax.tree.map(sl, new), cfg)
+
+    return jax.lax.map(one, jnp.arange(G))
 
 
 def make_scan_body(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig):
@@ -126,6 +202,79 @@ def make_run(proto: SimProtocol, cfg: SimConfig,
         carry = init_carry(proto, cfg, fuzz, n_groups, rng)
         carry, viols = jax.lax.scan(body, carry, jnp.arange(n_steps))
         return finish_run(proto, cfg, carry, viols)
+
+    return run
+
+
+def make_recorded_run(proto: SimProtocol, cfg: SimConfig,
+                      fuzz: FuzzConfig = FAULT_FREE):
+    """Build the capture-mode runner (the sim runner's ``record`` mode):
+
+    ``run(rng, n_groups, n_steps) -> (state, metrics, viols_total,
+    viol_steps, sched)`` where ``viol_steps`` is the per-step, PER-GROUP
+    violation matrix (T, G) — locating the violating group is the whole
+    point — and ``sched`` is the materialized fault schedule for every
+    group and step (conn/crashed planes plus per-message-type
+    drop/delay/dup planes), stacked over time.  The PRNG chain is
+    identical to make_run's, so the recorded schedule is exactly what
+    the normal run consumed."""
+    step1 = functools.partial(_group_step, proto, cfg, fuzz, record=True)
+    if proto.batched:
+        body = step1
+    else:
+        def body(carry, t):
+            carry, ys = jax.vmap(step1, in_axes=(0, None))(carry, t)
+            return carry, ys
+
+    @functools.partial(jax.jit, static_argnums=(1, 2))
+    def run(rng, n_groups: int, n_steps: int):
+        carry = init_carry(proto, cfg, fuzz, n_groups, rng)
+        carry, (viols, sched) = jax.lax.scan(body, carry,
+                                             jnp.arange(n_steps))
+        state, metrics, total = finish_run(proto, cfg, carry, viols)
+        return state, metrics, total, viols, sched
+
+    return run
+
+
+def make_pinned_run(proto: SimProtocol, cfg: SimConfig,
+                    fuzz: FuzzConfig, group: int):
+    """Build the replay-mode runner: ``run(rng, n_groups, sched) ->
+    (state, metrics, viols_g_total, viol_steps_g)``.
+
+    ``sched`` is a time-stacked single-group schedule (a trace's
+    pytree); group ``group`` consumes it INSTEAD of PRNG draws while the
+    other groups keep their drawn schedules (they are scaffolding — with
+    the original seed and geometry they reproduce the captured run
+    exactly, so the traced group's workload is pinned too).  Violations
+    are reported for the traced group only."""
+    def sl(x):
+        return jax.lax.dynamic_slice_in_dim(x, group, 1, axis=-1)
+
+    def body(carry, xt):
+        t, sched_t = xt
+        old_state = carry[0]
+        if proto.batched:
+            carry, _ = _group_step(proto, cfg, fuzz, carry, t,
+                                   sched_t=sched_t, pin_on=group)
+            viol_g = proto.invariants(jax.tree.map(sl, old_state),
+                                      jax.tree.map(sl, carry[0]), cfg)
+            return carry, viol_g
+        gidx = jnp.arange(jax.tree_util.tree_leaves(old_state)[0].shape[0])
+        carry, viol = jax.vmap(
+            lambda cg, on: _group_step(proto, cfg, fuzz, cg, t,
+                                       sched_t=sched_t, pin_on=on),
+            in_axes=(0, 0))(carry, gidx == group)
+        return carry, viol[group]
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def run(rng, n_groups: int, sched):
+        carry = init_carry(proto, cfg, fuzz, n_groups, rng)
+        n_steps = jax.tree_util.tree_leaves(sched)[0].shape[0]
+        carry, viols = jax.lax.scan(body, carry,
+                                    (jnp.arange(n_steps), sched))
+        state, metrics, total = finish_run(proto, cfg, carry, viols)
+        return state, metrics, total, viols
 
     return run
 

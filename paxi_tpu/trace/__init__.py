@@ -1,0 +1,22 @@
+"""Deterministic trace capture, replay & shrinking.
+
+A violation found by the fuzzing sim runner becomes a first-class,
+shippable artifact: ``capture`` materializes the violating group's
+fault schedule into a versioned trace file, ``replay`` re-executes it
+bit-for-bit through the pinned-schedule kernel path, ``shrink``
+delta-debugs it down to a minimal witness, and ``trace.host`` projects
+it onto the host runtime's fault-injection surface so sim findings
+drive asyncio regression tests (and divergence between the runtimes
+becomes observable).
+"""
+
+from paxi_tpu.trace.capture import capture
+from paxi_tpu.trace.format import (TRACE_VERSION, Trace, load, make_meta,
+                                   save)
+from paxi_tpu.trace.replay import (ReplayResult, check_determinism,
+                                   replay, state_hash)
+from paxi_tpu.trace.shrink import list_events, neutralize, shrink
+
+__all__ = ["Trace", "TRACE_VERSION", "load", "save", "make_meta",
+           "capture", "replay", "ReplayResult", "check_determinism",
+           "state_hash", "shrink", "list_events", "neutralize"]

@@ -1,0 +1,87 @@
+"""Capture: materialize the fault schedule behind a violation.
+
+``capture`` reruns a (protocol, cfg, fuzz, seed, groups, steps)
+combination — exactly the tuple a fuzz-soak run is keyed by — in the
+sim runner's record mode, which emits the per-step, per-group fault
+schedule alongside a per-group violation matrix.  The first violating
+group's schedule is sliced out into a single-group Trace; replaying it
+through the pinned path reproduces the run bit-for-bit (the recorded
+schedule IS what the original run drew).  No violation -> None.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.random as jr
+import numpy as np
+
+from paxi_tpu.sim.runner import make_recorded_run
+from paxi_tpu.sim.types import FuzzConfig, SimConfig, SimProtocol
+from paxi_tpu.trace import replay as _replay
+from paxi_tpu.trace.format import Trace, make_meta
+
+
+def _slice_group(sched, g: int, batched: bool):
+    """Single-group schedule out of the recorded batch.  Lane-major
+    kernels stack the group axis LAST ((T, R, R, G)); vmapped kernels
+    carry it right after time ((T, G, R, R))."""
+    if batched:
+        return jax.tree.map(lambda x: np.asarray(x[..., g]), sched)
+    return jax.tree.map(lambda x: np.asarray(x[:, g]), sched)
+
+
+# one compiled record-mode runner per (protocol, geometry, fuzz) —
+# a soak dumping several seeds of the same case shares one executable
+# (the pinned twin is replay._PIN_CACHE)
+_REC_CACHE: dict = {}
+
+
+def _recorded_run(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig):
+    # id(proto), not proto.name: see replay._pinned_run
+    key = (id(proto), cfg, fuzz)
+    run = _REC_CACHE.get(key)
+    if run is None:
+        run = make_recorded_run(proto, cfg, fuzz)
+        _REC_CACHE[key] = run
+    return run
+
+
+def capture(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
+            seed: int, n_groups: int, n_steps: int,
+            group: Optional[int] = None,
+            proto_name: Optional[str] = None) -> Optional[Trace]:
+    """Record-mode rerun; returns the violating group's Trace or None.
+
+    ``group`` forces a specific group (useful to capture a non-violating
+    group's schedule for divergence studies); by default the group with
+    the earliest violation wins.
+    """
+    run = _recorded_run(proto, cfg, fuzz)
+    state, metrics, total, viols, sched = run(
+        jr.PRNGKey(seed), n_groups, n_steps)
+    jax.block_until_ready(total)
+    viols = np.asarray(viols)                    # (T, G)
+    if group is None:
+        if int(total) == 0:
+            return None
+        per_group = viols.sum(axis=0)
+        first_step = np.where(viols > 0, np.arange(n_steps)[:, None],
+                              n_steps).min(axis=0)
+        # earliest-violating group; ties broken by violation count
+        cands = np.nonzero(per_group > 0)[0]
+        group = int(cands[np.lexsort(
+            (-per_group[cands], first_step[cands]))][0])
+    g = int(group)
+    gsched = _slice_group(sched, g, proto.batched)
+    gstate = jax.tree.map(lambda x: x[g], state)  # finish_run: G leading
+    gviols = viols[:, g]
+    nz = np.nonzero(gviols)[0]
+    meta = make_meta(
+        proto_name or proto.name, cfg, fuzz, seed, n_groups, g,
+        group_violations=int(gviols.sum()),
+        first_violation_step=int(nz[0]) if nz.size else -1,
+        capture_state_hash=_replay.state_hash(gstate),
+        shrunk=False)
+    return Trace(meta=meta, sched=gsched)

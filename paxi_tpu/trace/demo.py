@@ -1,0 +1,74 @@
+"""`fragile_counter`: a deliberately UNSAFE protocol that seeds
+violations for the trace subsystem's own tests and demos.
+
+Replica 0 broadcasts a sequence number each step; receivers require
+strict in-order delivery and count a violation whenever a sequence gap
+slips through — which any single drop (or reordering delay) of a
+``seq`` message causes.  This is the trace pipeline's lab rat: a
+violation exists under any lossy schedule, the minimal witness is ONE
+fault event, and the kernel is small enough that capture -> shrink ->
+replay runs in well under a second on CPU.  It runs the per-group
+(vmapped) kernel layout, complementing the lane-major protocols the
+soak uses, so both runner paths stay covered.
+
+NOT a real protocol — never add it to the soak matrix as a correctness
+case; its violations are the expected output.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+
+
+def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
+    return {"seq": ("v",)}
+
+
+def init_state(cfg: SimConfig, rng: jax.Array):
+    del rng
+    R = cfg.n_replicas
+    return {
+        "last": jnp.zeros((R,), jnp.int32),   # highest seq applied
+        "gaps": jnp.zeros((), jnp.int32),     # out-of-order deliveries
+    }
+
+
+def step(state, inbox, ctx: StepCtx):
+    cfg = ctx.cfg
+    R = cfg.n_replicas
+    m = inbox["seq"]
+    from0 = m["valid"][0]                     # (dst,): arrivals from 0
+    v0 = m["v"][0]
+    last = state["last"]
+    gap = from0 & (v0 > last + 1)             # a seq number was skipped
+    new_last = jnp.where(from0, jnp.maximum(last, v0), last)
+    new_gaps = state["gaps"] + jnp.sum(gap.astype(jnp.int32))
+    out = {"seq": {
+        "valid": jnp.zeros((R, R), bool).at[0].set(True),
+        "v": jnp.broadcast_to(ctx.t + 1, (R, R)).astype(jnp.int32),
+    }}
+    return {"last": new_last, "gaps": new_gaps}, out
+
+
+def metrics(state, cfg: SimConfig):
+    return {"delivered": jnp.sum(state["last"])}
+
+
+def invariants(old, new, cfg: SimConfig) -> jax.Array:
+    return (new["gaps"] - old["gaps"]).astype(jnp.int32)
+
+
+PROTOCOL = SimProtocol(
+    name="fragile_counter",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=step,
+    metrics=metrics,
+    invariants=invariants,
+    batched=False,
+)

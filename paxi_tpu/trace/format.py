@@ -1,0 +1,157 @@
+"""The trace file format: a violation's fault schedule as an artifact.
+
+A trace is the complete, versioned record of the fault schedule one
+simulated group experienced — per step: the connectivity plane, the
+crash vector, and per message type the effective drop/delay/dup planes
+(only events that coincided with an actual send are kept; everything
+else is neutral by construction, see runner._group_step's record path).
+Together with (protocol, geometry, fuzz config, seed, group index) it
+pins a run exactly: the pinned replay path consumes these planes
+INSTEAD of PRNG draws, so a captured violation reproduces bit-for-bit,
+and a schedule edited by the shrinker replays deterministically too.
+
+Container: one ``.npz`` with path-flattened arrays plus a JSON meta
+blob — the same portable envelope as sim/checkpoint.py, so traces move
+between hosts/devices freely.
+
+Schedule pytree (single group, time-major)::
+
+    {"conn":    (T, R, R) bool,   # src->dst deliverable this step
+     "crashed": (T, R)    bool,   # comms-crashed replicas
+     "faults":  {msg_type: {"drop":  (T, R, R) bool,
+                            "delay": (T, R, R) int32,  # 1..max_delay
+                            "dup":   (T, R, R) bool}}}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+from paxi_tpu.sim.types import FuzzConfig, SimConfig
+
+_META_KEY = "__paxi_tpu_trace_meta__"
+_SEP = "|"
+# bump on incompatible schedule-layout changes; load() refuses a
+# mismatch with a clear error instead of a downstream shape error
+TRACE_VERSION = 1
+
+
+@dataclass
+class Trace:
+    """A captured (or shrunk) single-group fault schedule + provenance."""
+
+    meta: Dict[str, Any]
+    sched: Dict[str, Any]     # pytree of numpy/jax arrays, time-major
+
+    # ---- provenance accessors -----------------------------------------
+    @property
+    def protocol(self) -> str:
+        return self.meta["protocol"]
+
+    @property
+    def group(self) -> int:
+        return int(self.meta["group"])
+
+    @property
+    def n_groups(self) -> int:
+        return int(self.meta["n_groups"])
+
+    @property
+    def seed(self) -> int:
+        return int(self.meta["seed"])
+
+    @property
+    def n_steps(self) -> int:
+        return int(jax.tree_util.tree_leaves(self.sched)[0].shape[0])
+
+    def sim_config(self) -> SimConfig:
+        return SimConfig(**self.meta["sim_cfg"])
+
+    def fuzz_config(self) -> FuzzConfig:
+        return FuzzConfig(**self.meta["fuzz"])
+
+    def n_events(self) -> int:
+        """Total fault events in the schedule (what the shrinker
+        minimizes): drops + dups + delayed sends + crashed replica-steps
+        + severed edge-steps."""
+        s = self.sched
+        n = int(np.sum(~np.asarray(s["conn"])))
+        n += int(np.sum(np.asarray(s["crashed"])))
+        for f in s["faults"].values():
+            n += int(np.sum(np.asarray(f["drop"])))
+            n += int(np.sum(np.asarray(f["dup"])))
+            n += int(np.sum(np.asarray(f["delay"]) > 1))
+        return n
+
+    def with_sched(self, sched, **meta_updates) -> "Trace":
+        meta = dict(self.meta, **meta_updates)
+        return Trace(meta=meta, sched=sched)
+
+
+def make_meta(proto_name: str, cfg: SimConfig, fuzz: FuzzConfig,
+              seed: int, n_groups: int, group: int,
+              **extra) -> Dict[str, Any]:
+    meta = {
+        "trace_version": TRACE_VERSION,
+        "protocol": proto_name,
+        "sim_cfg": dataclasses.asdict(cfg),
+        "fuzz": dataclasses.asdict(fuzz),
+        "seed": int(seed),
+        "n_groups": int(n_groups),
+        "group": int(group),
+    }
+    meta.update(extra)
+    return meta
+
+
+def _flatten(tree: Any) -> Dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        flat[_SEP.join(str(p) for p in path)] = np.asarray(leaf)
+    return flat
+
+
+def _norm(path: str) -> str:
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def save(path: str, trace: Trace) -> str:
+    """Write a trace; returns the (normalized) path written."""
+    flat = _flatten(trace.sched)
+    meta = dict(trace.meta)
+    meta.setdefault("trace_version", TRACE_VERSION)
+    flat[_META_KEY] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    path = _norm(path)
+    np.savez_compressed(path, **flat)
+    return path
+
+
+def load(path: str) -> Trace:
+    with np.load(_norm(path)) as z:
+        if _META_KEY not in z:
+            raise ValueError(f"{path!r} is not a paxi_tpu trace file")
+        meta = json.loads(bytes(z[_META_KEY]).decode())
+        flat = {k: z[k] for k in z.files if k != _META_KEY}
+    v = int(meta.get("trace_version", 0))
+    if v != TRACE_VERSION:
+        raise ValueError(
+            f"trace version v{v} is incompatible with this build "
+            f"(v{TRACE_VERSION}); re-capture the trace")
+    sched: Dict[str, Any] = {"faults": {}}
+    for key, arr in flat.items():
+        parts = [p.strip("[']") for p in key.split(_SEP)]
+        node = sched
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    for req in ("conn", "crashed"):
+        if req not in sched:
+            raise ValueError(f"trace {path!r} missing {req!r} plane")
+    return Trace(meta=meta, sched=sched)

@@ -1,0 +1,296 @@
+"""Cross-runtime replay: project a sim trace onto the host runtime's
+fault-injection surface.
+
+The two runtimes share one fault vocabulary by construction — the sim's
+drop/dup/delay/partition/crash schedule is the vectorized
+generalization of socket.go's Crash/Drop/Slow/Flaky (see sim/mailbox.py
+docstring) — so a captured schedule can be projected back:
+
+- per-message-type **drops** become occurrence-indexed ``DropMsg``
+  directives consumed by ``Socket.drop_next`` (deterministic: "drop the
+  next N messages of class X on edge i->j"), using the protocol's
+  ``TRACE_MSG_MAP`` to translate sim mailbox names to host message
+  classes;
+- **delays** become ``SlowWin``/``DelayMsg`` (reordering) windows;
+- **crashes** and **partition cuts** become ``CrashWin``/``DropWin``
+  wall-clock windows, scaled by ``step_s`` (one sim step ~ one
+  watchdog tick of host time);
+- **dups** have no host analog (TCP/chan never duplicate) and are
+  dropped from the projection, reported in the stats.
+
+The projection is a schedule homomorphism, not a clock-accurate
+emulation: the asyncio runtime has no lock-step rounds, so recorded
+message drops apply to the FIRST ``count`` matching sends (step
+indices ride along as ``DropMsg.steps`` provenance; ``skip`` can
+re-aim them by hand) and everything else becomes coarse time
+windows.  That is exactly what is needed to turn
+a minimized sim witness ("the run where THIS Grant vanished") into a
+host regression test, and to surface sim<->host divergence when the
+projected schedule does NOT reproduce on the host.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import importlib
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paxi_tpu.trace.format import Trace
+
+
+# ---- directive vocabulary ----------------------------------------------
+@dataclass
+class DropMsg:
+    """Drop ``count`` messages of class ``msg_type`` on src->dst (after
+    ``skip`` matching ones pass); ``key`` narrows to one object.
+
+    ``steps`` is provenance only: the sim step indices of the recorded
+    drops.  The projection applies a first-N approximation (skip=0) —
+    the host runtime has no lock-step rounds, so "which occurrence"
+    cannot be recovered from step indices alone; when a witness hinges
+    on dropping a LATER occurrence, set ``skip`` by hand (the recorded
+    steps say where to look)."""
+
+    src: str
+    dst: str
+    msg_type: str
+    count: int = 1
+    skip: int = 0
+    key: Optional[int] = None
+    steps: Optional[List[int]] = None
+
+
+@dataclass
+class DelayMsg:
+    """Hold matching messages for ``delay_s`` — the reordering fault."""
+
+    src: str
+    dst: str
+    msg_type: str
+    delay_s: float
+    count: int = 1
+    skip: int = 0
+    key: Optional[int] = None
+
+
+@dataclass
+class CrashWin:
+    id: str
+    t0: float
+    t1: float
+
+
+@dataclass
+class DropWin:
+    src: str
+    dst: str
+    t0: float
+    t1: float
+
+
+@dataclass
+class SlowWin:
+    src: str
+    dst: str
+    delay_s: float
+    t0: float
+    t1: float
+
+
+@dataclass
+class FlakyWin:
+    src: str
+    dst: str
+    p: float
+    t0: float
+    t1: float
+
+
+Directive = Any
+
+
+def directives_json(dirs: Sequence[Directive]) -> List[dict]:
+    return [dict(kind=type(d).__name__, **dataclasses.asdict(d))
+            for d in dirs]
+
+
+# ---- projection ---------------------------------------------------------
+def trace_msg_map(protocol: str) -> Dict[str, str]:
+    """The protocol's sim-mailbox-name -> host-message-class map
+    (``TRACE_MSG_MAP`` in its host module; {} when it has none).
+
+    Variant protocols (seeded-bug twins like ``wankeeper_nofloor``)
+    register in ``_SIM_MODULES`` pointing at the base protocol's sim
+    module, so the host module is derived from that registration — no
+    name-suffix conventions baked in here."""
+    from paxi_tpu.protocols import _HOST_MODULES, _SIM_MODULES
+    base = protocol
+    if base not in _HOST_MODULES:
+        sim_mod = _SIM_MODULES.get(protocol, "").partition(":")[0]
+        parts = sim_mod.rsplit(".", 2)
+        base = parts[-2] if len(parts) >= 2 else protocol
+    mod = _HOST_MODULES.get(base)
+    if mod is None:
+        return {}
+    return dict(getattr(importlib.import_module(mod),
+                        "TRACE_MSG_MAP", {}))
+
+
+def _runs(ts: Sequence[int]) -> List[Tuple[int, int]]:
+    """Contiguous [lo, hi] runs of a sorted step list."""
+    out: List[Tuple[int, int]] = []
+    for t in ts:
+        if out and t == out[-1][1] + 1:
+            out[-1] = (out[-1][0], t)
+        else:
+            out.append((t, t))
+    return out
+
+
+def host_directives(trace: Trace, ids: Sequence, step_s: float = 0.05,
+                    msg_map: Optional[Dict[str, str]] = None
+                    ) -> Tuple[List[Directive], Dict[str, int]]:
+    """Project ``trace`` onto host directives.  ``ids`` is the host
+    config's replica-ID list in SIM ORDER (numerically sorted — sim
+    replica r is sorted(cfg.ids)[r] under ID's (zone, node) order,
+    matching the zone-block layout both runtimes derive from the id
+    list; lexical order would misplace node/zone numbers >= 10).
+    Returns (directives, stats)."""
+    from paxi_tpu.core.ident import ID
+    ids = [str(i) for i in sorted(ID(str(i)) for i in ids)]
+    if msg_map is None:
+        msg_map = trace_msg_map(trace.protocol)
+    sched = trace.sched
+    dirs: List[Directive] = []
+    stats = {"drops": 0, "drops_unmapped": 0, "dups_skipped": 0,
+             "delays": 0, "crashes": 0, "cuts": 0}
+
+    # message drops -> occurrence-indexed DropMsg (mapped types) or
+    # coarse DropWin windows (unmapped types)
+    per_edge: Dict[Tuple[str, int, int], List[int]] = {}
+    win_edge: Dict[Tuple[int, int], List[int]] = {}
+    for name in sorted(sched["faults"]):
+        drop = np.asarray(sched["faults"][name]["drop"])
+        for t, i, j in np.argwhere(drop):
+            if name in msg_map:
+                per_edge.setdefault((msg_map[name], int(i), int(j)),
+                                    []).append(int(t))
+                stats["drops"] += 1
+            else:
+                win_edge.setdefault((int(i), int(j)), []).append(int(t))
+                stats["drops_unmapped"] += 1
+        stats["dups_skipped"] += int(
+            np.sum(np.asarray(sched["faults"][name]["dup"])))
+    for (mt, i, j), ts in sorted(per_edge.items()):
+        dirs.append(DropMsg(ids[i], ids[j], mt, count=len(ts),
+                            steps=sorted(ts)))
+    for (i, j), ts in sorted(win_edge.items()):
+        for lo, hi in _runs(sorted(set(ts))):
+            dirs.append(DropWin(ids[i], ids[j], lo * step_s,
+                                (hi + 1) * step_s))
+
+    # delays -> SlowWin per contiguous run; the per-event magnitude is
+    # the schedule's wheel depth (max_delay steps)
+    lag = max(trace.fuzz_config().max_delay - 1, 1) * step_s
+    slow_edge: Dict[Tuple[int, int], set] = {}
+    for name in sorted(sched["faults"]):
+        delay = np.asarray(sched["faults"][name]["delay"])
+        for t, i, j in np.argwhere(delay > 1):
+            slow_edge.setdefault((int(i), int(j)), set()).add(int(t))
+            stats["delays"] += 1
+    for (i, j), ts in sorted(slow_edge.items()):
+        for lo, hi in _runs(sorted(ts)):
+            dirs.append(SlowWin(ids[i], ids[j], lag, lo * step_s,
+                                (hi + 1) * step_s))
+
+    # crashes / partition cuts -> wall-clock windows
+    crashed = np.asarray(sched["crashed"])
+    for i in range(crashed.shape[1]):
+        ts = np.nonzero(crashed[:, i])[0].tolist()
+        stats["crashes"] += len(ts)
+        for lo, hi in _runs(ts):
+            dirs.append(CrashWin(ids[i], lo * step_s, (hi + 1) * step_s))
+    conn = np.asarray(sched["conn"])
+    for i in range(conn.shape[1]):
+        for j in range(conn.shape[2]):
+            if i == j:
+                continue
+            ts = np.nonzero(~conn[:, i, j])[0].tolist()
+            stats["cuts"] += len(ts)
+            for lo, hi in _runs(ts):
+                dirs.append(DropWin(ids[i], ids[j], lo * step_s,
+                                    (hi + 1) * step_s))
+    return dirs, stats
+
+
+# ---- application --------------------------------------------------------
+def _socket_of(cluster, id_str: str):
+    return cluster[id_str].socket
+
+
+def apply_immediate(cluster, dirs: Sequence[Directive]) -> None:
+    """Install the occurrence-indexed (timeless) directives now."""
+    for d in dirs:
+        if isinstance(d, DropMsg):
+            _socket_of(cluster, d.src).drop_next(
+                d.dst, d.msg_type, count=d.count, skip=d.skip, key=d.key)
+        elif isinstance(d, DelayMsg):
+            _socket_of(cluster, d.src).delay_next(
+                d.dst, d.msg_type, d.delay_s, count=d.count,
+                skip=d.skip, key=d.key)
+
+
+async def _drive_windows(dirs: Sequence[Directive], apply) -> None:
+    """One scheduling engine for both window surfaces: open each
+    windowed directive at its ``t0`` (relative to now) by awaiting
+    ``apply(directive, duration)``.  Returns once every window has been
+    opened (not when it expires)."""
+    timed = sorted((d for d in dirs
+                    if not isinstance(d, (DropMsg, DelayMsg))),
+                   key=lambda d: d.t0)
+    t_start = asyncio.get_running_loop().time()
+    for d in timed:
+        lag = d.t0 - (asyncio.get_running_loop().time() - t_start)
+        if lag > 0:
+            await asyncio.sleep(lag)
+        await apply(d, max(d.t1 - d.t0, 0.0))
+
+
+async def drive(cluster, dirs: Sequence[Directive]) -> None:
+    """Run a full directive schedule against an in-process Cluster:
+    timeless directives install immediately, windowed ones fire at
+    their ``t0`` via the Socket injection surface."""
+    apply_immediate(cluster, dirs)
+
+    async def apply(d, dur):
+        if isinstance(d, CrashWin):
+            _socket_of(cluster, d.id).crash(dur)
+        elif isinstance(d, DropWin):
+            _socket_of(cluster, d.src).drop(d.dst, dur)
+        elif isinstance(d, SlowWin):
+            _socket_of(cluster, d.src).slow(d.dst, d.delay_s * 1000.0,
+                                            dur)
+        elif isinstance(d, FlakyWin):
+            _socket_of(cluster, d.src).flaky(d.dst, d.p, dur)
+
+    await _drive_windows(dirs, apply)
+
+
+async def drive_admin(admin, dirs: Sequence[Directive]) -> None:
+    """Same schedule through the REAL AdminClient HTTP surface (the
+    soak harness path) — only windowed directives exist there."""
+    async def apply(d, dur):
+        if isinstance(d, CrashWin):
+            await admin.crash(d.id, dur)
+        elif isinstance(d, DropWin):
+            await admin.drop(d.src, d.dst, dur)
+        elif isinstance(d, SlowWin):
+            await admin.slow(d.src, d.dst, d.delay_s * 1000.0, dur)
+        elif isinstance(d, FlakyWin):
+            await admin.flaky(d.src, d.dst, d.p, dur)
+
+    await _drive_windows(dirs, apply)

@@ -1,0 +1,117 @@
+"""Pinned-schedule replay: run a trace, get the violation back.
+
+The replay runner (sim/runner.make_pinned_run) re-executes the captured
+run with the SAME seed and geometry; the traced group consumes the
+trace's recorded planes instead of PRNG draws while the other groups
+keep their drawn schedules — they are scaffolding that pins the traced
+group's workload (batched kernels draw workload per step from one run
+key shaped over all groups, so the batch context is part of the
+reproduction).  Because the recorded schedule of an unedited trace
+equals the drawn one, replaying a fresh capture is bit-for-bit the
+original run; an edited (shrunk) schedule replays just as
+deterministically, which is what makes the shrinker's oracle sound.
+
+``ReplayResult.state_hash`` fingerprints the traced group's final state
+pytree — two replays of the same trace must agree exactly, and a replay
+of an unedited capture must match the hash recorded at capture time.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+
+from paxi_tpu.sim.runner import make_pinned_run
+from paxi_tpu.sim.types import SimProtocol
+from paxi_tpu.trace.format import Trace
+
+
+@dataclass
+class ReplayResult:
+    violations: int           # traced group's total invariant violations
+    viol_steps: np.ndarray    # per-step violation counts, shape (T,)
+    state_hash: str           # fingerprint of the group's final state
+    metrics: Dict[str, int]   # whole-batch metrics (context, not oracle)
+
+    @property
+    def violated(self) -> bool:
+        return self.violations > 0
+
+    def first_violation_step(self) -> Optional[int]:
+        nz = np.nonzero(self.viol_steps)[0]
+        return int(nz[0]) if nz.size else None
+
+
+def state_hash(state) -> str:
+    """Order-, dtype- and shape-sensitive fingerprint of a pytree."""
+    h = hashlib.sha256()
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        a = np.asarray(leaf)
+        h.update(str(path).encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def resolve_protocol(name: str) -> SimProtocol:
+    from paxi_tpu.protocols import sim_protocol
+    return sim_protocol(name)
+
+
+# one compiled pinned runner per (protocol, geometry, fuzz, group);
+# distinct schedule lengths retrace under the same jit wrapper, so the
+# shrinker's many same-length trials share one executable
+_PIN_CACHE: dict = {}
+
+
+def _pinned_run(proto: SimProtocol, trace: Trace):
+    # id(proto) in the key (like runner._CONTINUE_CACHE): an explicitly
+    # passed protocol object must never be shadowed by a same-named
+    # cached compile — registry singletons still hit
+    key = (id(proto), trace.sim_config(), trace.fuzz_config(),
+           trace.group)
+    run = _PIN_CACHE.get(key)
+    if run is None:
+        run = make_pinned_run(proto, trace.sim_config(),
+                              trace.fuzz_config(), trace.group)
+        _PIN_CACHE[key] = run
+    return run
+
+
+def replay(trace: Trace, proto: Optional[SimProtocol] = None,
+           sched=None) -> ReplayResult:
+    """Replay ``trace`` (or an edited ``sched`` override against the
+    trace's provenance) and report the traced group's violations."""
+    proto = proto or resolve_protocol(trace.protocol)
+    sched = trace.sched if sched is None else sched
+    sched = jax.tree.map(jnp.asarray, sched)
+    run = _pinned_run(proto, trace)
+    state, metrics, total, viols = run(
+        jr.PRNGKey(trace.seed), trace.n_groups, sched)
+    jax.block_until_ready(total)
+    gstate = jax.tree.map(lambda x: x[trace.group], state)
+    return ReplayResult(
+        violations=int(total),
+        viol_steps=np.asarray(viols).reshape(-1),
+        state_hash=state_hash(gstate),
+        metrics={k: int(v) for k, v in metrics.items()})
+
+
+def check_determinism(trace: Trace,
+                      proto: Optional[SimProtocol] = None) -> ReplayResult:
+    """Replay twice and assert identical outcomes (the determinism
+    guarantee the whole subsystem rests on); returns the result."""
+    a = replay(trace, proto)
+    b = replay(trace, proto)
+    if a.state_hash != b.state_hash or a.violations != b.violations:
+        raise AssertionError(
+            f"non-deterministic replay: {a.violations}@{a.state_hash[:12]}"
+            f" vs {b.violations}@{b.state_hash[:12]}")
+    return a

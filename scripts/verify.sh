@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Tier-1 verification: the exact command ROADMAP.md pins, wrapped so
+# CI and humans run the same thing.  Budget: 870 s wall for the
+# 'not slow' tier (run `pytest -m slow` separately for the heavy
+# end-to-end cases, e.g. the WanKeeper trace round-trip).
+#
+#   scripts/verify.sh            # run tier-1, print DOTS_PASSED
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+  | tr -cd . | wc -c)
+exit $rc

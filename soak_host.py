@@ -24,6 +24,8 @@ from paxi_tpu.core.config import Bconfig, local_config
 from paxi_tpu.host.benchmark import Benchmark
 from paxi_tpu.host.client import AdminClient
 from paxi_tpu.host.simulation import Cluster
+from paxi_tpu.trace.host import (CrashWin, DropWin, FlakyWin,
+                                 directives_json, drive_admin)
 
 # (protocol, n, zones, crash-likely-leader-too)
 CASES = [
@@ -38,18 +40,21 @@ CASES = [
 ]
 
 
-async def inject(admin: AdminClient, ids, leader_too: bool) -> None:
-    """The fault schedule, through the admin HTTP surface."""
-    followers = [i for i in ids[1:]]
-    await asyncio.sleep(1.5)
-    await admin.crash(followers[0], 1.0)
-    await asyncio.sleep(1.0)
-    await admin.drop(followers[-1], ids[0], 0.8)
-    await asyncio.sleep(1.0)
-    await admin.flaky(ids[0], followers[0], 0.5, 1.0)
+def fault_schedule(ids, leader_too: bool):
+    """The fault schedule as trace-adapter directives — the same
+    declarative vocabulary sim traces project into (trace/host.py), so
+    a failing soak's schedule is a reproducible artifact in
+    SOAK_HOST.json rather than timing buried in code."""
+    followers = [str(i) for i in ids[1:]]
+    leader = str(ids[0])
+    dirs = [
+        CrashWin(followers[0], 1.5, 2.5),
+        DropWin(followers[-1], leader, 2.5, 3.3),
+        FlakyWin(leader, followers[0], 0.5, 3.5, 4.5),
+    ]
     if leader_too:
-        await asyncio.sleep(1.0)
-        await admin.crash(ids[0], 1.2)
+        dirs.append(CrashWin(leader, 4.5, 5.7))
+    return dirs
 
 
 async def soak_one(name: str, n: int, zones: int, leader_too: bool
@@ -61,11 +66,11 @@ async def soak_one(name: str, n: int, zones: int, leader_too: bool
     c = Cluster(name, cfg=cfg, http=True)
     await c.start()
     admin = AdminClient(cfg)
+    dirs = fault_schedule(cfg.ids, leader_too)
     try:
         bench = asyncio.create_task(Benchmark(cfg, cfg.benchmark,
                                               seed=2).run())
-        injector = asyncio.create_task(inject(admin, cfg.ids,
-                                              leader_too))
+        injector = asyncio.create_task(drive_admin(admin, dirs))
         stats = await bench
         await injector
         return {
@@ -73,6 +78,7 @@ async def soak_one(name: str, n: int, zones: int, leader_too: bool
             "leader_crash": leader_too, "ops": stats.ops,
             "errors": stats.errors, "anomalies": stats.anomalies,
             "duration_s": round(stats.duration, 2),
+            "fault_schedule": directives_json(dirs),
         }
     finally:
         admin.close()

@@ -51,6 +51,7 @@ def test_conflict_heavy_small_keyspace():
     assert int(res.metrics["executed"]) > 5
 
 
+@pytest.mark.slow  # tier-1 budget audit: ~24s, covered per-protocol
 def test_deterministic():
     r1, _ = run(groups=2, steps=30, seed=7)
     r2, _ = run(groups=2, steps=30, seed=7)
@@ -102,6 +103,7 @@ def test_long_horizon_ring():
     assert int(res.metrics["executed"]) > 2 * 5 * 3 * cfg.n_slots
 
 
+@pytest.mark.slow  # tier-1 budget audit: ~22s compile
 def test_recovery_under_drops():
     """Heavy drop schedules force recoveries even with all replicas
     alive (stalled owners look dead); safety must hold and the recovered
@@ -112,6 +114,7 @@ def test_recovery_under_drops():
     assert int(res.metrics["committed_slots"]) > 0
 
 
+@pytest.mark.slow  # tier-1 budget audit: ~22s compile
 def test_scc_blocked_by_above_window_dep():
     """An SCC member whose mate depends on an above-window instance must
     not execute ahead of that dependency (fblock propagates through

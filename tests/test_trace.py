@@ -1,0 +1,125 @@
+"""Trace subsystem: capture -> replay (bit-for-bit) -> shrink.
+
+The fast cases ride on the `fragile_counter` demo kernel (per-group
+layout, compiles in ~a second); the lane-major path is covered by the
+seeded WanKeeper bug twin in the `slow`-marked end-to-end test."""
+
+import numpy as np
+import pytest
+
+from paxi_tpu import trace as tr
+from paxi_tpu.protocols import sim_protocol
+from paxi_tpu.sim import FuzzConfig, SimConfig
+
+pytestmark = pytest.mark.jax
+
+CFG = SimConfig(n_replicas=3)
+LOSSY = FuzzConfig(p_drop=0.2, max_delay=2)
+
+
+@pytest.fixture(scope="module")
+def fragile():
+    return sim_protocol("fragile_counter")
+
+
+@pytest.fixture(scope="module")
+def captured(fragile):
+    t = tr.capture(fragile, CFG, LOSSY, seed=0, n_groups=4, n_steps=20)
+    assert t is not None, "lossy schedule must violate fragile_counter"
+    return t
+
+
+def test_capture_slices_the_violating_group(captured):
+    assert captured.protocol == "fragile_counter"
+    assert captured.n_steps == 20
+    assert captured.meta["group_violations"] > 0
+    assert captured.n_events() > 0
+    # schedule planes are single-group (T, R, R) / (T, R)
+    assert np.asarray(captured.sched["conn"]).shape == (20, 3, 3)
+    assert np.asarray(captured.sched["crashed"]).shape == (20, 3)
+
+
+def test_replay_is_deterministic_and_matches_capture(captured):
+    r = tr.check_determinism(captured)   # two replays, identical hash
+    assert r.violations == captured.meta["group_violations"]
+    # the recorded schedule IS what the original run drew, so replaying
+    # an unedited capture reproduces the captured run bit-for-bit
+    assert r.state_hash == captured.meta["capture_state_hash"]
+    assert r.first_violation_step() == captured.meta["first_violation_step"]
+
+
+def test_no_violation_no_trace(fragile):
+    t = tr.capture(fragile, CFG, FuzzConfig(), seed=0, n_groups=4,
+                   n_steps=20)
+    assert t is None
+
+
+def test_save_load_roundtrip(captured, tmp_path):
+    p = tr.save(str(tmp_path / "t"), captured)
+    t2 = tr.load(p)
+    assert t2.meta == captured.meta
+    a = tr.replay(captured)
+    b = tr.replay(t2)
+    assert a.state_hash == b.state_hash
+
+
+def test_load_rejects_foreign_and_stale_files(captured, tmp_path):
+    np.savez(tmp_path / "x.npz", a=np.zeros(3))
+    with pytest.raises(ValueError, match="not a paxi_tpu trace"):
+        tr.load(str(tmp_path / "x.npz"))
+    stale = tr.Trace(meta=dict(captured.meta, trace_version=0),
+                     sched=captured.sched)
+    p = tr.save(str(tmp_path / "stale"), stale)
+    with pytest.raises(ValueError, match="incompatible with this build"):
+        tr.load(p)
+
+
+def test_shrink_to_minimal_witness(captured):
+    mini, stats = tr.shrink(captured)
+    # a sequence gap needs exactly one fault event; the shrinker must
+    # find a witness of (at most) a couple of events from dozens
+    assert stats["events_before"] > 10
+    assert stats["events_after"] <= 2
+    assert mini.n_steps < captured.n_steps
+    assert mini.meta["shrunk"] is True
+    r = tr.check_determinism(mini)       # edited schedule: still exact
+    assert r.violated
+    assert r.state_hash == mini.meta["replay_state_hash"]
+
+
+def test_shrink_requires_a_violation(fragile):
+    clean = tr.capture(fragile, CFG, FuzzConfig(), seed=0, n_groups=4,
+                       n_steps=20, group=0)   # forced group, no faults
+    assert clean is not None
+    with pytest.raises(ValueError, match="does not reproduce"):
+        tr.shrink(clean)
+
+
+@pytest.mark.slow
+def test_wankeeper_seeded_bug_end_to_end():
+    """The acceptance round-trip on the lane-major layout: the seeded
+    WanKeeper dropped-Grant twin violates under a drop schedule, the
+    violation captures, shrinks to a tiny witness, replays bit-for-bit,
+    and projects onto host-runtime fault directives."""
+    from paxi_tpu.core.config import local_config
+    from paxi_tpu.trace import host as th
+
+    proto = sim_protocol("wankeeper_nofloor")
+    cfg = SimConfig(n_replicas=6, n_zones=2, n_objects=2, n_slots=16,
+                    locality=0.1)
+    fuzz = FuzzConfig(p_drop=0.25, max_delay=2)
+    t = tr.capture(proto, cfg, fuzz, seed=0, n_groups=16, n_steps=80)
+    assert t is not None, "seeded bug must violate under drops"
+    r = tr.check_determinism(t, proto)
+    assert r.state_hash == t.meta["capture_state_hash"]
+
+    mini, stats = tr.shrink(t, proto, max_trials=120)
+    assert stats["events_after"] < stats["events_before"] // 10
+    rm = tr.check_determinism(mini, proto)
+    assert rm.violated
+
+    dirs, dstats = th.host_directives(mini, local_config(6, zones=2).ids)
+    assert dirs, "minimal witness must project onto host directives"
+    total = sum(dstats[k] for k in
+                ("drops", "drops_unmapped", "delays", "crashes", "cuts"))
+    assert total == mini.n_events() - dstats["dups_skipped"]

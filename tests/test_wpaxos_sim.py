@@ -78,6 +78,7 @@ def test_fuzzed_safety(fuzz):
     assert int(res.violations) == 0
 
 
+@pytest.mark.slow  # heaviest compile in the suite (~60s on one core)
 def test_partition_zombie_owner_fence():
     """Regression (found by fuzz_soak.py): a deposed owner partitioned
     through later rounds, after snapshot-adopting the new owner's
