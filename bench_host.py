@@ -21,6 +21,7 @@ import time
 from paxi_tpu.core.config import Bconfig, local_config
 from paxi_tpu.host.benchmark import Benchmark
 from paxi_tpu.host.simulation import Cluster
+from paxi_tpu.metrics import merge_snapshots
 
 CONFIGS = [
     # (protocol, n, zones, linearizable?)
@@ -46,7 +47,8 @@ async def bench_one(name: str, n: int, zones: int, lin: bool) -> dict:
     await c.start()
     try:
         t0 = time.perf_counter()
-        stats = await Benchmark(cfg, cfg.benchmark, seed=1).run()
+        bench = Benchmark(cfg, cfg.benchmark, seed=1)
+        stats = await bench.run()
         dt = time.perf_counter() - t0
         return {
             "metric": f"{name}_host_ops_per_sec",
@@ -60,6 +62,16 @@ async def bench_one(name: str, n: int, zones: int, lin: bool) -> dict:
             "anomalies": (stats.anomalies if lin else None),
             "consistency": ("linearizable" if lin else "eventual"),
             "wall_s": round(dt, 2),
+            "latency": {k: v for k, v in stats.summary().items()
+                        if k.startswith("latency_")},
+            # the per-message-class evidence (paxi_tpu/metrics/): the
+            # bench registry (per-stream op latency histograms + client
+            # retries) and the node registries merged cluster-wide
+            "metrics": {
+                "bench": bench.metrics.snapshot(),
+                "cluster": merge_snapshots(
+                    r.metrics.snapshot() for r in c.replicas.values()),
+            },
         }
     finally:
         await c.stop()

@@ -28,6 +28,7 @@ import time
 
 import jax.random as jr
 
+from paxi_tpu.metrics.simcount import counters_of
 from paxi_tpu.protocols import sim_protocol
 from paxi_tpu.sim import FuzzConfig, SimConfig, make_run
 
@@ -140,6 +141,11 @@ def main(argv=None) -> int:
                     "steps": steps,
                     "violations": v,
                     "progress": int(metrics[pkey]),
+                    # the on-device message/fault counters (metrics/
+                    # simcount.py): per-message-class evidence of what
+                    # the schedule actually did to this run
+                    "counters": {k: int(vv) for k, vv
+                                 in counters_of(metrics).items()},
                     "wall_s": round(time.perf_counter() - t0, 3),
                 }
                 if v and not args.no_capture:

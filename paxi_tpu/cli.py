@@ -186,12 +186,19 @@ def cmd_trace(args) -> int:
         want = (t.meta.get("replay_state_hash")
                 if t.meta.get("shrunk") else
                 t.meta.get("capture_state_hash"))
+        # counter determinism rides along with the state hash: a replay
+        # must reproduce the recorded whole-batch message/fault counters
+        want_counts = t.meta.get("replay_counters"
+                                 if t.meta.get("shrunk") else
+                                 "capture_counters")
         ok = (r.violations == t.meta.get("group_violations", -1)
-              and (want is None or r.state_hash == want))
+              and (want is None or r.state_hash == want)
+              and (want_counts is None or r.counters == want_counts))
         print(json.dumps({
             "violations": r.violations,
             "first_violation_step": r.first_violation_step(),
             "state_hash": r.state_hash,
+            "counters": r.counters,
             "reproduced": ok,
         }))
         return 0 if ok else 1
@@ -215,6 +222,61 @@ def cmd_trace(args) -> int:
     raise AssertionError(args.trace_cmd)
 
 
+def cmd_metrics(args) -> int:
+    """Pretty-print a metrics snapshot from either source: scrape a
+    live host node's /metrics endpoint, or pull the snapshots embedded
+    in a JSON artifact (BENCH_HOST.json, FUZZ_SOAK.json, ...)."""
+    import urllib.request
+
+    from paxi_tpu.metrics import merge_snapshots, pretty
+
+    def _find_snapshots(doc, out):
+        """Walk a JSON document for metric payloads: registry snapshots
+        ({"counters": [...], "histograms": [...]}) and the sim runtime's
+        plain counter dicts ({"counters": {name: int}})."""
+        if isinstance(doc, dict):
+            c = doc.get("counters")
+            if isinstance(c, list) or isinstance(doc.get("histograms"),
+                                                 list):
+                out.append({"counters": c if isinstance(c, list) else [],
+                            "histograms": doc.get("histograms", [])})
+                return
+            if isinstance(c, dict):
+                out.append({"counters": [
+                    {"name": f"net_{k}", "labels": {}, "value": int(v)}
+                    for k, v in c.items()], "histograms": []})
+                doc = {k: v for k, v in doc.items() if k != "counters"}
+            for v in doc.values():
+                _find_snapshots(v, out)
+        elif isinstance(doc, list):
+            for v in doc:
+                _find_snapshots(v, out)
+
+    if args.url:
+        base = args.url.rstrip("/")
+        if args.raw:
+            with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+                sys.stdout.write(r.read().decode())
+            return 0
+        with urllib.request.urlopen(base + "/metrics?format=json",
+                                    timeout=10) as r:
+            snaps = [json.load(r)]
+    else:
+        if not args.file:
+            print("metrics: need -url or -file", file=sys.stderr)
+            return 2
+        with open(args.file) as f:
+            doc = json.load(f)
+        snaps = []
+        _find_snapshots(doc, snaps)
+        if not snaps:
+            print(f"metrics: no snapshots found in {args.file}",
+                  file=sys.stderr)
+            return 1
+    print(pretty(merge_snapshots(snaps)))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="paxi_tpu",
@@ -226,8 +288,9 @@ def main(argv=None) -> int:
         sp.add_argument("-n", type=int, default=3,
                         help="replicas for the default local config")
         sp.add_argument("-zones", "--zones", type=int, default=1)
+        # empty default: log.configure falls back to $PAXI_LOG_LEVEL
         sp.add_argument("-log_level", "--log-level", dest="log_level",
-                        default="info")
+                        default="")
         sp.add_argument("-log_dir", "--log-dir", dest="log_dir", default="")
 
     s = sub.add_parser("server", help="run one replica (or -simulation)")
@@ -292,6 +355,16 @@ def main(argv=None) -> int:
     tho.add_argument("-step_ms", "--step-ms", dest="step_ms",
                      type=float, default=50.0)
     t.set_defaults(fn=cmd_trace)
+
+    me = sub.add_parser("metrics",
+                        help="pretty-print metrics (live node or artifact)")
+    me.add_argument("-url", "--url", default="",
+                    help="a node's HTTP base, e.g. http://127.0.0.1:2735")
+    me.add_argument("-file", "--file", default="",
+                    help="a JSON artifact with embedded snapshots")
+    me.add_argument("-raw", "--raw", action="store_true",
+                    help="with -url: dump the Prometheus text unparsed")
+    me.set_defaults(fn=cmd_metrics)
 
     args = p.parse_args(argv)
     return args.fn(args)

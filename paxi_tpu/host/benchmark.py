@@ -21,6 +21,7 @@ from typing import Dict, List, Optional
 from paxi_tpu.core.config import Bconfig, Config
 from paxi_tpu.host.client import Client
 from paxi_tpu.host.history import History
+from paxi_tpu.metrics import Histogram, Registry
 from paxi_tpu.utils import log
 
 
@@ -67,36 +68,45 @@ class KeyGen:
 
 @dataclass
 class Stats:
-    """Latency/throughput summary (benchmark.go stat output)."""
+    """Latency/throughput summary (benchmark.go stat output).
+
+    Per-op latency lives in a fixed-bucket mergeable histogram
+    (paxi_tpu/metrics/) instead of an unbounded list — O(1) memory per
+    stream however long the run, and percentiles derive from buckets
+    (exact to one bucket's width, with exact min/max/mean)."""
 
     ops: int
     errors: int
     duration: float
-    latencies: List[float] = field(repr=False, default_factory=list)
+    hist: Histogram = field(repr=False, default_factory=Histogram)
     anomalies: Optional[int] = None
 
     @staticmethod
     def _pct(sorted_lat: List[float], p: float) -> float:
+        """Exact nearest-rank percentile of a sorted sample: the
+        smallest element with cumulative frequency >= p% — index
+        ceil(p/100*n)-1.  (The old ``int(p/100*n)`` overshot by one
+        rank for every sample size where p/100*n is fractional, e.g.
+        p50 of 10 samples picked the 6th.)"""
         if not sorted_lat:
             return 0.0
-        i = min(len(sorted_lat) - 1, int(p / 100.0 * len(sorted_lat)))
-        return sorted_lat[i]
+        i = max(math.ceil(p / 100.0 * len(sorted_lat)) - 1, 0)
+        return sorted_lat[min(i, len(sorted_lat) - 1)]
 
     def summary(self) -> Dict[str, float]:
-        lat = sorted(self.latencies)
-        mean = sum(lat) / len(lat) if lat else 0.0
+        h = self.hist
         return {
             "ops": self.ops,
             "errors": self.errors,
             "duration_s": round(self.duration, 3),
             "throughput_ops_s": round(self.ops / self.duration, 1)
             if self.duration > 0 else 0.0,
-            "latency_mean_ms": round(mean * 1e3, 3),
-            "latency_p50_ms": round(self._pct(lat, 50) * 1e3, 3),
-            "latency_p95_ms": round(self._pct(lat, 95) * 1e3, 3),
-            "latency_p99_ms": round(self._pct(lat, 99) * 1e3, 3),
-            "latency_min_ms": round((lat[0] if lat else 0.0) * 1e3, 3),
-            "latency_max_ms": round((lat[-1] if lat else 0.0) * 1e3, 3),
+            "latency_mean_ms": round(h.mean() * 1e3, 3),
+            "latency_p50_ms": round(h.percentile(50) * 1e3, 3),
+            "latency_p95_ms": round(h.percentile(95) * 1e3, 3),
+            "latency_p99_ms": round(h.percentile(99) * 1e3, 3),
+            "latency_min_ms": round(h.min * 1e3, 3),
+            "latency_max_ms": round(h.max * 1e3, 3),
             **({"anomalies": self.anomalies}
                if self.anomalies is not None else {}),
         }
@@ -111,6 +121,9 @@ class Benchmark:
         self.b = b or cfg.benchmark
         self.seed = seed
         self.history = History()
+        # per-run registry: per-stream latency series + client op/retry
+        # counters; bench_host.py embeds its snapshot in the artifact
+        self.metrics = Registry(source="bench")
 
     async def run(self) -> Stats:
         b = self.b
@@ -125,7 +138,12 @@ class Benchmark:
             rng = random.Random(self.seed * 77 + si)
             client = Client(self.cfg,
                             id=self.cfg.ids[si % len(self.cfg.ids)],
-                            client_id=f"bench-{si}")
+                            client_id=f"bench-{si}",
+                            metrics=self.metrics)
+            # one latency series per stream; merged into stats.hist at
+            # stream end (exact: shared bucket layout)
+            hist = self.metrics.histogram("paxi_op_seconds",
+                                          stream=str(si))
             n_local = 0
             try:
                 while True:
@@ -149,7 +167,7 @@ class Benchmark:
                         else:
                             out = await client.get(key)
                         e = time.time()
-                        stats.latencies.append(e - s)
+                        hist.observe(e - s)
                         stats.ops += 1
                         if b.linearizability_check:
                             self.history.add(
@@ -170,6 +188,7 @@ class Benchmark:
                         await asyncio.sleep(
                             b.concurrency / b.throttle)
             finally:
+                stats.hist.merge(hist)
                 client.close()
 
         await asyncio.gather(*(stream(i) for i in range(b.concurrency)))

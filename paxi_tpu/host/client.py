@@ -18,6 +18,7 @@ from paxi_tpu.core.config import Config
 from paxi_tpu.core.ident import ID
 from paxi_tpu.host.http import read_request  # noqa: F401 (API symmetry)
 from paxi_tpu.host.transport import parse_addr
+from paxi_tpu.metrics import Registry
 
 
 class _Conn:
@@ -65,12 +66,16 @@ class Client:
     (clients usually talk to their own zone's node, client.go)."""
 
     def __init__(self, cfg: Config, id: Optional[ID] = None,
-                 client_id: str = "c1"):
+                 client_id: str = "c1",
+                 metrics: Optional[Registry] = None):
         self.cfg = cfg
         self.id = ID(id) if id else cfg.ids[0]
         self.client_id = client_id
         self.command_id = 0
         self._conns: Dict[ID, _Conn] = {}
+        # optional: a caller-owned registry (the benchmark passes its
+        # own so per-op counters and retries aggregate per run)
+        self.metrics = metrics
 
     def _conn(self, id: ID) -> _Conn:
         if id not in self._conns:
@@ -92,14 +97,27 @@ class Client:
     async def _with_retry(self, method: str, key: Key, value: Value) -> Value:
         """Try own node first, then every other replica (client.go retry)."""
         last: Exception = IOError("no nodes configured")
+        first = True
         for id in [self.id] + [i for i in self.cfg.ids if i != self.id]:
             if id not in self.cfg.http_addrs:
                 continue
+            if not first and self.metrics is not None:
+                self.metrics.counter("paxi_client_retries_total",
+                                     client=self.client_id).inc()
+            first = False
             try:
-                return await self._rest(id, method, key, value)
+                out = await self._rest(id, method, key, value)
+                if self.metrics is not None:
+                    self.metrics.counter("paxi_client_ops_total",
+                                         client=self.client_id,
+                                         method=method).inc()
+                return out
             except (IOError, OSError, asyncio.IncompleteReadError) as e:
                 self._conns.pop(id, None)
                 last = e
+        if self.metrics is not None:
+            self.metrics.counter("paxi_client_errors_total",
+                                 client=self.client_id).inc()
         raise last
 
     async def get(self, key: Key) -> Value:

@@ -10,6 +10,10 @@ Headers:
 - request:  ``Client-Id``, ``Command-Id``, and arbitrary ``Property-*``
 - response: ``Err`` (error string, body empty) on failure
 
+Observability (paxi_tpu/metrics/):
+- ``GET /metrics``              Prometheus text (counters + histograms)
+- ``GET /metrics?format=json``  JSON snapshot of the same registry
+
 Admin (AdminClient surface):
 - ``POST /admin/crash?t=SECONDS``
 - ``POST /admin/drop?id=ZONE.NODE&t=SECONDS``
@@ -97,6 +101,21 @@ class HTTPServer:
         parts = [p for p in url.path.split("/") if p]
         if parts and parts[0] == "admin":
             return self._admin(method, parts[1:], parse_qs(url.query))
+        if parts and parts[0] == "metrics":
+            # observability scrape surface (paxi_tpu/metrics/):
+            #   GET /metrics              Prometheus text exposition
+            #   GET /metrics?format=json  JSON snapshot (same registry)
+            if method != "GET":
+                return _response(405, b"", {"Err": "GET only"})
+            q = parse_qs(url.query)
+            if q.get("format", [""])[0] == "json" or parts[1:] == ["json"]:
+                body = json.dumps(self.node.metrics.snapshot()).encode()
+                return _response(200, body,
+                                 {"Content-Type": "application/json"})
+            return _response(
+                200, self.node.metrics.prometheus().encode(),
+                {"Content-Type":
+                 "text/plain; version=0.0.4; charset=utf-8"})
         if parts and parts[0] == "local" and len(parts) == 2:
             # msg.go Read: a raw non-linearized probe of the local store
             if method != "GET":

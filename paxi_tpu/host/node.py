@@ -30,6 +30,7 @@ from paxi_tpu.core.ident import ID
 from paxi_tpu.host.codec import Codec, register_message
 from paxi_tpu.host.http import HTTPServer
 from paxi_tpu.host.socket import Socket
+from paxi_tpu.metrics import Registry
 
 
 @register_message
@@ -66,7 +67,13 @@ class Node:
     def __init__(self, id: ID, cfg: Config, codec: Optional[Codec] = None):
         self.id = ID(id)
         self.cfg = cfg
-        self.socket = Socket(self.id, cfg, codec)
+        # one registry per node, shared with the socket, exported by the
+        # node's HTTP server as GET /metrics (paxi_tpu/metrics/)
+        self.metrics = Registry(node=str(self.id))
+        # per-message-type handles resolved once: the recv loop is THE
+        # hot path and must not pay a labeled registry lookup per message
+        self._msg_metrics: Dict[str, tuple] = {}
+        self.socket = Socket(self.id, cfg, codec, metrics=self.metrics)
         self.db = Database(cfg.multi_version)
         self.handles: Dict[type, Callable[[Any], None]] = {}
         self.http: Optional[HTTPServer] = None
@@ -93,9 +100,21 @@ class Node:
         A handler exception must not kill the loop — log and keep going."""
         while True:
             msg = await self.socket.recv()
+            mname = type(msg).__name__
+            mm = self._msg_metrics.get(mname)
+            if mm is None:
+                mm = self._msg_metrics[mname] = (
+                    self.metrics.counter("paxi_msgs_in_total", type=mname),
+                    self.metrics.histogram("paxi_handler_seconds",
+                                           type=mname))
+            in_total, dispatch_hist = mm
+            in_total.inc()
             h = self.handles.get(type(msg))
             if h is None:
+                self.metrics.counter("paxi_msgs_unhandled_total",
+                                     type=mname).inc()
                 continue
+            t0 = time.perf_counter()
             try:
                 r = h(msg)
                 if asyncio.iscoroutine(r):
@@ -103,8 +122,11 @@ class Node:
             except asyncio.CancelledError:
                 raise
             except Exception:
+                self.metrics.counter("paxi_handler_errors_total",
+                                     type=mname).inc()
                 log.errorf("%s: handler for %s raised:\n%s", self.id,
                            type(msg).__name__, traceback.format_exc())
+            dispatch_hist.observe(time.perf_counter() - t0)
 
     async def stop(self) -> None:
         for t in self._tasks:
@@ -124,6 +146,7 @@ class Node:
     def handle_client_request(self, req: Request) -> None:
         """Entry from the HTTP server: dispatch into the protocol's
         registered Request handler (node.go http handler -> MessageChan)."""
+        self.metrics.counter("paxi_client_requests_total").inc()
         h = self.handles.get(Request)
         if h is None:
             req.reply(Reply(req.command, err="no Request handler registered"))
@@ -133,6 +156,7 @@ class Node:
     def forward(self, to: ID, req: Request) -> None:
         """Reference: node.go Forward — relay to ``to`` (e.g. the leader),
         remember the pending reply slot."""
+        self.metrics.counter("paxi_forwards_total").inc()
         self._fwd_seq += 1
         seq = self._fwd_seq
         self._fwd_pending[seq] = req
@@ -166,4 +190,5 @@ class Node:
     # ---- misc ----------------------------------------------------------
     def retry(self, req: Request) -> None:
         """Reference: node.go Retry — re-inject a request into dispatch."""
+        self.metrics.counter("paxi_retries_total").inc()
         self.handle_client_request(req)

@@ -21,6 +21,7 @@ from paxi_tpu.core.config import Config
 from paxi_tpu.core.ident import ID
 from paxi_tpu.host.codec import Codec
 from paxi_tpu.host.transport import Transport, listen, new_transport
+from paxi_tpu.metrics import Registry
 
 
 @dataclass
@@ -48,10 +49,19 @@ class MsgMatcher:
 
 
 class Socket:
-    def __init__(self, id: ID, cfg: Config, codec: Optional[Codec] = None):
+    def __init__(self, id: ID, cfg: Config, codec: Optional[Codec] = None,
+                 metrics: Optional[Registry] = None):
         self.id = ID(id)
         self.cfg = cfg
         self.codec = codec or Codec("pickle")
+        # shared with the owning Node so sends/drops/faults land in the
+        # same exported registry; standalone sockets get their own
+        self.metrics = metrics if metrics is not None else Registry(
+            node=str(self.id))
+        # per-type send counters resolved once (send is a hot path;
+        # drop/delay outcomes only occur under injected faults and pay
+        # the registry lookup)
+        self._out_counters: Dict[str, Any] = {}
         self.inbox: asyncio.Queue = asyncio.Queue()
         self._peers: Dict[ID, Transport] = {}
         self._server = None
@@ -71,6 +81,8 @@ class Socket:
 
     def _deliver(self, msg: Any) -> None:
         if time.monotonic() < self._crashed_until:
+            self.metrics.counter("paxi_msgs_recv_dropped_total",
+                                 reason="crashed").inc()
             return  # crashed: receives suppressed too
         self.inbox.put_nowait(msg)
 
@@ -89,21 +101,38 @@ class Socket:
         """Reference: socket.go Send — lazily dial, consult fault state,
         silently drop to crashed/dropped peers."""
         to = ID(to)
+        mname = type(msg).__name__
+        met = self.metrics
+        out_total = self._out_counters.get(mname)
+        if out_total is None:
+            out_total = self._out_counters[mname] = met.counter(
+                "paxi_msgs_out_total", type=mname)
+        out_total.inc()
         now = time.monotonic()
         if now < self._crashed_until:
+            met.counter("paxi_msgs_dropped_total", type=mname,
+                        reason="crashed").inc()
             return
         if now < self._drop_until.get(to, 0.0):
+            met.counter("paxi_msgs_dropped_total", type=mname,
+                        reason="drop_window").inc()
             return
         act = self._consume_match(to, msg)
         if act == "drop":
+            met.counter("paxi_msgs_dropped_total", type=mname,
+                        reason="matcher").inc()
             return
         extra = act[1] if isinstance(act, tuple) else 0.0
         p, until = self._flaky.get(to, (0.0, 0.0))
         if now < until and self._rng.random() < p:
+            met.counter("paxi_msgs_dropped_total", type=mname,
+                        reason="flaky").inc()
             return
         t = self._peers.get(to)
         if t is None:
             if to not in self.cfg.addrs:
+                met.counter("paxi_msgs_dropped_total", type=mname,
+                            reason="unknown_peer").inc()
                 return
             t = new_transport(self.cfg.addrs[to], self.codec,
                               self.cfg.buffer_size)
@@ -112,6 +141,7 @@ class Socket:
         delay, until = self._slow.get(to, (0.0, 0.0))
         delay = extra + (delay if now < until else 0.0)
         if delay > 0:
+            met.counter("paxi_msgs_delayed_total", type=mname).inc()
             asyncio.get_event_loop().call_later(delay, t.send, msg)
         else:
             t.send(msg)
@@ -161,6 +191,8 @@ class Socket:
         return act
 
     def add_matcher(self, m: MsgMatcher) -> None:
+        self.metrics.counter("paxi_faults_injected_total",
+                             kind="matcher").inc()
         self._matchers.append(m)
 
     def drop_next(self, to: ID, msg_type: str, count: int = 1,
@@ -180,13 +212,21 @@ class Socket:
 
     # ---- fault injection (socket.go Crash/Drop/Slow/Flaky) -------------
     def crash(self, t: float) -> None:
+        self.metrics.counter("paxi_faults_injected_total",
+                             kind="crash").inc()
         self._crashed_until = time.monotonic() + t
 
     def drop(self, to: ID, t: float) -> None:
+        self.metrics.counter("paxi_faults_injected_total",
+                             kind="drop").inc()
         self._drop_until[ID(to)] = time.monotonic() + t
 
     def slow(self, to: ID, delay_ms: float, t: float) -> None:
+        self.metrics.counter("paxi_faults_injected_total",
+                             kind="slow").inc()
         self._slow[ID(to)] = (delay_ms / 1000.0, time.monotonic() + t)
 
     def flaky(self, to: ID, p: float, t: float) -> None:
+        self.metrics.counter("paxi_faults_injected_total",
+                             kind="flaky").inc()
         self._flaky[ID(to)] = (p, time.monotonic() + t)

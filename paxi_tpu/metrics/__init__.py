@@ -1,0 +1,30 @@
+"""Unified metrics layer: one model, two backends.
+
+The source paper's whole method is *dissecting* replication-protocol
+performance, and latency distributions / per-message-class counters —
+not means — are what expose pathologies ("Performance of Paxos in the
+Cloud", PAPERS.md).  This package gives both runtimes one metrics
+vocabulary:
+
+- **Host backend** (`registry.py`, stdlib-only — no jax import): a
+  registry of labeled counters and fixed-bucket log-spaced latency
+  histograms.  All histograms share ONE bucket layout, so merging is
+  exact bucket-count addition — per-stream series merge into per-run
+  series, per-node series merge into per-cluster series.  Exported as
+  Prometheus text (`GET /metrics`) and a JSON snapshot
+  (`GET /metrics?format=json`) from the node HTTP server.
+- **Sim backend** (`simcount.py`): integer counter reductions threaded
+  through the jitted scan body (delivered / dropped / duplicated /
+  delayed messages, crash and partition mask occupancy), folded into
+  the run's metrics dict under the ``net_`` prefix, summed across
+  shards by `parallel/mesh.py`, and preserved bit-for-bit by trace
+  capture/replay — counter equality between a recorded run and its
+  pinned replay is a determinism check on top of the state hash.
+"""
+
+from paxi_tpu.metrics.registry import (HIST_BOUNDS, Counter, Histogram,
+                                       Registry, merge_snapshots,
+                                       parse_prometheus, pretty)
+
+__all__ = ["Counter", "Histogram", "Registry", "HIST_BOUNDS",
+           "merge_snapshots", "parse_prometheus", "pretty"]

@@ -1,0 +1,89 @@
+"""Sim-side metrics backend: on-device counters inside the scan body.
+
+The sim runtime can't call a Python registry from inside a jitted
+lock-step round, so its counters are integer reductions computed in
+``runner._group_step`` and threaded out of the scan as per-step
+outputs: every step contributes one int32 per counter (summed over the
+whole group batch), ``runner.finish_run`` sums over time and folds the
+totals into the run's metrics dict under the ``net_`` prefix, and
+``parallel/mesh.py``'s psum adds them across shards like any other
+metric.
+
+Determinism contract: the counts are pure functions of (inbox, outbox,
+fault planes, fault masks) — no extra PRNG draws — and the fault-plane
+terms use the same effective-event predicate as the trace recorder
+(``drop & valid & live``), so a pinned replay of an unedited capture
+reports byte-identical counters.  Counter equality between capture and
+replay is therefore a determinism check alongside the state hash.
+
+Counters are flow-per-run (a resumed segment counts its own segment),
+int32 like every other sim metric.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+NET_PREFIX = "net_"
+
+# the fixed counter vocabulary (stripped names, as surfaced on
+# SimResult.counters / trace meta / FUZZ_SOAK.json records)
+COUNTER_NAMES = ("msgs_sent", "msgs_delivered", "msgs_dropped",
+                 "msgs_duplicated", "msgs_delayed", "crash_steps",
+                 "cut_edge_steps")
+
+
+def step_counts(inbox, outbox, faults, fs, n: int
+                ) -> Dict[str, jax.Array]:
+    """One lock-step round's counter increments, summed over the whole
+    batch (per-group under vmap — the caller sums the group axis).
+
+    - ``msgs_sent``: protocol outbox emissions (pre-fault).
+    - ``msgs_delivered``: wheel slots popped into this step's inbox.
+    - ``msgs_dropped/duplicated/delayed``: EFFECTIVE fault events —
+      masked by ``valid & live`` exactly like the trace recorder's
+      neutralization, so schedule noise on empty edges never counts.
+    - ``crash_steps`` / ``cut_edge_steps``: fault-mask occupancy
+      (replica-steps crashed, directed-edge-steps severed).
+    """
+    # function-local: sim.runner imports this module, so a top-level
+    # sim.mailbox import would cycle through the sim package __init__
+    from paxi_tpu.sim import mailbox as mb
+
+    sample = next(iter(outbox.values()))["valid"]
+    live = mb.live_mask(fs, sample.ndim, n)
+
+    def tot(x):
+        return jnp.sum(x, dtype=jnp.int32)
+
+    sent = sum(tot(b["valid"]) for b in outbox.values())
+    delivered = sum(tot(b["valid"]) for b in inbox.values())
+    dropped = jnp.int32(0)
+    duplicated = jnp.int32(0)
+    delayed = jnp.int32(0)
+    for name in sorted(outbox.keys()):
+        valid = outbox[name]["valid"] & live
+        f = faults[name]
+        dropped = dropped + tot(f["drop"] & valid)
+        kept = valid & ~f["drop"]
+        duplicated = duplicated + tot(f["dup"] & kept)
+        delayed = delayed + tot((f["delay"] > 1) & kept)
+    return {
+        NET_PREFIX + "msgs_sent": sent,
+        NET_PREFIX + "msgs_delivered": delivered,
+        NET_PREFIX + "msgs_dropped": dropped,
+        NET_PREFIX + "msgs_duplicated": duplicated,
+        NET_PREFIX + "msgs_delayed": delayed,
+        NET_PREFIX + "crash_steps": tot(fs["crashed"]),
+        NET_PREFIX + "cut_edge_steps": tot(~fs["conn"]),
+    }
+
+
+def counters_of(metrics: Dict) -> Dict:
+    """Strip the runner's counters out of a metrics dict (prefix
+    removed) — the public ``SimResult.counters`` view."""
+    return {k[len(NET_PREFIX):]: v for k, v in metrics.items()
+            if k.startswith(NET_PREFIX)}

@@ -24,6 +24,14 @@ import jax.random as jr
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+# jax >= 0.5 promotes shard_map to the top level and adds the
+# varying-manual-axes (vma) carry typing that needs lax.pcast; on
+# older jax the experimental entry point works without either
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
+_HAS_VMA = hasattr(jax.lax, "pcast") and hasattr(jax, "typeof")
+
 from paxi_tpu.sim.runner import finish_run, init_carry, make_scan_body
 from paxi_tpu.sim.types import FAULT_FREE, FuzzConfig, SimConfig, SimProtocol
 
@@ -54,22 +62,29 @@ def make_sharded_run(proto: SimProtocol, cfg: SimConfig,
         g_local = n_groups // n_dev
 
         @functools.partial(
-            jax.shard_map, mesh=mesh,
+            _shard_map, mesh=mesh,
             in_specs=P(axis),
             out_specs=(P(axis), P(), P()))
         def sharded(rngs):
             carry = init_carry(proto, cfg, fuzz, g_local, rngs[0])
             # zero-initialized leaves are mesh-invariant; mark them as
             # varying over the shard axis so the scan carry types match
+            # (a no-op on jax builds without the vma type system)
             def _vary(x):
+                if not _HAS_VMA:
+                    return x
                 if axis in getattr(jax.typeof(x), "vma", frozenset()):
                     return x
                 return jax.lax.pcast(x, (axis,), to="varying")
             carry = jax.tree.map(_vary, carry)
-            carry, viols = jax.lax.scan(body, carry, jnp.arange(n_steps))
+            carry, (viols, counts) = jax.lax.scan(body, carry,
+                                                  jnp.arange(n_steps))
             # the shared aggregation tail (group-major public state for
-            # either layout), then reduce across shards
-            state, metrics, viol = finish_run(proto, cfg, carry, viols)
+            # either layout), then reduce across shards — the psum
+            # covers the runner's ``net_*`` counters too, so sharded
+            # runs report whole-batch message/fault totals
+            state, metrics, viol = finish_run(proto, cfg, carry, viols,
+                                              counts)
             metrics = {k: jax.lax.psum(v, axis) for k, v in metrics.items()}
             viol = jax.lax.psum(viol, axis)
             return state, metrics, viol

@@ -376,3 +376,14 @@ class PaxosReplica(Node):
 
 def new_replica(id: ID, cfg: Config) -> PaxosReplica:
     return PaxosReplica(ID(id), cfg)
+
+
+# sim mailbox name -> host message class, for the cross-runtime trace
+# projection (trace/host.py).  Unlike wankeeper's map this one is a
+# wire-level identity: the sim kernel's five mailbox planes are exactly
+# the host runtime's five message classes, so a minimized sim witness
+# ("the run where THIS P2a vanished") projects onto deterministic
+# Socket.drop_next directives with no schedule homomorphism caveats.
+TRACE_MSG_MAP = {
+    "p1a": "P1a", "p1b": "P1b", "p2a": "P2a", "p2b": "P2b", "p3": "P3",
+}

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
+from paxi_tpu.metrics.simcount import counters_of, step_counts
 from paxi_tpu.sim import lanes
 from paxi_tpu.sim import mailbox as mb
 from paxi_tpu.sim.types import (FAULT_FREE, FuzzConfig, SimConfig,
@@ -33,10 +34,17 @@ from paxi_tpu.sim.types import (FAULT_FREE, FuzzConfig, SimConfig,
 @dataclass
 class SimResult:
     state: Any                   # final batched state pytree (G leading)
-    metrics: Dict[str, jax.Array]  # aggregated over groups
+    metrics: Dict[str, jax.Array]  # aggregated over groups (protocol
+    # metrics + the runner's ``net_*`` message/fault counters)
     violations: jax.Array        # total invariant violations (int32)
     steps: int
     groups: int
+
+    @property
+    def counters(self) -> Dict[str, jax.Array]:
+        """Per-run message/fault counters threaded through the scan
+        (see paxi_tpu/metrics/simcount.py), prefix stripped."""
+        return counters_of(self.metrics)
 
 
 def init_carry(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
@@ -111,6 +119,11 @@ def _group_step(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
                        for k, v in f.items()}
                 for name, f in faults.items()}
     wheel = ops.wheel_insert(wheel, outbox, fs, fuzz, faults)
+    # on-device metrics carry: pure reductions over the same planes
+    # delivery consumed — AFTER the sched_t substitution, so a pinned
+    # replay counts the recorded schedule and reproduces the captured
+    # counters exactly (see metrics/simcount.py)
+    counts = step_counts(inbox, outbox, faults, fs, cfg.n_replicas)
     if record and proto.batched:
         viol = per_group_invariants(proto, cfg, state, new_state)
     else:
@@ -133,8 +146,8 @@ def _group_step(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
             for name, f in faults.items()}
         sched = {"conn": fs["conn"], "crashed": fs["crashed"],
                  "faults": rec_faults}
-        return (new_state, wheel, fs, rng), (viol, sched)
-    return (new_state, wheel, fs, rng), viol
+        return (new_state, wheel, fs, rng), (viol, counts, sched)
+    return (new_state, wheel, fs, rng), (viol, counts)
 
 
 def per_group_invariants(proto: SimProtocol, cfg: SimConfig, old, new):
@@ -164,27 +177,33 @@ def make_scan_body(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig):
         return step1
 
     def body(carry, t):
-        carry, viol = jax.vmap(step1, in_axes=(0, None))(carry, t)
-        return carry, jnp.sum(viol)
+        carry, (viol, counts) = jax.vmap(step1, in_axes=(0, None))(carry, t)
+        return carry, (jnp.sum(viol),
+                       {k: jnp.sum(v) for k, v in counts.items()})
 
     return body
 
 
-def finish_run(proto: SimProtocol, cfg: SimConfig, carry, viols):
-    """Shared aggregation tail: per-group metrics summed over groups.
-    One implementation for both the straight and the resumed path, so
-    checkpointed runs can never diverge from uninterrupted ones — and
-    part of the runner's cross-module contract (parallel/mesh.py calls
-    it inside each shard).  Lane-major kernels aggregate internally;
-    their final state is transposed back to the public group-major
-    layout (one cheap transpose per run, outside the hot loop)."""
+def finish_run(proto: SimProtocol, cfg: SimConfig, carry, viols,
+               counts=None):
+    """Shared aggregation tail: per-group metrics summed over groups,
+    plus the scan's per-step ``net_*`` counters summed over time and
+    folded into the metrics dict.  One implementation for both the
+    straight and the resumed path, so checkpointed runs can never
+    diverge from uninterrupted ones — and part of the runner's
+    cross-module contract (parallel/mesh.py calls it inside each
+    shard).  Lane-major kernels aggregate internally; their final state
+    is transposed back to the public group-major layout (one cheap
+    transpose per run, outside the hot loop)."""
     state = carry[0]
+    net = ({k: jnp.sum(v) for k, v in counts.items()}
+           if counts is not None else {})
     if proto.batched:
-        metrics = proto.metrics(state, cfg)
+        metrics = {**proto.metrics(state, cfg), **net}
         state = jax.tree.map(lambda x: jnp.moveaxis(x, -1, 0), state)
         return state, metrics, jnp.sum(viols)
     per_group = jax.vmap(lambda s: proto.metrics(s, cfg))(state)
-    metrics = {k: jnp.sum(v) for k, v in per_group.items()}
+    metrics = {**{k: jnp.sum(v) for k, v in per_group.items()}, **net}
     return state, metrics, jnp.sum(viols)
 
 
@@ -200,8 +219,9 @@ def make_run(proto: SimProtocol, cfg: SimConfig,
     @functools.partial(jax.jit, static_argnums=(1, 2))
     def run(rng, n_groups: int, n_steps: int):
         carry = init_carry(proto, cfg, fuzz, n_groups, rng)
-        carry, viols = jax.lax.scan(body, carry, jnp.arange(n_steps))
-        return finish_run(proto, cfg, carry, viols)
+        carry, (viols, counts) = jax.lax.scan(body, carry,
+                                              jnp.arange(n_steps))
+        return finish_run(proto, cfg, carry, viols, counts)
 
     return run
 
@@ -223,15 +243,19 @@ def make_recorded_run(proto: SimProtocol, cfg: SimConfig,
         body = step1
     else:
         def body(carry, t):
-            carry, ys = jax.vmap(step1, in_axes=(0, None))(carry, t)
-            return carry, ys
+            carry, (viol, counts, sched) = jax.vmap(
+                step1, in_axes=(0, None))(carry, t)
+            return carry, (viol,
+                           {k: jnp.sum(v) for k, v in counts.items()},
+                           sched)
 
     @functools.partial(jax.jit, static_argnums=(1, 2))
     def run(rng, n_groups: int, n_steps: int):
         carry = init_carry(proto, cfg, fuzz, n_groups, rng)
-        carry, (viols, sched) = jax.lax.scan(body, carry,
-                                             jnp.arange(n_steps))
-        state, metrics, total = finish_run(proto, cfg, carry, viols)
+        carry, (viols, counts, sched) = jax.lax.scan(body, carry,
+                                                     jnp.arange(n_steps))
+        state, metrics, total = finish_run(proto, cfg, carry, viols,
+                                           counts)
         return state, metrics, total, viols, sched
 
     return run
@@ -255,25 +279,27 @@ def make_pinned_run(proto: SimProtocol, cfg: SimConfig,
         t, sched_t = xt
         old_state = carry[0]
         if proto.batched:
-            carry, _ = _group_step(proto, cfg, fuzz, carry, t,
-                                   sched_t=sched_t, pin_on=group)
+            carry, (_, counts) = _group_step(proto, cfg, fuzz, carry, t,
+                                             sched_t=sched_t, pin_on=group)
             viol_g = proto.invariants(jax.tree.map(sl, old_state),
                                       jax.tree.map(sl, carry[0]), cfg)
-            return carry, viol_g
+            return carry, (viol_g, counts)
         gidx = jnp.arange(jax.tree_util.tree_leaves(old_state)[0].shape[0])
-        carry, viol = jax.vmap(
+        carry, (viol, counts) = jax.vmap(
             lambda cg, on: _group_step(proto, cfg, fuzz, cg, t,
                                        sched_t=sched_t, pin_on=on),
             in_axes=(0, 0))(carry, gidx == group)
-        return carry, viol[group]
+        return carry, (viol[group],
+                       {k: jnp.sum(v) for k, v in counts.items()})
 
     @functools.partial(jax.jit, static_argnums=(1,))
     def run(rng, n_groups: int, sched):
         carry = init_carry(proto, cfg, fuzz, n_groups, rng)
         n_steps = jax.tree_util.tree_leaves(sched)[0].shape[0]
-        carry, viols = jax.lax.scan(body, carry,
-                                    (jnp.arange(n_steps), sched))
-        state, metrics, total = finish_run(proto, cfg, carry, viols)
+        carry, (viols, counts) = jax.lax.scan(body, carry,
+                                              (jnp.arange(n_steps), sched))
+        state, metrics, total = finish_run(proto, cfg, carry, viols,
+                                           counts)
         return state, metrics, total, viols
 
     return run
@@ -300,7 +326,9 @@ def continue_run(proto: SimProtocol, cfg: SimConfig, carry,
     seam — see sim/checkpoint.py).  ``t0`` is the absolute step index the
     carry was paused at (a traced operand, so resuming at a new offset
     reuses the compiled executable); resumed runs are bit-for-bit
-    identical to uninterrupted ones.  Returns (SimResult, new_carry)."""
+    identical to uninterrupted ones.  Returns (SimResult, new_carry).
+    Note the ``net_*`` counters are flow-per-segment (this call's
+    steps), unlike the state-derived protocol metrics."""
     key = (id(proto), cfg, fuzz)
     run = _CONTINUE_CACHE.get(key)
     if run is None:
@@ -308,9 +336,9 @@ def continue_run(proto: SimProtocol, cfg: SimConfig, carry,
 
         @functools.partial(jax.jit, static_argnums=(2,))
         def run(carry, t0, n_steps: int):
-            carry, viols = jax.lax.scan(body, carry,
-                                        t0 + jnp.arange(n_steps))
-            return carry, *finish_run(proto, cfg, carry, viols)
+            carry, (viols, counts) = jax.lax.scan(body, carry,
+                                                  t0 + jnp.arange(n_steps))
+            return carry, *finish_run(proto, cfg, carry, viols, counts)
 
         _CONTINUE_CACHE[key] = run
     carry, state, metrics, viols = run(carry, jnp.int32(t0), n_steps)

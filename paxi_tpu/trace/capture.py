@@ -78,10 +78,16 @@ def capture(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
     gstate = jax.tree.map(lambda x: x[g], state)  # finish_run: G leading
     gviols = viols[:, g]
     nz = np.nonzero(gviols)[0]
+    from paxi_tpu.metrics.simcount import counters_of
     meta = make_meta(
         proto_name or proto.name, cfg, fuzz, seed, n_groups, g,
         group_violations=int(gviols.sum()),
         first_violation_step=int(nz[0]) if nz.size else -1,
         capture_state_hash=_replay.state_hash(gstate),
+        # whole-batch message/fault counters: a pinned replay of this
+        # (unedited) trace must reproduce them exactly — the counter
+        # half of the determinism check (metrics/simcount.py)
+        capture_counters={k: int(v)
+                          for k, v in counters_of(metrics).items()},
         shrunk=False)
     return Trace(meta=meta, sched=gsched)

@@ -43,6 +43,15 @@ class ReplayResult:
     def violated(self) -> bool:
         return self.violations > 0
 
+    @property
+    def counters(self) -> Dict[str, int]:
+        """Whole-batch message/fault counters (``net_*`` metrics, prefix
+        stripped).  For an unedited capture these must equal the trace's
+        ``capture_counters`` meta — the counter half of the determinism
+        guarantee."""
+        from paxi_tpu.metrics.simcount import counters_of
+        return counters_of(self.metrics)
+
     def first_violation_step(self) -> Optional[int]:
         nz = np.nonzero(self.viol_steps)[0]
         return int(nz[0]) if nz.size else None
@@ -114,4 +123,8 @@ def check_determinism(trace: Trace,
         raise AssertionError(
             f"non-deterministic replay: {a.violations}@{a.state_hash[:12]}"
             f" vs {b.violations}@{b.state_hash[:12]}")
+    if a.counters != b.counters:
+        raise AssertionError(
+            f"non-deterministic replay counters: {a.counters} "
+            f"vs {b.counters}")
     return a

@@ -168,6 +168,7 @@ def shrink(trace: Trace, proto: Optional[SimProtocol] = None,
         group_violations=base.violations,
         first_violation_step=base.first_violation_step(),
         replay_state_hash=base.state_hash,
+        replay_counters=dict(base.counters),
         shrink_stats={"steps_before": steps0, "events_before": events0,
                       "replays": trials})
     stats = {

@@ -17,10 +17,16 @@ _logger = logging.getLogger("paxi_tpu")
 _configured = False
 
 
-def configure(level: str = "info", log_dir: Optional[str] = None,
+def configure(level: Optional[str] = None, log_dir: Optional[str] = None,
               stdout: bool = True, tag: str = "") -> None:
-    """Reference: log.Setup from flags (-log_level, -log_dir, -log_stdout)."""
+    """Reference: log.Setup from flags (-log_level, -log_dir, -log_stdout).
+
+    ``level=None`` (or "") falls back to the ``PAXI_LOG_LEVEL`` env var,
+    then "info" — so driver scripts get leveled logging from the
+    environment without each re-implementing flag plumbing."""
     global _configured
+    if not level:
+        level = os.environ.get("PAXI_LOG_LEVEL", "info")
     _logger.setLevel(getattr(logging, level.upper(), logging.INFO))
     _logger.handlers.clear()
     fmt = logging.Formatter(
@@ -62,3 +68,13 @@ def warningf(fmt: str, *a) -> None:
 def errorf(fmt: str, *a) -> None:
     _ensure()
     _logger.error(fmt, *a)
+
+
+def metrics_dump(source, header: str = "metrics") -> None:
+    """Log a metrics snapshot (a Registry or its ``snapshot()`` dict) as
+    aligned info lines — one shared implementation so the driver
+    scripts don't each reinvent metrics printing."""
+    snap = source.snapshot() if hasattr(source, "snapshot") else source
+    from paxi_tpu.metrics import pretty  # local: utils must stay light
+    for line in pretty(snap).splitlines():
+        infof("%s| %s", header, line)

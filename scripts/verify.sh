@@ -5,8 +5,17 @@
 # end-to-end cases, e.g. the WanKeeper trace round-trip).
 #
 #   scripts/verify.sh            # run tier-1, print DOTS_PASSED
+#   scripts/verify.sh --metrics  # prepend the observability smoke stage
+#                                # (5 s chan bench + /metrics scrape)
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+if [ "${1:-}" = "--metrics" ]; then
+  shift
+  echo "== metrics smoke (scripts/metrics_smoke.py) =="
+  timeout -k 10 180 env JAX_PLATFORMS=cpu \
+    python scripts/metrics_smoke.py || exit $?
+fi
 
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -24,6 +24,7 @@ from paxi_tpu.core.config import Bconfig, local_config
 from paxi_tpu.host.benchmark import Benchmark
 from paxi_tpu.host.client import AdminClient
 from paxi_tpu.host.simulation import Cluster
+from paxi_tpu.metrics import merge_snapshots
 from paxi_tpu.trace.host import (CrashWin, DropWin, FlakyWin,
                                  directives_json, drive_admin)
 
@@ -68,8 +69,8 @@ async def soak_one(name: str, n: int, zones: int, leader_too: bool
     admin = AdminClient(cfg)
     dirs = fault_schedule(cfg.ids, leader_too)
     try:
-        bench = asyncio.create_task(Benchmark(cfg, cfg.benchmark,
-                                              seed=2).run())
+        b = Benchmark(cfg, cfg.benchmark, seed=2)
+        bench = asyncio.create_task(b.run())
         injector = asyncio.create_task(drive_admin(admin, dirs))
         stats = await bench
         await injector
@@ -78,7 +79,17 @@ async def soak_one(name: str, n: int, zones: int, leader_too: bool
             "leader_crash": leader_too, "ops": stats.ops,
             "errors": stats.errors, "anomalies": stats.anomalies,
             "duration_s": round(stats.duration, 2),
+            "latency": {k: v for k, v in stats.summary().items()
+                        if k.startswith("latency_")},
             "fault_schedule": directives_json(dirs),
+            # under-fault evidence (paxi_tpu/metrics/): per-stream op
+            # latency + client retries, and the cluster's per-node
+            # message/drop/fault counters merged into one snapshot
+            "metrics": {
+                "bench": b.metrics.snapshot(),
+                "cluster": merge_snapshots(
+                    r.metrics.snapshot() for r in c.replicas.values()),
+            },
         }
     finally:
         admin.close()

@@ -52,6 +52,38 @@ def test_zipfian_skew():
     assert top[0][1] > counts.get(40, 0) * 5
 
 
+def test_pct_nearest_rank_exact():
+    """_pct is nearest-rank: index ceil(p/100*n)-1.  The old
+    int(p/100*n) overshot by one rank whenever p*n/100 was integral-
+    free territory, e.g. p50 of 10 samples returned the 6th value."""
+    from paxi_tpu.host.benchmark import Stats
+    ten = [float(i) for i in range(1, 11)]
+    assert Stats._pct(ten, 50) == 5.0      # was 6.0 with the biased index
+    assert Stats._pct(ten, 90) == 9.0
+    assert Stats._pct(ten, 91) == 10.0
+    assert Stats._pct(ten, 100) == 10.0
+    assert Stats._pct([1.0, 2.0], 50) == 1.0
+    assert Stats._pct([7.0], 99) == 7.0
+    assert Stats._pct([], 50) == 0.0
+    # p99 of 200 samples: rank ceil(198) = 198 -> index 197
+    two_hundred = [float(i) for i in range(200)]
+    assert Stats._pct(two_hundred, 99) == 197.0
+
+
+def test_stats_summary_from_histogram():
+    from paxi_tpu.host.benchmark import Stats
+    s = Stats(ops=3, errors=0, duration=2.0)
+    for v in (0.001, 0.002, 0.050):
+        s.hist.observe(v)
+    out = s.summary()
+    assert out["ops"] == 3 and out["throughput_ops_s"] == 1.5
+    assert out["latency_min_ms"] == 1.0
+    assert out["latency_max_ms"] == 50.0
+    assert out["latency_mean_ms"] == pytest.approx(17.667, abs=0.01)
+    # p99 lands in the top sample's bucket (one-bucket resolution)
+    assert 30.0 <= out["latency_p99_ms"] <= 50.0
+
+
 def test_closed_loop_benchmark_paxos():
     async def main():
         c = Cluster("paxos", n=3)

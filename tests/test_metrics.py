@@ -1,0 +1,255 @@
+"""Unified metrics layer (paxi_tpu/metrics/): histogram model and
+mergeability, registry export (Prometheus + JSON), the node /metrics
+endpoint against a live chan cluster, and sim-counter determinism
+between a recorded run and its pinned replay."""
+
+import asyncio
+import json
+import random
+
+import pytest
+
+from paxi_tpu.metrics import (HIST_BOUNDS, Histogram, Registry,
+                              merge_snapshots, parse_prometheus, pretty)
+
+
+# ---- histogram model ----------------------------------------------------
+def test_histogram_basic_stats():
+    h = Histogram()
+    for v in (0.001, 0.002, 0.004, 0.008, 0.1):
+        h.observe(v)
+    assert h.count == 5
+    assert h.sum == pytest.approx(0.115)
+    assert h.min == 0.001 and h.max == 0.1
+    assert h.mean() == pytest.approx(0.023)
+
+
+def test_histogram_percentile_within_bucket_resolution():
+    h = Histogram()
+    vals = [random.Random(7).uniform(0.001, 0.5) for _ in range(2000)]
+    for v in vals:
+        h.observe(v)
+    vals.sort()
+    for p in (50, 90, 95, 99):
+        exact = vals[max(-(-p * len(vals) // 100) - 1, 0)]
+        got = h.percentile(p)
+        # one log-spaced bucket is a 10^(1/6) ~ 1.47x band; the
+        # geometric-midpoint answer must land within one band
+        assert exact / 1.5 <= got <= exact * 1.5, (p, exact, got)
+    assert h.percentile(100) == h.max
+
+
+def test_histogram_merge_is_exact():
+    rng = random.Random(3)
+    a, b, both = Histogram(), Histogram(), Histogram()
+    for i in range(500):
+        v = rng.expovariate(100)
+        (a if i % 2 else b).observe(v)
+        both.observe(v)
+    a.merge(b)
+    assert a.counts == both.counts
+    assert a.count == both.count
+    assert a.sum == pytest.approx(both.sum)
+    assert a.min == both.min and a.max == both.max
+    for p in (50, 95, 99):
+        assert a.percentile(p) == both.percentile(p)
+
+
+def test_histogram_snapshot_roundtrip():
+    h = Histogram()
+    for v in (1e-7, 0.003, 2.5, 5000.0):   # underflow + overflow bands
+        h.observe(v)
+    h2 = Histogram.from_snapshot(
+        json.loads(json.dumps(h.to_snapshot())))
+    assert h2.counts == h.counts
+    assert h2.count == h.count and h2.min == h.min and h2.max == h.max
+    with pytest.raises(ValueError, match="scheme"):
+        Histogram.from_snapshot({"scheme": "other", "buckets": {},
+                                 "count": 0, "sum": 0})
+
+
+def test_bounds_are_log_spaced_and_shared():
+    ratios = {round(HIST_BOUNDS[i + 1] / HIST_BOUNDS[i], 6)
+              for i in range(len(HIST_BOUNDS) - 1)}
+    assert len(ratios) == 1          # constant growth factor
+    assert HIST_BOUNDS[0] < 2e-6 and HIST_BOUNDS[-1] > 100.0
+
+
+# ---- registry export ----------------------------------------------------
+def test_registry_prometheus_parses_and_is_cumulative():
+    reg = Registry(node="1.1")
+    reg.counter("paxi_msgs_in_total", type="P2a").inc(3)
+    reg.counter("paxi_msgs_in_total", type="P3").inc()
+    h = reg.histogram("paxi_handler_seconds", type="P2a")
+    for v in (0.001, 0.002, 0.2):
+        h.observe(v)
+    samples = parse_prometheus(reg.prometheus())
+    assert ("paxi_msgs_in_total", {"node": "1.1", "type": "P2a"}, 3.0) \
+        in samples
+    buckets = [(s[1]["le"], s[2]) for s in samples
+               if s[0] == "paxi_handler_seconds_bucket"]
+    assert buckets[-1][0] == "+Inf" and buckets[-1][1] == 3.0
+    cum = [v for _, v in buckets]
+    assert cum == sorted(cum)        # cumulative counts are monotone
+    assert ("paxi_handler_seconds_count",
+            {"node": "1.1", "type": "P2a"}, 3.0) in samples
+
+
+def test_merge_snapshots_aggregates_series():
+    regs = [Registry(), Registry()]
+    for reg in regs:
+        reg.counter("ops", kind="w").inc(5)
+        reg.histogram("lat").observe(0.01)
+    merged = merge_snapshots(r.snapshot() for r in regs)
+    assert merged["counters"] == [
+        {"name": "ops", "labels": {"kind": "w"}, "value": 10}]
+    assert merged["histograms"][0]["count"] == 2
+    out = pretty(merged)
+    assert "ops" in out and "lat" in out
+
+
+# ---- the /metrics endpoint on a live cluster ----------------------------
+@pytest.mark.host
+def test_metrics_endpoint_live_chan_cluster():
+    from paxi_tpu.core.config import local_config
+    from paxi_tpu.host.client import Client, _Conn
+    from paxi_tpu.host.simulation import Cluster
+
+    async def scrape(url_base: str, path: str) -> bytes:
+        conn = _Conn(url_base)
+        try:
+            status, _, payload = await conn.request("GET", path, {}, b"")
+            assert status == 200
+            return payload
+        finally:
+            conn.close()
+
+    async def main():
+        cfg = local_config(3, base_port=18830)
+        cfg.addrs = {i: f"chan://metrics-test/{i}" for i in cfg.addrs}
+        c = Cluster("paxos", cfg=cfg)
+        await c.start()
+        try:
+            client = Client(cfg, client_id="m1")
+            for k in range(8):
+                await client.put(k, b"v")
+                assert await client.get(k) == b"v"
+            client.close()
+
+            base = cfg.http_addrs[cfg.ids[0]]
+            text = (await scrape(base, "/metrics")).decode()
+            samples = parse_prometheus(text)
+            assert samples, "empty scrape"
+            by_name = {}
+            for name, labels, v in samples:
+                by_name.setdefault(name, []).append((labels, v))
+            # message-count counters by class, with the node label
+            ins = by_name["paxi_msgs_in_total"]
+            assert all(lb["node"] == "1.1" for lb, _ in ins)
+            assert {lb["type"] for lb, _ in ins} >= {"P2b"}
+            assert sum(v for _, v in ins) > 0
+            assert sum(v for _, v in by_name["paxi_msgs_out_total"]) > 0
+            # at least one latency histogram with consistent count
+            assert "paxi_handler_seconds_count" in by_name
+            infs = [v for lb, v in by_name["paxi_handler_seconds_bucket"]
+                    if lb["le"] == "+Inf"]
+            assert sum(infs) == sum(
+                v for _, v in by_name["paxi_handler_seconds_count"])
+
+            # JSON variant serves the same registry
+            snap = json.loads(await scrape(base, "/metrics?format=json"))
+            assert snap["counters"] and snap["histograms"]
+            total_in = sum(
+                cc["value"] for cc in snap["counters"]
+                if cc["name"] == "paxi_msgs_in_total")
+            assert total_in == sum(v for _, v in ins)
+
+            # per-node registries merge cluster-wide (exact buckets)
+            merged = merge_snapshots(
+                r.metrics.snapshot() for r in c.replicas.values())
+            nodes = {cc["labels"]["node"] for cc in merged["counters"]}
+            assert nodes == {"1.1", "1.2", "1.3"}
+        finally:
+            await c.stop()
+
+    asyncio.run(main())
+
+
+# ---- sim counters: surface + capture/replay determinism -----------------
+def test_simresult_counters_property():
+    from paxi_tpu.sim import SimResult
+
+    res = SimResult(state=None,
+                    metrics={"committed_slots": 7, "net_msgs_sent": 5,
+                             "net_msgs_dropped": 2},
+                    violations=0, steps=1, groups=1)
+    assert res.counters == {"msgs_sent": 5, "msgs_dropped": 2}
+
+
+@pytest.mark.jax
+@pytest.mark.slow  # tier-1 budget: one extra make_run compile; the
+# counter *values* under fuzz are covered by the roundtrip test
+def test_sim_counters_on_simresult():
+    from paxi_tpu.protocols import sim_protocol
+    from paxi_tpu.sim import FuzzConfig, SimConfig, simulate
+
+    cfg = SimConfig(n_replicas=3, n_slots=16)
+    fuzz = FuzzConfig(p_drop=0.2, p_dup=0.1, max_delay=2)
+    res = simulate(sim_protocol("paxos_pg"), cfg, 4, 40, fuzz=fuzz,
+                   seed=3)
+    c = {k: int(v) for k, v in res.counters.items()}
+    assert c["msgs_sent"] > 0
+    assert 0 < c["msgs_delivered"] <= c["msgs_sent"]
+    assert c["msgs_dropped"] > 0 and c["msgs_duplicated"] > 0
+    assert c["msgs_delayed"] > 0
+    assert c["crash_steps"] == 0 and c["cut_edge_steps"] == 0
+
+
+def _assert_counter_roundtrip(name: str):
+    """Capture's whole-batch counters reproduce exactly under pinned
+    replay — the counter half of the determinism guarantee."""
+    from paxi_tpu import trace as tr
+    from paxi_tpu.protocols import sim_protocol
+    from paxi_tpu.sim import FuzzConfig, SimConfig
+
+    proto = sim_protocol(name)
+    cfg = SimConfig(n_replicas=3, n_slots=16)
+    fuzz = FuzzConfig(p_drop=0.25, p_dup=0.1, max_delay=2)
+    t = tr.capture(proto, cfg, fuzz, 3, 4, 30, group=1, proto_name=name)
+    want = t.meta["capture_counters"]
+    assert want["msgs_dropped"] > 0 and want["msgs_sent"] > 0
+    r = tr.check_determinism(t, proto)
+    assert r.counters == want, name
+    assert r.state_hash == t.meta["capture_state_hash"]
+
+
+@pytest.mark.jax
+def test_sim_counters_recorded_equals_pinned_replay():
+    _assert_counter_roundtrip("paxos_pg")       # vmapped layout
+
+
+@pytest.mark.jax
+@pytest.mark.slow  # tier-1 budget: second kernel layout, ~2 compiles
+def test_sim_counters_roundtrip_lane_major():
+    _assert_counter_roundtrip("paxos")
+
+
+@pytest.mark.jax
+@pytest.mark.slow  # tier-1 budget: one sharded compile on the 8-dev mesh
+def test_sharded_run_reports_counters():
+    import jax.random as jr
+
+    from paxi_tpu.parallel import make_mesh, make_sharded_run
+    from paxi_tpu.protocols import sim_protocol
+    from paxi_tpu.sim import FuzzConfig, SimConfig
+
+    run = make_sharded_run(sim_protocol("paxos"),
+                           SimConfig(n_replicas=3, n_slots=16),
+                           fuzz=FuzzConfig(p_drop=0.2),
+                           mesh=make_mesh(8))
+    _, metrics, viol = run(jr.PRNGKey(0), 16, 30)
+    assert int(viol) == 0
+    assert int(metrics["net_msgs_sent"]) > 0
+    assert int(metrics["net_msgs_dropped"]) > 0
+    assert int(metrics["net_msgs_delivered"]) < int(
+        metrics["net_msgs_sent"])

@@ -26,6 +26,8 @@ def test_sharded_matches_metrics_shape():
     assert int(metrics["has_leader"]) == 16
 
 
+@pytest.mark.slow  # tier-1 budget: compiles BOTH a sharded and an
+# unsharded run; the other sharding tests stay in tier-1
 def test_sharded_equals_unsharded_totals():
     """Same aggregate behavior sharded vs single-device (different per-
     group rng streams, so compare invariants + coarse totals)."""

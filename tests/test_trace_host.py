@@ -41,6 +41,43 @@ def test_host_projection_orders_ids_numerically():
     assert [d.id for d in dirs] == ["1.2"]
 
 
+def test_paxos_trace_msg_map_projects_drops():
+    """Paxos now carries a TRACE_MSG_MAP (ROADMAP divergence-hunting
+    item): every sim mailbox plane maps to a real host message class,
+    so recorded log-plane drops become deterministic DropMsg directives
+    instead of coarse DropWin windows."""
+    import numpy as np
+
+    from paxi_tpu.core.config import local_config
+    from paxi_tpu.protocols.paxos import host as paxos_host
+    from paxi_tpu.protocols.paxos.sim import mailbox_spec
+    from paxi_tpu.sim import FuzzConfig, SimConfig
+    from paxi_tpu.trace.format import Trace, make_meta
+    from paxi_tpu.trace.host import host_directives, trace_msg_map
+
+    m = trace_msg_map("paxos")
+    # total: every sim plane maps, every target class really exists
+    assert set(m) == set(mailbox_spec(SimConfig()))
+    for host_cls in m.values():
+        assert isinstance(getattr(paxos_host, host_cls), type)
+
+    R, T = 3, 4
+    sched = {"conn": np.ones((T, R, R), bool),
+             "crashed": np.zeros((T, R), bool),
+             "faults": {name: {"drop": np.zeros((T, R, R), bool),
+                               "delay": np.ones((T, R, R), np.int32),
+                               "dup": np.zeros((T, R, R), bool)}
+                        for name in m}}
+    sched["faults"]["p2a"]["drop"][1, 0, 2] = True   # 1.1 -> 1.3
+    sched["faults"]["p3"]["drop"][2, 0, 1] = True    # 1.1 -> 1.2
+    t = Trace(meta=make_meta("paxos", SimConfig(n_replicas=R),
+                             FuzzConfig(), 0, 1, 0), sched=sched)
+    dirs, stats = host_directives(t, local_config(R).ids)
+    assert stats["drops"] == 2 and stats["drops_unmapped"] == 0
+    got = {(d.src, d.dst, d.msg_type) for d in dirs}
+    assert got == {("1.1", "1.3", "P2a"), ("1.1", "1.2", "P3")}
+
+
 def run(coro):
     return asyncio.run(coro)
 
