@@ -1,0 +1,115 @@
+"""paxi-lint: protocol-aware static analysis for the two runtimes.
+
+Four AST rule families over the repo, each exploiting an invariant the
+architecture already promises (see each module's docstring):
+
+- ``kernel-purity``        (purity.py,      PXK1xx)
+- ``handler-completeness`` (handlers.py,    PXH2xx)
+- ``trace-map``            (tracemap.py,    PXT3xx)
+- ``host-concurrency``     (concurrency.py, PXC4xx)
+
+Entry points: ``python -m paxi_tpu lint [--rule ...] [--json]`` (cli.py)
+and :func:`run_lint` for tests/tooling.  Intentional exceptions live in
+``analysis/baseline.toml``; one-line escapes use an inline
+``# paxi-lint: disable=CODE`` comment.  Purely static — no module under
+analysis is ever imported, so the linter needs no jax and is safe on
+broken code.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from paxi_tpu.analysis import astutil, concurrency, handlers, purity, \
+    tracemap
+from paxi_tpu.analysis.model import (LintReport, Suppression, Violation,
+                                     apply_suppressions, inline_disables,
+                                     load_baseline)
+
+__all__ = ["RULES", "DEFAULT_BASELINE", "LintReport", "Suppression",
+           "Violation", "repo_root", "run_lint"]
+
+# rule family name -> module exposing check(root, files=None)
+RULES = {
+    purity.RULE: purity,
+    handlers.RULE: handlers,
+    tracemap.RULE: tracemap,
+    concurrency.RULE: concurrency,
+}
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.toml"
+
+
+def repo_root() -> Path:
+    """The directory holding the ``paxi_tpu`` package."""
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def _target_files(root: Path, rule_mod,
+                  paths: Sequence[Path]) -> List[Path]:
+    """A rule's default file set restricted to ``paths`` (files or
+    directories), plus any explicitly named file outside the rule's
+    globs — that is how fixture tests drive a rule over seeded
+    modules."""
+    dirs = [p.resolve() for p in paths if p.is_dir()]
+    files = {p.resolve() for p in paths if p.is_file()}
+    defaults = list(astutil.iter_py(root, getattr(rule_mod, "TARGETS", ())))
+    wanted = [p for p in defaults
+              if p.resolve() in files
+              or any(str(p.resolve()).startswith(str(d) + "/")
+                     for d in dirs)]
+    default_set = {p.resolve() for p in defaults}
+    wanted += [Path(f) for f in sorted(files - default_set)]
+    return sorted(set(wanted))
+
+
+def run_lint(root: Optional[Path] = None,
+             rules: Optional[Sequence[str]] = None,
+             baseline_path: Optional[Path] = DEFAULT_BASELINE,
+             paths: Optional[Sequence[Path]] = None) -> LintReport:
+    """Run the selected rule families and apply both suppression
+    layers.  ``baseline_path=None`` disables the baseline (the
+    "show me everything" mode)."""
+    root = (root or repo_root()).resolve()
+    selected = list(rules) if rules else list(RULES)
+    unknown = [r for r in selected if r not in RULES]
+    if unknown:
+        raise KeyError(f"unknown rule(s) {unknown}; have {sorted(RULES)}")
+    if paths is not None:
+        missing = [str(p) for p in paths if not Path(p).exists()]
+        if missing:
+            raise ValueError(f"no such path(s): {', '.join(missing)}")
+
+    raw: List[Violation] = []
+    checked: set = set()
+    for name in selected:
+        mod = RULES[name]
+        if name == tracemap.RULE:
+            # pair-based, registry-driven: restriction matches the sim
+            # or host module, directories match their subtrees
+            for protocol, sp, hp in tracemap.analyzed_pairs(root, paths):
+                raw.extend(tracemap.check_pair(protocol, sp, hp, root))
+                checked.update((sp, hp))
+            continue
+        files = (None if paths is None
+                 else _target_files(root, mod, paths))
+        raw.extend(mod.check(root, files=files))
+        checked.update(files if files is not None
+                       else astutil.iter_py(root, mod.TARGETS))
+
+    baseline = (load_baseline(baseline_path)
+                if baseline_path is not None else [])
+    inline: Dict[str, Dict[int, set]] = {}
+    for path in {v.path for v in raw}:
+        try:
+            inline[path] = inline_disables((root / path).read_text())
+        except OSError:
+            inline[path] = {}
+    kept, dropped = apply_suppressions(raw, baseline, inline)
+    # stale-baseline warnings only make sense when every rule ran over
+    # the whole tree — a restricted run never exercises most entries
+    complete = paths is None and set(selected) == set(RULES)
+    unused = [s for s in baseline if not s.used] if complete else []
+    return LintReport(violations=kept, suppressed=dropped,
+                      unused_baseline=unused, checked_files=len(checked))

@@ -1,0 +1,211 @@
+"""Host-concurrency rule family (PXC4xx) — a lightweight race lint.
+
+The host runtime is asyncio-first (one task per node), but a few
+shared structures are also touched from real threads: the Database is
+hit by HTTP worker contexts and benchmark executors, and anything that
+grows a ``threading.Lock`` is *declaring* itself cross-thread shared.
+For such a class the locking discipline is mechanical — every mutation
+of ``self`` state happens inside ``with self._lock:`` — and mechanical
+discipline is what a linter can hold forever, long after the original
+author stops looking (the cloud-Paxos experience report's category of
+"implementation diverges from the obviously-intended protocol").
+
+Scope is deliberately narrow to stay true-positive-heavy: only classes
+that themselves create a ``threading.Lock``/``RLock``/``Condition``
+(or ``asyncio.Lock``) attribute are checked; ``__init__`` is exempt
+(the object is not shared yet); nested function bodies are skipped
+(deferred callbacks run under whatever discipline their call site
+has).
+
+Checks:
+
+- **PXC401** assignment / augmented assignment / deletion of a
+  ``self`` attribute (or an item of one) outside the lock
+- **PXC402** a mutating container call (``self.x.append(...)``,
+  ``.pop``, ``.update``, ``.clear``, ...) outside the lock
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Optional, Sequence, Set, Tuple
+
+from paxi_tpu.analysis import astutil
+from paxi_tpu.analysis.model import Violation
+
+RULE = "host-concurrency"
+
+TARGETS = (
+    "paxi_tpu/**/*.py",
+)
+
+LOCK_FACTORIES = frozenset({
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition", "asyncio.Lock",
+})
+
+MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "remove", "pop",
+    "popleft", "popitem", "clear", "update", "setdefault", "add",
+    "discard", "sort", "reverse",
+})
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``x`` for ``self.x`` (possibly through subscripts:
+    ``self.x[k]`` -> ``x``)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Names of self attributes bound to lock objects anywhere in the
+    class body."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        factory = astutil.dotted_name(node.value.func)
+        if factory not in LOCK_FACTORIES:
+            continue
+        for t in node.targets:
+            attr = _self_attr(t)
+            if attr is not None:
+                out.add(attr)
+    return out
+
+
+def _acquires_lock(node: ast.With, lock_attrs: Set[str]) -> bool:
+    for item in node.items:
+        expr = item.context_expr
+        # both `with self._lock:` and `with self._lock.something():`
+        attr = _self_attr(expr)
+        if attr is None and isinstance(expr, ast.Call):
+            attr = _self_attr(expr.func)
+            if attr is None and isinstance(expr.func, ast.Attribute):
+                attr = _self_attr(expr.func.value)
+        if attr in lock_attrs:
+            return True
+    return False
+
+
+class _MethodChecker:
+    def __init__(self, relpath: str, cls: str, method: str,
+                 lock_attrs: Set[str]):
+        self.relpath = relpath
+        self.cls = cls
+        self.method = method
+        self.lock_attrs = lock_attrs
+        self.out: List[Violation] = []
+
+    def _add(self, code: str, node: ast.AST, msg: str) -> None:
+        self.out.append(Violation(
+            rule=RULE, code=code, path=self.relpath,
+            line=node.lineno, col=node.col_offset,
+            message=f"{msg} in `{self.cls}.{self.method}` outside "
+                    f"`with self.{sorted(self.lock_attrs)[0]}` — the "
+                    "class declares itself cross-thread shared by "
+                    "owning that lock"))
+
+    def _check_write_target(self, target: ast.AST, node: ast.AST) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._check_write_target(elt, node)
+            return
+        attr = _self_attr(target)
+        if attr is not None and attr not in self.lock_attrs:
+            self._add("PXC401", node,
+                      f"unlocked write to `self.{attr}`")
+
+    def _check_stmt(self, stmt: ast.stmt, locked: bool) -> None:
+        if isinstance(stmt, astutil.FuncNode):
+            return   # deferred body: locking judged at its call site
+        if isinstance(stmt, ast.With) and not locked and \
+                _acquires_lock(stmt, self.lock_attrs):
+            for s in stmt.body:
+                self._check_stmt(s, True)
+            return
+        if not locked:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    self._check_write_target(t, stmt)
+            elif isinstance(stmt, ast.AugAssign) or (
+                    isinstance(stmt, ast.AnnAssign)
+                    and stmt.value is not None):
+                self._check_write_target(stmt.target, stmt)
+            elif isinstance(stmt, ast.Delete):
+                for t in stmt.targets:
+                    self._check_write_target(t, stmt)
+            # mutating calls inside any expression of this statement
+            for node in ast.iter_child_nodes(stmt):
+                if isinstance(node, ast.expr):
+                    self._check_expr(node)
+        # recurse into compound statements, carrying the lock state
+        for name in ("body", "orelse", "finalbody"):
+            for s in getattr(stmt, name, []) or []:
+                if isinstance(s, ast.stmt):
+                    self._check_stmt(s, locked)
+        for h in getattr(stmt, "handlers", []) or []:
+            for s in h.body:
+                self._check_stmt(s, locked)
+
+    def _check_expr(self, expr: ast.expr) -> None:
+        deferred: Set[int] = set()   # lambda bodies run at their call site
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Lambda):
+                for sub in ast.walk(node):
+                    if sub is not node:
+                        deferred.add(id(sub))
+        for node in ast.walk(expr):
+            if id(node) in deferred:
+                continue
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in MUTATORS:
+                attr = _self_attr(node.func.value)
+                if attr is not None and attr not in self.lock_attrs:
+                    self._add(
+                        "PXC402", node,
+                        f"unlocked mutating call "
+                        f"`self.{attr}.{node.func.attr}(...)`")
+
+    def run(self, fn: ast.AST) -> List[Violation]:
+        for stmt in fn.body:
+            self._check_stmt(stmt, False)
+        return self.out
+
+
+def check_file(path: Path, root: Path) -> List[Violation]:
+    relpath = astutil.rel(path, root)
+    tree, _ = astutil.parse_file(path)
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        lock_attrs = _lock_attrs(node)
+        if not lock_attrs:
+            continue
+        for item in node.body:
+            if not isinstance(item, astutil.FuncNode):
+                continue
+            if item.name == "__init__":
+                continue
+            out.extend(_MethodChecker(relpath, node.name, item.name,
+                                      lock_attrs).run(item))
+    return out
+
+
+def check(root: Path,
+          files: Optional[Sequence[Path]] = None) -> List[Violation]:
+    paths = (list(files) if files is not None
+             else list(astutil.iter_py(root, TARGETS)))
+    out: List[Violation] = []
+    for p in paths:
+        out.extend(check_file(p, root))
+    return out

@@ -1,0 +1,303 @@
+"""Kernel-purity rule family (PXK1xx).
+
+The sim runtime's contract is that everything inside ``jax.jit`` /
+``lax.scan`` bodies is a *pure function of traced arrays*: Python
+nondeterminism (wall clocks, PRNGs outside ``jax.random``, set
+iteration order, object identity) silently bakes a value in at trace
+time — the classic way a "deterministic" kernel stops replaying
+bit-for-bit (see trace/capture.py's guarantee).
+
+Statically we find the kernel surface per module:
+
+- functions decorated with / passed to ``jax.jit``, ``jax.vmap``,
+  ``shard_map``, ``lax.scan|map|cond|while_loop|fori_loop|switch``
+  (including ``functools.partial(jax.jit, ...)`` decorators);
+- functions wired into a ``SimProtocol(...)`` plugin (``init_state``,
+  ``step``, ``metrics``, ``invariants`` — ``mailbox_spec`` runs at
+  config time and is excluded);
+- every top-level function of the kernel-library modules
+  (``sim/mailbox.py``, ``sim/lanes.py``, ``sim/ring.py``, ...), which
+  only ever execute under a caller's trace;
+
+then take the closure over module-local references, so helpers called
+from a kernel are kernels too.  Host-side code in the same files
+(``make_mesh``, checkpoint IO, the lincheck fallback) is untouched.
+
+Checks:
+
+- **PXK101** nondeterministic call (``time.*``, ``random.*``,
+  ``np.random.*``, ``datetime.*``, ``uuid.*``, ``os.urandom``, ...)
+- **PXK102** ``np.`` / ``numpy.`` usage where ``jnp`` is required
+- **PXK103** iteration over a ``set()``/``frozenset()``/set literal
+  (unordered -> trace-order nondeterminism)
+- **PXK104** Python ``if``/``while``/``assert`` branching on a traced
+  expression (a ``jnp.``/``lax.`` call in the test) — raises a
+  ``TracerBoolConversionError`` at best, freezes one branch at worst
+- **PXK105** float64 creep (``jnp.float64``/``np.float64``/"float64")
+  — x64 is disabled on TPU; these silently become float32 or upcast
+  the whole kernel under ``jax_enable_x64``
+- **PXK106** ``id()``/``hash()`` of traced values (object identity is
+  not a kernel fact; cf. the host-side cache key in sim/runner.py,
+  which is deliberately outside the kernel)
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from paxi_tpu.analysis import astutil
+from paxi_tpu.analysis.model import Violation
+
+RULE = "kernel-purity"
+
+# file globs (repo-relative) holding kernel or kernel-adjacent code
+TARGETS = (
+    "paxi_tpu/protocols/*/sim*.py",
+    "paxi_tpu/sim/*.py",
+    "paxi_tpu/ops/*.py",
+    "paxi_tpu/parallel/*.py",
+    "paxi_tpu/metrics/simcount.py",
+    "paxi_tpu/trace/demo.py",
+)
+
+# modules whose every top-level function is kernel code (they exist to
+# be called inside someone else's jit/scan)
+KERNEL_LIB_MODULES = frozenset({
+    "paxi_tpu/sim/mailbox.py",
+    "paxi_tpu/sim/lanes.py",
+    "paxi_tpu/sim/ring.py",
+    "paxi_tpu/sim/ballot_ring.py",
+    "paxi_tpu/ops/closure.py",
+    "paxi_tpu/ops/hashing.py",
+    "paxi_tpu/metrics/simcount.py",
+})
+
+# call targets that make their function arguments traced code
+TRACE_ENTRY = frozenset({
+    "jax.jit", "jit", "jax.vmap", "vmap", "jax.pmap", "pmap",
+    "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "lax.scan", "jax.lax.map", "lax.map",
+    "jax.lax.cond", "lax.cond", "jax.lax.switch", "lax.switch",
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.associative_scan", "lax.associative_scan",
+    "shard_map", "_shard_map", "jax.shard_map",
+})
+
+# SimProtocol kwargs that are traced plugin entry points
+PROTOCOL_TRACED_KWARGS = frozenset({
+    "init_state", "step", "metrics", "invariants",
+})
+
+BANNED_CALL_PREFIXES = (
+    "time.", "random.", "np.random.", "numpy.random.", "datetime.",
+    "uuid.", "secrets.",
+)
+BANNED_CALLS = frozenset({"os.urandom", "os.getrandom"})
+
+TRACED_PREFIXES = ("jnp.", "jax.numpy.", "lax.", "jax.lax.")
+
+
+def _protocol_roots(tree: ast.Module,
+                    funcs: Dict[str, List[ast.AST]]) -> List[ast.AST]:
+    """Functions wired as traced SimProtocol plugin entry points."""
+    roots: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = astutil.dotted_name(node.func)
+        if name is None or name.split(".")[-1] != "SimProtocol":
+            continue
+        for kw in node.keywords:
+            if kw.arg in PROTOCOL_TRACED_KWARGS and \
+                    isinstance(kw.value, ast.Name):
+                roots.extend(funcs.get(kw.value.id, []))
+    return roots
+
+
+def _trace_entry_roots(tree: ast.Module,
+                       funcs: Dict[str, List[ast.AST]]) -> List[ast.AST]:
+    roots: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if isinstance(node, astutil.FuncNode):
+            decs = astutil.decorator_names(node)
+            if any(d in TRACE_ENTRY for d in decs):
+                roots.append(node)
+        if not isinstance(node, ast.Call):
+            continue
+        name = astutil.dotted_name(node.func)
+        if name not in TRACE_ENTRY:
+            # functools.partial(jax.jit, ...)(f) and partial(f, ...)
+            # feeding scan are caught via decorators / name references
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name):
+                roots.extend(funcs.get(arg.id, []))
+            elif isinstance(arg, ast.Lambda):
+                roots.append(arg)
+    return roots
+
+
+def _enclosing(fn: ast.AST) -> str:
+    return getattr(fn, "name", "<lambda>")
+
+
+class _KernelChecker:
+    def __init__(self, relpath: str, fn_name: str):
+        self.relpath = relpath
+        self.fn = fn_name
+        self.out: List[Violation] = []
+        self._claimed: set = set()   # Attribute ids consumed by Call checks
+
+    def _add(self, code: str, node: ast.AST, msg: str) -> None:
+        self.out.append(Violation(
+            rule=RULE, code=code, path=self.relpath,
+            line=node.lineno, col=node.col_offset,
+            message=f"{msg} (in kernel function `{self.fn}`)"))
+
+    # -- individual checks ------------------------------------------------
+    def check_call(self, node: ast.Call) -> None:
+        name = astutil.dotted_name(node.func)
+        if name is None:
+            return
+        if name in ("id", "hash"):
+            self._add("PXK106", node,
+                      f"`{name}()` of a value inside a jitted kernel — "
+                      "object identity is a trace-time accident")
+            return
+        if name in BANNED_CALLS or \
+                any(name.startswith(p) for p in BANNED_CALL_PREFIXES):
+            self._claim_chain(node.func)
+            self._add("PXK101", node,
+                      f"nondeterministic call `{name}()` inside a jitted "
+                      "kernel — bakes a trace-time value into the "
+                      "compiled computation")
+            return
+        if name.startswith(("np.", "numpy.")):
+            self._claim_chain(node.func)
+            self._add("PXK102", node,
+                      f"`{name}()` inside a jitted kernel — use `jnp` "
+                      "(numpy ops silently constant-fold traced values "
+                      "or fall back to host)")
+
+    def _claim_chain(self, node: ast.AST) -> None:
+        while isinstance(node, ast.Attribute):
+            self._claimed.add(id(node))
+            node = node.value
+
+    def check_attribute(self, node: ast.Attribute) -> None:
+        if id(node) in self._claimed:
+            return
+        name = astutil.dotted_name(node)
+        if name is None:
+            return
+        if node.attr in ("float64", "double") and \
+                name.split(".")[0] in ("np", "numpy", "jnp", "jax"):
+            self._add("PXK105", node,
+                      f"`{name}` in kernel code — float64 creep (x64 is "
+                      "disabled on TPU; this silently degrades or "
+                      "upcasts)")
+            return
+        if name.startswith(("np.", "numpy.")) and \
+                not name.startswith(("np.random.", "numpy.random.")):
+            # non-call attribute use (np.int32 dtype args etc.)
+            if isinstance(getattr(node, "ctx", None), ast.Load):
+                self._add("PXK102", node,
+                          f"`{name}` referenced inside a jitted kernel — "
+                          "use the `jnp` equivalent")
+
+    def check_iteration(self, node: ast.AST) -> None:
+        iters = []
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            iters = [node.iter]
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            iters = [g.iter for g in node.generators]
+        for it in iters:
+            if isinstance(it, ast.Set):
+                self._add("PXK103", it,
+                          "iteration over a set literal in kernel code — "
+                          "unordered iteration makes trace order "
+                          "nondeterministic")
+            elif isinstance(it, ast.Call):
+                name = astutil.dotted_name(it.func)
+                if name in ("set", "frozenset"):
+                    self._add("PXK103", it,
+                              f"iteration over `{name}()` in kernel code "
+                              "— wrap in `sorted(...)` for a "
+                              "deterministic trace order")
+
+    def check_branch(self, node: ast.AST) -> None:
+        test = getattr(node, "test", None)
+        if test is None:
+            return
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Call):
+                name = astutil.dotted_name(sub.func)
+                if name and name.startswith(TRACED_PREFIXES):
+                    kind = type(node).__name__.lower()
+                    self._add("PXK104", node,
+                              f"Python `{kind}` on a traced expression "
+                              f"(`{name}(...)`) — use `jnp.where`/"
+                              "`lax.cond`; a Python branch freezes one "
+                              "side at trace time or raises under jit")
+                    return
+
+    def check_constant(self, node: ast.Constant) -> None:
+        if node.value == "float64":
+            self._add("PXK105", node,
+                      "\"float64\" dtype string in kernel code — float64 "
+                      "creep (x64 is disabled on TPU)")
+
+    # -- driver -----------------------------------------------------------
+    def run(self, fn: ast.AST) -> List[Violation]:
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    self.check_call(node)
+                elif isinstance(node, ast.Attribute):
+                    self.check_attribute(node)
+                elif isinstance(node, ast.Constant):
+                    self.check_constant(node)
+                if isinstance(node, (ast.For, ast.AsyncFor, ast.ListComp,
+                                     ast.SetComp, ast.DictComp,
+                                     ast.GeneratorExp)):
+                    self.check_iteration(node)
+                if isinstance(node, (ast.If, ast.While, ast.Assert,
+                                     ast.IfExp)):
+                    self.check_branch(node)
+        return self.out
+
+
+def check_file(path: Path, root: Path) -> List[Violation]:
+    relpath = astutil.rel(path, root)
+    tree, _ = astutil.parse_file(path)
+    funcs = astutil.collect_functions(tree)
+    roots: List[ast.AST] = []
+    roots += _trace_entry_roots(tree, funcs)
+    roots += _protocol_roots(tree, funcs)
+    if relpath in KERNEL_LIB_MODULES:
+        roots += [n for n in tree.body if isinstance(n, astutil.FuncNode)]
+    kernel_fns = astutil.reachable_functions(roots, funcs)
+    seen: set = set()
+    out: List[Violation] = []
+    for fn in kernel_fns:
+        for v in _KernelChecker(relpath, _enclosing(fn)).run(fn):
+            key = (v.path, v.line, v.col, v.code)
+            if key not in seen:
+                seen.add(key)
+                out.append(v)
+    return out
+
+
+def check(root: Path,
+          files: Optional[Sequence[Path]] = None) -> List[Violation]:
+    paths = (list(files) if files is not None
+             else list(astutil.iter_py(root, TARGETS)))
+    out: List[Violation] = []
+    for p in paths:
+        out.extend(check_file(p, root))
+    return out

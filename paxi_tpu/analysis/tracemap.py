@@ -1,0 +1,206 @@
+"""Trace-map coverage rule family (PXT3xx).
+
+Cross-runtime replay (trace/host.py) projects a sim trace's
+per-mailbox fault schedule onto host ``Socket`` directives through the
+protocol's ``TRACE_MSG_MAP`` (sim mailbox name -> host message class).
+Every *unmapped* mailbox degrades to a coarse time-window drop — the
+projection still runs, but the witness loses its occurrence-indexed
+precision, which is exactly the ROADMAP divergence-hunting item.  A
+*missing* map disables the projection entirely.
+
+This rule closes the loop statically, without importing jax or any
+protocol module:
+
+- the protocol registry (``protocols/__init__.py``) is parsed for the
+  ``_SIM_MODULES`` / ``_HOST_MODULES`` dict literals, applying the same
+  variant-derivation rule as ``trace/host.py:trace_msg_map`` (a sim
+  protocol not in ``_HOST_MODULES`` projects through its base
+  protocol's host module — e.g. ``paxos_pg`` and
+  ``wankeeper_nofloor``);
+- the sim module's ``mailbox_spec`` supplies the mailbox names (dict
+  literal keys — constant strings even where the field tuples are
+  computed);
+- the host module supplies ``TRACE_MSG_MAP`` and its
+  ``@register_message`` classes.
+
+Checks:
+
+- **PXT301** a protocol with both runtimes whose host module exports
+  no ``TRACE_MSG_MAP``
+- **PXT302** a sim mailbox absent from the map's keys (projection
+  falls back to coarse windows for that message type)
+- **PXT303** a map key that names no sim mailbox (stale after a
+  kernel refactor — it will never match a recorded fault)
+- **PXT304** a map value that names no ``@register_message`` class in
+  the host module (``Socket.drop_next`` matches on
+  ``type(msg).__name__``, so a typo never fires)
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from paxi_tpu.analysis import astutil
+from paxi_tpu.analysis.model import Violation
+
+RULE = "trace-map"
+
+REGISTRY = "paxi_tpu/protocols/__init__.py"
+MAP_NAME = "TRACE_MSG_MAP"
+
+
+def _module_to_path(module: str, root: Path) -> Path:
+    return root / (module.replace(".", "/") + ".py")
+
+
+def registry_pairs(root: Path) -> List[Tuple[str, str, str]]:
+    """(protocol, sim module, host module) for every sim protocol whose
+    trace projection resolves a host module — base protocols and
+    variants alike, deduplicated on (sim module, host module)."""
+    tree, _ = astutil.parse_file(root / REGISTRY)
+    sims = astutil.parse_module_dict(tree, "_SIM_MODULES")
+    hosts = astutil.parse_module_dict(tree, "_HOST_MODULES")
+    if sims is None or hosts is None:
+        raise ValueError(f"{REGISTRY}: _SIM_MODULES/_HOST_MODULES dict "
+                         "literals not found — registry layout changed?")
+    sim_map = {k: v for k, v, _, _ in astutil.str_dict_items(sims)
+               if v is not None}
+    host_map = {k: v for k, v, _, _ in astutil.str_dict_items(hosts)
+                if v is not None}
+    out: List[Tuple[str, str, str]] = []
+    seen = set()
+    for proto, sim_mod in sim_map.items():
+        sim_mod = sim_mod.partition(":")[0]
+        base = proto
+        if base not in host_map:
+            # trace/host.py:trace_msg_map's variant rule: derive the
+            # base protocol from the sim module's package name
+            parts = sim_mod.rsplit(".", 2)
+            base = parts[-2] if len(parts) >= 2 else proto
+        host_mod = host_map.get(base)
+        if host_mod is None:
+            continue   # sim-only protocol (e.g. fragile_counter)
+        key = (sim_mod, host_mod)
+        if key not in seen:
+            seen.add(key)
+            out.append((proto, sim_mod, host_mod))
+    return sorted(out, key=lambda t: t[0])
+
+
+def sim_mailboxes(sim_path: Path) -> List[Tuple[str, int]]:
+    """(mailbox name, line) from the sim module's ``mailbox_spec``."""
+    tree, _ = astutil.parse_file(sim_path)
+    for node in tree.body:
+        if isinstance(node, astutil.FuncNode) and \
+                node.name == "mailbox_spec":
+            return astutil.string_keys_of_returned_dicts(node)
+    return []
+
+
+def host_map(host_path: Path) -> Optional[Tuple[Dict[str, str], int]]:
+    """(TRACE_MSG_MAP as dict, its line) or None when absent."""
+    tree, _ = astutil.parse_file(host_path)
+    d = astutil.parse_module_dict(tree, MAP_NAME)
+    if d is None:
+        return None
+    out = {}
+    for key, val, _, _ in astutil.str_dict_items(d):
+        out[key] = val or ""
+    return out, d.lineno
+
+
+def host_message_classes(host_path: Path) -> set:
+    tree, _ = astutil.parse_file(host_path)
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            decs = astutil.decorator_names(node)
+            if any(d.split(".")[-1] == "register_message" for d in decs):
+                out.add(node.name)
+    return out
+
+
+def check_pair(protocol: str, sim_path: Path, host_path: Path,
+               root: Path) -> List[Violation]:
+    rel_host = astutil.rel(host_path, root)
+    out: List[Violation] = []
+    boxes = sim_mailboxes(sim_path)
+    if not boxes:
+        return out   # no mailbox_spec — not a sim protocol module
+    found = host_map(host_path)
+    if found is None:
+        out.append(Violation(
+            rule=RULE, code="PXT301", path=rel_host, line=1, col=0,
+            message=f"protocol `{protocol}` has a sim twin "
+                    f"({astutil.rel(sim_path, root)}) but its host "
+                    f"module exports no {MAP_NAME} — sim witnesses "
+                    "cannot project onto host fault directives"))
+        return out
+    mapping, line = found
+    box_names = {name for name, _ in boxes}
+    for name, bline in boxes:
+        if name not in mapping:
+            out.append(Violation(
+                rule=RULE, code="PXT302", path=rel_host, line=line, col=0,
+                message=f"sim mailbox `{name}` of protocol `{protocol}` "
+                        f"is not covered by {MAP_NAME} — its recorded "
+                        "faults degrade to coarse drop windows"))
+    classes = host_message_classes(host_path)
+    for key, val in mapping.items():
+        if key not in box_names:
+            out.append(Violation(
+                rule=RULE, code="PXT303", path=rel_host, line=line, col=0,
+                message=f"{MAP_NAME} key `{key}` names no sim mailbox of "
+                        f"protocol `{protocol}` (stale after a kernel "
+                        "refactor?)"))
+        if val not in classes:
+            out.append(Violation(
+                rule=RULE, code="PXT304", path=rel_host, line=line, col=0,
+                message=f"{MAP_NAME} value `{val}` (key `{key}`) names no "
+                        "@register_message class in the host module — "
+                        "drop_next matches type names, a typo never "
+                        "fires"))
+    return out
+
+
+def _matches(path: Path, dirs: List[Path], files: set) -> bool:
+    rp = path.resolve()
+    return rp in files or any(str(rp).startswith(str(d) + "/")
+                              for d in dirs)
+
+
+def analyzed_pairs(root: Path,
+                   restrict: Optional[Sequence[Path]] = None
+                   ) -> List[Tuple[str, Path, Path]]:
+    """(protocol, sim path, host path) for every pair this rule will
+    analyze.  ``restrict`` (files or directories) keeps a pair when its
+    sim OR host module falls inside — so both ``lint
+    paxi_tpu/protocols`` and ``lint .../wankeeper/host.py`` exercise
+    the coverage rule rather than silently skipping it."""
+    dirs = [p.resolve() for p in restrict or [] if p.is_dir()]
+    files = {p.resolve() for p in restrict or [] if p.is_file()}
+    out: List[Tuple[str, Path, Path]] = []
+    for protocol, sim_mod, host_mod in registry_pairs(root):
+        sim_path = _module_to_path(sim_mod, root)
+        host_path = _module_to_path(host_mod, root)
+        if not sim_path.exists() or not host_path.exists():
+            continue
+        if restrict is not None and not (
+                _matches(sim_path, dirs, files)
+                or _matches(host_path, dirs, files)):
+            continue
+        out.append((protocol, sim_path, host_path))
+    return out
+
+
+def check(root: Path,
+          files: Optional[Sequence[Path]] = None) -> List[Violation]:
+    """``files``, when given, restricts the check to pairs whose sim or
+    host module is in the set (CLI ``-paths`` filtering; directories
+    match everything beneath them)."""
+    out: List[Violation] = []
+    for protocol, sim_path, host_path in analyzed_pairs(root, files):
+        out.extend(check_pair(protocol, sim_path, host_path, root))
+    return out

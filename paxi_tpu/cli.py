@@ -277,6 +277,33 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """paxi-lint: the protocol-aware static analyzer (paxi_tpu/analysis).
+
+    Exits 0 when the tree is clean modulo the checked-in baseline
+    (``analysis/baseline.toml``), 1 on violations — cheap enough for
+    every commit (pure AST, no jax import)."""
+    from pathlib import Path
+
+    from paxi_tpu import analysis
+
+    baseline = None if args.no_baseline else (
+        Path(args.baseline) if args.baseline else analysis.DEFAULT_BASELINE)
+    try:
+        report = analysis.run_lint(
+            rules=args.rule or None,
+            baseline_path=baseline,
+            paths=[Path(p) for p in args.paths] or None)
+    except (KeyError, ValueError) as e:
+        print(f"lint: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render(verbose=args.verbose))
+    return 0 if report.ok else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="paxi_tpu",
@@ -355,6 +382,26 @@ def main(argv=None) -> int:
     tho.add_argument("-step_ms", "--step-ms", dest="step_ms",
                      type=float, default=50.0)
     t.set_defaults(fn=cmd_trace)
+
+    from paxi_tpu.analysis import RULES as _LINT_RULES  # stdlib-only
+    li = sub.add_parser(
+        "lint", help="protocol-aware static analysis (paxi-lint)")
+    li.add_argument("paths", nargs="*", default=[],
+                    help="restrict to these files/directories "
+                         "(default: whole repo)")
+    li.add_argument("-rule", "--rule", action="append", default=[],
+                    choices=sorted(_LINT_RULES),
+                    help="run only this rule family (repeatable)")
+    li.add_argument("-json", "--json", action="store_true",
+                    help="machine-readable report")
+    li.add_argument("-verbose", "--verbose", action="store_true",
+                    help="also list suppressed findings")
+    li.add_argument("-baseline", "--baseline", default="",
+                    help="alternate baseline file")
+    li.add_argument("-no_baseline", "--no-baseline", dest="no_baseline",
+                    action="store_true",
+                    help="ignore the baseline (show every finding)")
+    li.set_defaults(fn=cmd_lint)
 
     me = sub.add_parser("metrics",
                         help="pretty-print metrics (live node or artifact)")

@@ -187,3 +187,14 @@ class ABDReplica(Node):
 
 def new_replica(id: ID, cfg: Config) -> ABDReplica:
     return ABDReplica(ID(id), cfg)
+
+
+# sim mailbox name -> host message class, for the cross-runtime trace
+# projection (trace/host.py).  Wire-level identity: the sim kernel's
+# four mailbox planes are exactly the host runtime's four message
+# classes, so sim witnesses project onto occurrence-indexed
+# Socket.drop_next directives.
+TRACE_MSG_MAP = {
+    "query": "Query", "query_r": "QueryReply",
+    "store": "Store", "store_r": "StoreReply",
+}

@@ -198,3 +198,14 @@ class BlockchainReplica(Node):
 
 def new_replica(id: ID, cfg: Config) -> BlockchainReplica:
     return BlockchainReplica(ID(id), cfg)
+
+
+# sim mailbox name -> host message class, for the cross-runtime trace
+# projection (trace/host.py).  The sim's single ``head`` plane
+# announces each replica's chain head; the host announces heads by
+# broadcasting the block itself (BlockMsg) — BlockReq is the pull-side
+# repair with no sim analog (the sim plane carries the whole head
+# state, so there is nothing to fetch).
+TRACE_MSG_MAP = {
+    "head": "BlockMsg",
+}

@@ -118,3 +118,13 @@ class ChainReplica(Node):
 
 def new_replica(id: ID, cfg: Config) -> ChainReplica:
     return ChainReplica(ID(id), cfg)
+
+
+# sim mailbox name -> host message class, for the cross-runtime trace
+# projection (trace/host.py).  The sim's ``rep`` plane is its go-back-N
+# retransmit channel for the SAME wire message a ``prop`` carries, so
+# both project onto Propagate; dropping either in the sim is dropping a
+# Propagate on the host.
+TRACE_MSG_MAP = {
+    "prop": "Propagate", "rep": "Propagate", "ack": "Ack",
+}

@@ -242,3 +242,14 @@ class DynamoReplica(Node):
 
 def new_replica(id: ID, cfg: Config) -> DynamoReplica:
     return DynamoReplica(ID(id), cfg)
+
+
+# sim mailbox name -> host message class, for the cross-runtime trace
+# projection (trace/host.py).  The sim models replication as one
+# anti-entropy gossip plane; the host's replica-to-replica value
+# propagation is RWrite, so a dropped gossip edge projects onto
+# dropping the write replication on that edge (read-path traffic has
+# no sim plane and stays unmapped on purpose).
+TRACE_MSG_MAP = {
+    "gossip": "RWrite",
+}

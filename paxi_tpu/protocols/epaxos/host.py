@@ -751,3 +751,20 @@ class EPaxosReplica(Node):
 
 def new_replica(id: ID, cfg: Config) -> EPaxosReplica:
     return EPaxosReplica(ID(id), cfg)
+
+
+# sim mailbox name -> host message class, for the cross-runtime trace
+# projection (trace/host.py).  The sim splits recovery onto separate
+# ballot-carrying planes (racc/raccr/rcmt) so an owner and a recoverer
+# broadcasting in the same step never collide on a wheel edge; on the
+# host both paths travel the SAME wire classes (Accept/AcceptReply/
+# Commit carry the ballot), so the recovery planes fold back onto them.
+# The ``gc`` executed-frontier gossip is kernel-internal window flow
+# control with no host wire analog (the host's unbounded dict log never
+# recycles) — baselined in analysis/baseline.toml.
+TRACE_MSG_MAP = {
+    "pa": "PreAccept", "par": "PreAcceptReply",
+    "acc": "Accept", "accr": "AcceptReply", "cmt": "Commit",
+    "prep": "Prepare", "prepr": "PrepareReply",
+    "racc": "Accept", "raccr": "AcceptReply", "rcmt": "Commit",
+}

@@ -160,3 +160,11 @@ class KPaxosReplica(Node):
 
 def new_replica(id: ID, cfg: Config) -> KPaxosReplica:
     return KPaxosReplica(ID(id), cfg)
+
+
+# sim mailbox name -> host message class, for the cross-runtime trace
+# projection (trace/host.py).  Wire-level identity (cf. paxos/host.py):
+# the partitioned phase-2 planes are the host's three message classes.
+TRACE_MSG_MAP = {
+    "p2a": "KP2a", "p2b": "KP2b", "p3": "KP3",
+}

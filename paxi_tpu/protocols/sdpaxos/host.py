@@ -551,3 +551,18 @@ class SDPaxosReplica(Node):
 
 def new_replica(id: ID, cfg: Config) -> SDPaxosReplica:
     return SDPaxosReplica(ID(id), cfg)
+
+
+# sim mailbox name -> host message class, for the cross-runtime trace
+# projection (trace/host.py).  ``cr`` is the command-body relay a
+# holder sends a staller in answer to a ``cneed`` fetch — on the host
+# that relay IS a (re)sent CAccept (handle_cfetch), so both the
+# original broadcast plane and the relay plane project onto CAccept.
+# The host's OFrontier/OFetch watchdog traffic has no sim plane (the
+# lock-step kernel needs no liveness prodding) and is simply absent
+# from the map.
+TRACE_MSG_MAP = {
+    "ca": "CAccept", "cack": "CAck", "cneed": "CFetch", "cr": "CAccept",
+    "oreq": "OReq", "p1a": "Seq1a", "p1b": "Seq1b",
+    "p2a": "OAccept", "p2b": "OAck", "p3": "OCommit",
+}

@@ -403,3 +403,13 @@ class WPaxosReplica(Node):
 
 def new_replica(id: ID, cfg: Config) -> WPaxosReplica:
     return WPaxosReplica(ID(id), cfg)
+
+
+# sim mailbox name -> host message class, for the cross-runtime trace
+# projection (trace/host.py).  Wire-level identity: the sim kernel's
+# five mailbox planes are the host runtime's five message classes
+# (per-key ballots ride inside the payload on both sides).
+TRACE_MSG_MAP = {
+    "p1a": "WP1a", "p1b": "WP1b", "p2a": "WP2a", "p2b": "WP2b",
+    "p3": "WP3",
+}

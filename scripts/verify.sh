@@ -5,17 +5,39 @@
 # end-to-end cases, e.g. the WanKeeper trace round-trip).
 #
 #   scripts/verify.sh            # run tier-1, print DOTS_PASSED
+#   scripts/verify.sh --lint     # prepend the static-analysis stage
+#                                # (paxi-lint + compileall + ruff if
+#                                # available — see README "Static
+#                                # analysis")
 #   scripts/verify.sh --metrics  # prepend the observability smoke stage
 #                                # (5 s chan bench + /metrics scrape)
+# Stage flags stack: `verify.sh --lint --metrics` runs both.
 set -o pipefail
 cd "$(dirname "$0")/.."
 
-if [ "${1:-}" = "--metrics" ]; then
-  shift
-  echo "== metrics smoke (scripts/metrics_smoke.py) =="
-  timeout -k 10 180 env JAX_PLATFORMS=cpu \
-    python scripts/metrics_smoke.py || exit $?
-fi
+while [ "${1:-}" = "--lint" ] || [ "${1:-}" = "--metrics" ]; do
+  if [ "$1" = "--lint" ]; then
+    shift
+    echo "== static analysis (paxi-lint) =="
+    # pure AST — no jax import, sub-second; exits 1 on any violation
+    # not covered by analysis/baseline.toml
+    timeout -k 10 120 python -m paxi_tpu lint || exit $?
+    echo "== compileall (syntax tier) =="
+    timeout -k 10 120 python -m compileall -q paxi_tpu tests scripts \
+      || exit $?
+    if command -v ruff >/dev/null 2>&1; then
+      echo "== ruff (ruff.toml subset) =="
+      timeout -k 10 120 ruff check . || exit $?
+    else
+      echo "== ruff not installed; skipping (config: ruff.toml) =="
+    fi
+  else
+    shift
+    echo "== metrics smoke (scripts/metrics_smoke.py) =="
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+      python scripts/metrics_smoke.py || exit $?
+  fi
+done
 
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

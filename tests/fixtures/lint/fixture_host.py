@@ -1,0 +1,40 @@
+"""Seeded handler-completeness violations (never imported — AST
+fixture for tests/test_lint.py)."""
+
+from dataclasses import dataclass
+
+from paxi_tpu.host.codec import register_message
+from paxi_tpu.host.node import Node
+
+
+@register_message
+@dataclass
+class Ping:
+    n: int
+
+
+@register_message
+@dataclass
+class Pong:           # PXH201: defined but never register()ed
+    n: int
+
+
+@dataclass
+class NotWire:        # no @register_message: not a wire class, ignored
+    n: int
+
+
+class FixtureReplica(Node):
+    def __init__(self, id, cfg):
+        super().__init__(id, cfg)
+        self.register(Ping, self.handle_ping)
+
+    def handle_ping(self, m):
+        self.handle_helper(m)
+
+    def handle_helper(self, m):
+        # referenced from handle_ping: alive despite no register()
+        return m
+
+    def handle_orphan(self, m):      # PXH202: dead handler
+        return m

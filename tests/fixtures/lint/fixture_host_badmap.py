@@ -1,0 +1,26 @@
+"""Host half of the trace-map fixture pair, with seeded map rot
+(never imported)."""
+
+from dataclasses import dataclass
+
+from paxi_tpu.host.codec import register_message
+
+
+@register_message
+@dataclass
+class Ping:
+    n: int
+
+
+@register_message
+@dataclass
+class Pong:
+    n: int
+
+
+TRACE_MSG_MAP = {
+    "ping": "Ping",
+    # "pong" missing                -> PXT302
+    "zombie": "Ping",             # -> PXT303: stale key
+    "ping2": "NoSuchClass",       # -> PXT303 + PXT304: bad value
+}

@@ -1,0 +1,12 @@
+"""Host module without a TRACE_MSG_MAP at all -> PXT301 (never
+imported)."""
+
+from dataclasses import dataclass
+
+from paxi_tpu.host.codec import register_message
+
+
+@register_message
+@dataclass
+class Ping:
+    n: int

@@ -1,0 +1,45 @@
+"""Seeded kernel-purity violations (never imported — AST fixture for
+tests/test_lint.py).  One specimen per PXK1xx check, plus host-side
+negative controls that must NOT be flagged."""
+
+import functools
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def helper(x):
+    # reachable from the jitted root through one call level:
+    n = np.sum(x)                      # PXK102: np in kernel
+    return n + hash(x)                 # PXK106: hash() of a traced value
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def kernel(x, n: int):
+    t = time.time()                    # PXK101: wall clock in kernel
+    if jnp.any(x > 0):                 # PXK104: Python if on traced expr
+        x = x + 1
+    for v in {1, 2, 3}:                # PXK103: set-literal iteration
+        x = x + v
+    y = jnp.zeros((n,), jnp.float64)   # PXK105: float64 creep
+    return helper(x) + y + t
+
+
+def scan_body(carry, t):
+    r = random.random()                # PXK101 (reachable via lax.scan)
+    return carry + r, t
+
+
+def run(xs):
+    return jax.lax.scan(scan_body, 0.0, xs)
+
+
+def host_side(path):
+    """Negative control: NOT reachable from any trace entry point —
+    host-side numpy/time here is fine and must stay unflagged."""
+    data = np.load(path)
+    t0 = time.time()
+    return data, t0

@@ -1,0 +1,48 @@
+"""Seeded host-concurrency violations (never imported — AST fixture
+for tests/test_lint.py)."""
+
+import threading
+
+
+class SharedThing:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0            # __init__ is exempt: not shared yet
+        self.items = []
+
+    def good(self, n):
+        with self._lock:
+            self.count += n
+            self.items.append(n)
+
+    def bad_write(self, n):
+        self.count = n            # PXC401: unlocked attribute write
+
+    def bad_item_write(self, k, v):
+        with self._lock:
+            pass
+        self.items[k] = v         # PXC401: outside the with block
+
+    def bad_mutate(self, n):
+        self.items.append(n)      # PXC402: unlocked mutating call
+
+    def inline_escaped(self, n):
+        self.count = n            # paxi-lint: disable=PXC401
+
+    def deferred(self):
+        def cb(n):
+            self.count = n        # nested def: judged at call site, ok
+        return cb
+
+    def reads_are_fine(self):
+        return self.count + len(self.items)
+
+
+class Unlocked:
+    """Negative control: no lock attribute — never checked."""
+
+    def __init__(self):
+        self.x = 0
+
+    def write(self, n):
+        self.x = n

@@ -1,0 +1,8 @@
+"""Sim half of the trace-map fixture pair (never imported)."""
+
+
+def mailbox_spec(cfg):
+    return {
+        "ping": ("n",),
+        "pong": ("n",),
+    }

@@ -1,0 +1,233 @@
+"""paxi-lint (paxi_tpu/analysis): fixture-driven rule tests + the
+repo-wide cleanliness gates.
+
+Each rule family is exercised against a small fixture module with
+seeded violations (tests/fixtures/lint/) — the fixtures are never
+imported, only parsed.  The repo-wide "lint is clean" check runs the
+full engine against the working tree and is marked ``slow`` (it is
+cheap, but it is a gate on the whole tree, not a unit test); the
+trace-map family alone is fast enough to keep in tier-1, directly
+pinning the ROADMAP cross-runtime item: all protocols project.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from paxi_tpu import analysis
+from paxi_tpu.analysis import concurrency, handlers, purity, tracemap
+from paxi_tpu.analysis.model import (Suppression, Violation,
+                                     apply_suppressions, inline_disables,
+                                     load_baseline)
+
+ROOT = Path(__file__).resolve().parent.parent
+FIX = ROOT / "tests" / "fixtures" / "lint"
+
+
+def codes(violations):
+    return sorted({v.code for v in violations})
+
+
+# ---- kernel purity -------------------------------------------------------
+def test_kernel_purity_fixture_catches_each_check():
+    vs = purity.check(ROOT, files=[FIX / "fixture_kernel.py"])
+    assert codes(vs) == ["PXK101", "PXK102", "PXK103", "PXK104",
+                         "PXK105", "PXK106"]
+    # both nondeterminism sites fire: time.time in the jitted root and
+    # random.random in the lax.scan body
+    k101_lines = sorted(v.line for v in vs if v.code == "PXK101")
+    assert len(k101_lines) == 2
+
+
+def test_kernel_purity_ignores_host_side_code():
+    vs = purity.check(ROOT, files=[FIX / "fixture_kernel.py"])
+    src = (FIX / "fixture_kernel.py").read_text().splitlines()
+    host_start = next(i for i, l in enumerate(src, 1)
+                      if l.startswith("def host_side"))
+    assert all(v.line < host_start for v in vs), \
+        "host-side numpy/time must not be flagged"
+
+
+def test_kernel_purity_repo_tree_is_clean():
+    # the real kernels are pure today; this pins it (tier-1, no baseline)
+    vs = purity.check(ROOT)
+    assert vs == []
+
+
+# ---- handler completeness ------------------------------------------------
+def test_handler_fixture_unregistered_and_dead():
+    vs = handlers.check(ROOT, files=[FIX / "fixture_host.py"])
+    assert codes(vs) == ["PXH201", "PXH202"]
+    msgs = " | ".join(v.message for v in vs)
+    assert "`Pong`" in msgs and "`handle_orphan`" in msgs
+    # registered and internally-called handlers stay alive
+    assert "handle_ping" not in msgs and "handle_helper" not in msgs
+
+
+def test_handler_repo_tree_is_clean():
+    assert handlers.check(ROOT) == []
+
+
+# ---- trace-map coverage --------------------------------------------------
+def test_tracemap_fixture_missing_stale_and_bad_value():
+    vs = tracemap.check_pair("fixture", FIX / "fixture_sim.py",
+                             FIX / "fixture_host_badmap.py", ROOT)
+    by_code = {c: [v for v in vs if v.code == c] for c in codes(vs)}
+    assert set(by_code) == {"PXT302", "PXT303", "PXT304"}
+    assert "`pong`" in by_code["PXT302"][0].message
+    assert {k for v in by_code["PXT303"]
+            for k in ("zombie", "ping2") if f"`{k}`" in v.message} \
+        == {"zombie", "ping2"}
+    assert "NoSuchClass" in by_code["PXT304"][0].message
+
+
+def test_tracemap_fixture_missing_map_entirely():
+    vs = tracemap.check_pair("fixture", FIX / "fixture_sim.py",
+                             FIX / "fixture_host_nomap.py", ROOT)
+    assert codes(vs) == ["PXT301"]
+
+
+def test_tracemap_registry_sees_every_protocol():
+    pairs = tracemap.registry_pairs(ROOT)
+    protos = {p for p, _, _ in pairs}
+    assert {"paxos", "paxos_pg", "abd", "chain", "wpaxos", "epaxos",
+            "kpaxos", "dynamo", "sdpaxos", "wankeeper",
+            "blockchain"} <= protos
+    # sim-only protocols must not demand a host map
+    assert "fragile_counter" not in protos
+
+
+def test_tracemap_runs_under_directory_restriction():
+    """`lint paxi_tpu/protocols` must exercise the coverage rule, not
+    silently skip it (pairs match when sim OR host is in the subtree)."""
+    report = analysis.run_lint(rules=["trace-map"],
+                               paths=[ROOT / "paxi_tpu" / "protocols"])
+    assert report.ok
+    assert len(report.suppressed) == 2     # wankeeper p2b + epaxos gc
+    assert report.checked_files > 0
+
+
+def test_nonexistent_path_is_an_error():
+    with pytest.raises(ValueError, match="no such path"):
+        analysis.run_lint(paths=[ROOT / "paxi_tpu" / "protcols"])
+    from paxi_tpu.cli import main
+    assert main(["lint", "paxi_tpu/protcols"]) == 2
+
+
+def test_tracemap_repo_passes_with_baseline():
+    """The ROADMAP item: every protocol with a sim twin projects.  Only
+    the two baselined kernel-internal mailboxes may be suppressed."""
+    report = analysis.run_lint(rules=["trace-map"])
+    assert report.ok, report.render()
+    assert len(report.suppressed) == 2
+    assert report.unused_baseline == []
+
+
+# ---- host concurrency ----------------------------------------------------
+def test_concurrency_fixture():
+    vs = concurrency.check(ROOT, files=[FIX / "fixture_locked.py"])
+    got = sorted((v.code, v.message.split("`")[1]) for v in vs)
+    assert got == [
+        ("PXC401", "self.count"),       # bad_write
+        ("PXC401", "self.count"),       # inline_escaped (raw: engine
+                                        # suppression is tested below)
+        ("PXC401", "self.items"),       # bad_item_write (post-with)
+        ("PXC402", "self.items.append(...)"),   # bad_mutate
+    ]
+
+
+def test_concurrency_repo_tree_is_clean():
+    assert concurrency.check(ROOT) == []
+
+
+# ---- suppression layers --------------------------------------------------
+def test_inline_disable_comment_suppresses():
+    report = analysis.run_lint(rules=["host-concurrency"],
+                               paths=[FIX / "fixture_locked.py"])
+    kept = [v.line for v in report.violations]
+    dropped = {(v.line, why) for v, why in report.suppressed}
+    src = (FIX / "fixture_locked.py").read_text().splitlines()
+    escaped_line = next(i for i, l in enumerate(src, 1)
+                        if "disable=PXC401" in l)
+    assert (escaped_line, "inline") in dropped
+    assert escaped_line not in kept
+    assert len(kept) == 3
+
+
+def test_baseline_parse_and_match(tmp_path):
+    p = tmp_path / "baseline.toml"
+    p.write_text('# comment\n[[suppress]]\ncode = "PXC401"\n'
+                 'path = "a/b.py"\nmatch = "self.count"\n'
+                 'reason = "because"\n')
+    entries = load_baseline(p)
+    assert len(entries) == 1
+    v = Violation(rule="host-concurrency", code="PXC401", path="a/b.py",
+                  line=3, col=0, message="unlocked write to `self.count`")
+    assert entries[0].matches(v)
+    other = Violation(rule="host-concurrency", code="PXC401",
+                      path="a/other.py", line=3, col=0, message="x")
+    assert not entries[0].matches(other)
+
+
+def test_baseline_allows_trailing_comments(tmp_path):
+    p = tmp_path / "baseline.toml"
+    p.write_text('[[suppress]]\ncode = "PXT302"  # the code\n'
+                 'path = "a/b.py"\nreason = "why"  # rationale\n')
+    entries = load_baseline(p)
+    assert entries[0].code == "PXT302" and entries[0].reason == "why"
+
+
+def test_baseline_requires_reason(tmp_path):
+    p = tmp_path / "baseline.toml"
+    p.write_text('[[suppress]]\ncode = "PXC401"\npath = "a/b.py"\n')
+    with pytest.raises(ValueError, match="reason"):
+        load_baseline(p)
+
+
+def test_apply_suppressions_reports_why():
+    v = Violation(rule="r", code="PXX1", path="p.py", line=1, col=0,
+                  message="m")
+    kept, dropped = apply_suppressions(
+        [v], [Suppression(code="PXX1", path="p.py", reason="why")], {})
+    assert kept == [] and dropped[0][1] == "baseline: why"
+
+
+def test_inline_disables_parser():
+    d = inline_disables("x = 1\ny = 2  # paxi-lint: disable=PXA1,PXB2\n"
+                        "z = 3  # paxi-lint: disable=all\n")
+    assert d == {2: {"PXA1", "PXB2"}, 3: {"all"}}
+
+
+# ---- CLI -----------------------------------------------------------------
+def test_cli_lint_json_on_fixture(capsys):
+    from paxi_tpu.cli import main
+    rc = main(["lint", str(FIX / "fixture_host.py"),
+               "-rule", "handler-completeness", "-json", "-no_baseline"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and not out["ok"]
+    assert {v["code"] for v in out["violations"]} == {"PXH201", "PXH202"}
+
+
+def test_cli_lint_unknown_rule_rejected(capsys):
+    from paxi_tpu.cli import main
+    with pytest.raises(SystemExit):
+        main(["lint", "-rule", "no-such-rule"])
+
+
+# ---- the repo-wide gate --------------------------------------------------
+@pytest.mark.slow
+def test_repo_lint_is_clean():
+    """`python -m paxi_tpu lint` exits 0 on the tree: all four rule
+    families, baseline applied, no stale baseline entries."""
+    report = analysis.run_lint()
+    assert report.ok, "\n" + report.render()
+    assert report.unused_baseline == [], \
+        "baseline entries no violation consumes — delete them"
+
+
+@pytest.mark.slow
+def test_cli_lint_repo_exit_zero(capsys):
+    from paxi_tpu.cli import main
+    assert main(["lint"]) == 0
+    assert "0 violation(s)" in capsys.readouterr().out
