@@ -30,69 +30,22 @@ import jax.random as jr
 
 from paxi_tpu.metrics.simcount import counters_of
 from paxi_tpu.protocols import sim_protocol
-from paxi_tpu.sim import FuzzConfig, SimConfig, make_run
+from paxi_tpu.sim import make_run
 
-DROP = FuzzConfig(p_drop=0.25, max_delay=2)
-DUP = FuzzConfig(p_dup=0.25, max_delay=3)
-PART = FuzzConfig(p_partition=0.3, p_crash=0.15, max_delay=2, window=8)
-KILL = FuzzConfig(p_drop=0.1, max_delay=2, perm_crash=0, perm_crash_at=25)
-
-# (protocol, cfg, schedules, groups, steps, progress metric)
-CASES = [
-    ("paxos", SimConfig(n_replicas=5, n_slots=32),
-     [DROP, DUP, PART, KILL], 64, 150, "committed_slots"),
-    ("paxos_pg", SimConfig(n_replicas=5, n_slots=32),
-     [DROP, PART], 64, 150, "committed_slots"),
-    ("epaxos", SimConfig(n_replicas=5, n_slots=16, n_keys=4),
-     [DROP, DUP, PART, KILL], 16, 120, "executed"),
-    ("wpaxos", SimConfig(n_replicas=6, n_zones=2, n_objects=4,
-                         n_slots=16, steal_threshold=3, locality=0.8),
-     [DROP, PART, KILL], 32, 140, "committed_slots"),
-    ("abd", SimConfig(n_replicas=5, n_keys=16),
-     [DROP, DUP, PART], 64, 150, "ops_done"),
-    ("chain", SimConfig(n_replicas=3, n_slots=32),
-     [DROP, DUP, PART], 64, 150, "committed_slots"),
-    ("kpaxos", SimConfig(n_replicas=3, n_slots=32),
-     [DROP, DUP, PART], 64, 150, "committed_slots"),
-    ("dynamo", SimConfig(n_replicas=5, n_keys=8, n_slots=40),
-     [DROP, DUP, PART], 64, 120, "writes"),
-    ("sdpaxos", SimConfig(n_replicas=5, n_slots=16, n_keys=8),
-     [DROP, DUP, PART, KILL], 32, 140, "committed_slots"),
-    ("wankeeper", SimConfig(n_replicas=6, n_zones=2, n_objects=4,
-                            n_slots=16, locality=0.8),
-     [DROP, PART, KILL], 32, 140, "committed_slots"),
-    # 3x3 zone-grid shapes, partition-stressed: the BASELINE geometry
-    # (grid_q2=1: Q1=3 zones, zone-local commits) and the reshaped
-    # q2=2 grid (Q1=2/Q2=2) must both stay violation-free
-    ("wpaxos", SimConfig(n_replicas=9, n_zones=3, n_objects=6,
-                         n_slots=16, steal_threshold=3, locality=0.8),
-     [PART], 16, 140, "committed_slots"),
-    ("wpaxos", SimConfig(n_replicas=9, n_zones=3, n_objects=6,
-                         n_slots=16, steal_threshold=3, locality=0.8,
-                         grid_q2=2),
-     [PART], 16, 140, "committed_slots"),
-    ("wankeeper", SimConfig(n_replicas=9, n_zones=3, n_objects=6,
-                            n_slots=16, locality=0.8),
-     [PART], 16, 140, "committed_slots"),
-    ("blockchain", SimConfig(n_replicas=5, n_slots=32,
-                             steal_threshold=4),
-     [DROP, DUP, PART], 64, 200, "committed_slots"),
-]
-
-SCHED_NAMES = {id(DROP): "drop", id(DUP): "dup", id(PART): "partition",
-               id(KILL): "perm_kill"}
-SEEDS = (0, 1, 2, 3, 4)
-
-# the seeded-bug demo case (--seed-bug): EXPECTED to violate — it
-# exists to exercise the capture -> dump pipeline, never the oracle
-BUG_DEMO = ("wankeeper_nofloor",
-            SimConfig(n_replicas=6, n_zones=2, n_objects=2, n_slots=16,
-                      locality=0.1),
-            [DROP], 16, 80, "committed_slots")
+# the adversarial case matrix is shared with the divergence-hunt
+# campaign engine (one source of truth: a witness the soak trips over
+# is a case the hunt can reproduce) — see paxi_tpu/hunt/cases.py
+from paxi_tpu.hunt.cases import (BUG_DEMO, CASES, DROP, DUP, KILL,  # noqa: F401
+                                 PART, SCHED_NAMES, SEEDS)
 
 
 def dump_trace(traces_dir, name, cfg, fz, seed, groups, steps):
-    """Record-mode rerun of a violating case -> trace file path."""
+    """Record-mode rerun of a violating case -> trace file path.
+
+    Dumped traces carry ``schedule_hash`` + ``protocol`` in their meta
+    (stamped by capture/save), so the hunt corpus
+    (``python -m paxi_tpu hunt run``) dedups them on first-run seeding
+    — and older unstamped dumps are hashed on import."""
     from paxi_tpu import trace as tr
     t = tr.capture(sim_protocol(name), cfg, fz, seed, groups, steps,
                    proto_name=name)

@@ -215,20 +215,100 @@ def cmd_trace(args) -> int:
         from paxi_tpu.trace.host import directives_json, host_directives
         cfg = t.sim_config()
         ids = local_config(cfg.n_replicas, zones=cfg.n_zones).ids
+        if args.all:
+            # batch mode: this trace's projection coverage under EVERY
+            # protocol's TRACE_MSG_MAP (the hunt classifier's
+            # mappability comparison) — which protocols could replay
+            # this schedule exactly, and what each one loses
+            from paxi_tpu.hunt.classify import coverage_of
+            from paxi_tpu.protocols import _HOST_MODULES
+            from paxi_tpu.trace.host import trace_msg_map
+            out = {}
+            for proto in sorted(_HOST_MODULES):
+                m = trace_msg_map(proto)
+                if not m:
+                    continue
+                out[proto] = coverage_of(t, ids=ids, msg_map=m)
+            print(json.dumps({"trace_protocol": t.protocol,
+                              "coverage": out}))
+            return 0
         dirs, stats = host_directives(t, ids, step_s=args.step_ms / 1e3)
-        print(json.dumps({"directives": directives_json(dirs),
-                          "stats": stats}))
+        payload = {"directives": directives_json(dirs), "stats": stats}
+        if args.seq:
+            from paxi_tpu.trace.host import seq_schedule
+            sched, sstats = seq_schedule(t, ids)
+            payload["sequenced"] = sched.to_json()
+            payload["seq_stats"] = sstats
+        print(json.dumps(payload))
         return 0
     raise AssertionError(args.trace_cmd)
+
+
+def cmd_hunt(args) -> int:
+    """The divergence-hunting campaign engine (paxi_tpu/hunt/)."""
+    from paxi_tpu.hunt import Campaign
+
+    try:
+        camp = Campaign(args.dir,
+                        protocols=(args.protocols.split(",")
+                                   if args.protocols else None),
+                        budget=args.budget, quick=args.quick,
+                        shrink_trials=args.shrink_trials,
+                        host_replay=not args.no_host,
+                        traces_dir=args.traces_dir or None,
+                        log=(lambda m: None) if args.quiet else None)
+    except (KeyError, ValueError) as e:
+        print(f"hunt: {e}", file=sys.stderr)
+        return 2
+    if args.hunt_cmd == "run":
+        rep = camp.run()
+        t = rep["summary"]["totals"]
+        print(json.dumps(rep["summary"]))
+        print(f"hunt: {t['runs']} runs, {t['witnesses']} witnesses "
+              f"({t['reproduced']} reproduced, {t['diverged']} diverged, "
+              f"{t['unmappable']} unmappable, "
+              f"{t['unclassified']} unclassified) -> "
+              f"{camp.root}/HUNT_REPORT.md", file=sys.stderr)
+        return 2 if t["unclassified"] else 0
+    if args.hunt_cmd == "status":
+        print(json.dumps(camp.status()))
+        return 0
+    if args.hunt_cmd == "report":
+        rep = camp.write_report()
+        print(json.dumps(rep["summary"]))
+        return 0
+    raise AssertionError(args.hunt_cmd)
 
 
 def cmd_metrics(args) -> int:
     """Pretty-print a metrics snapshot from either source: scrape a
     live host node's /metrics endpoint, or pull the snapshots embedded
-    in a JSON artifact (BENCH_HOST.json, FUZZ_SOAK.json, ...)."""
+    in a JSON artifact (BENCH_HOST.json, FUZZ_SOAK.json, ...).  With
+    ``--series``, run the sim instead and export the per-step counter
+    time series (SimResult.counter_series — the ROADMAP metrics
+    item)."""
     import urllib.request
 
     from paxi_tpu.metrics import merge_snapshots, pretty
+
+    if args.series:
+        from paxi_tpu.protocols import sim_protocol
+        from paxi_tpu.sim import FuzzConfig, SimConfig, simulate
+        proto = sim_protocol(args.algorithm)
+        cfg = SimConfig(n_replicas=args.replicas)
+        fuzz = FuzzConfig(p_drop=args.p_drop, p_dup=args.p_dup,
+                          max_delay=args.max_delay)
+        res = simulate(proto, cfg, args.groups, args.steps, fuzz=fuzz,
+                       seed=args.seed, series=True)
+        print(json.dumps({
+            "algorithm": args.algorithm,
+            "groups": args.groups,
+            "steps": args.steps,
+            "violations": int(res.violations),
+            "series": {k: [int(x) for x in v]
+                       for k, v in res.counter_series.items()},
+        }))
+        return 0
 
     def _find_snapshots(doc, out):
         """Walk a JSON document for metric payloads: registry snapshots
@@ -381,7 +461,42 @@ def main(argv=None) -> int:
     tho.add_argument("file")
     tho.add_argument("-step_ms", "--step-ms", dest="step_ms",
                      type=float, default=50.0)
+    tho.add_argument("-seq", "--seq", action="store_true",
+                     help="also emit the sequenced (virtual-clock) "
+                          "delivery schedule")
+    tho.add_argument("-all", "--all", action="store_true",
+                     help="batch mode: projection coverage under every "
+                          "protocol's TRACE_MSG_MAP")
     t.set_defaults(fn=cmd_trace)
+
+    h = sub.add_parser("hunt",
+                       help="divergence-hunting campaigns (sim->host)")
+    hsub = h.add_subparsers(dest="hunt_cmd", required=True)
+    for name, desc in (("run", "run/resume a campaign"),
+                       ("status", "print campaign progress"),
+                       ("report", "regenerate HUNT_REPORT.json/.md")):
+        hp = hsub.add_parser(name, help=desc)
+        hp.add_argument("-dir", "--dir", default="hunt",
+                        help="campaign directory (state + corpus + "
+                             "reports)")
+        hp.add_argument("-budget", "--budget", type=int, default=5,
+                        help="fuzz runs per protocol")
+        hp.add_argument("-protocols", "--protocols", default="",
+                        help="comma-separated subset (default: every "
+                             "mapped protocol)")
+        hp.add_argument("-quick", "--quick", action="store_true",
+                        help="cap groups/steps for smoke budgets")
+        hp.add_argument("-shrink_trials", "--shrink-trials",
+                        dest="shrink_trials", type=int, default=120)
+        hp.add_argument("-no_host", "--no-host", dest="no_host",
+                        action="store_true",
+                        help="skip host replay (coverage-only verdicts)")
+        hp.add_argument("-traces_dir", "--traces-dir",
+                        dest="traces_dir", default="",
+                        help="seed corpus from this trace dir on first "
+                             "run (default: repo traces/)")
+        hp.add_argument("-quiet", "--quiet", action="store_true")
+    h.set_defaults(fn=cmd_hunt)
 
     from paxi_tpu.analysis import RULES as _LINT_RULES  # stdlib-only
     li = sub.add_parser(
@@ -411,6 +526,17 @@ def main(argv=None) -> int:
                     help="a JSON artifact with embedded snapshots")
     me.add_argument("-raw", "--raw", action="store_true",
                     help="with -url: dump the Prometheus text unparsed")
+    me.add_argument("-series", "--series", action="store_true",
+                    help="run the sim and export the per-step counter "
+                         "time series instead")
+    me.add_argument("-algorithm", "--algorithm", default="paxos")
+    me.add_argument("-groups", type=int, default=64)
+    me.add_argument("-steps", type=int, default=100)
+    me.add_argument("-replicas", type=int, default=3)
+    me.add_argument("-seed", type=int, default=0)
+    me.add_argument("-p_drop", type=float, default=0.0)
+    me.add_argument("-p_dup", type=float, default=0.0)
+    me.add_argument("-max_delay", type=int, default=1)
     me.set_defaults(fn=cmd_metrics)
 
     args = p.parse_args(argv)

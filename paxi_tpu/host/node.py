@@ -64,7 +64,8 @@ class WireReply:
 
 
 class Node:
-    def __init__(self, id: ID, cfg: Config, codec: Optional[Codec] = None):
+    def __init__(self, id: ID, cfg: Config, codec: Optional[Codec] = None,
+                 fabric=None):
         self.id = ID(id)
         self.cfg = cfg
         # one registry per node, shared with the socket, exported by the
@@ -73,7 +74,11 @@ class Node:
         # per-message-type handles resolved once: the recv loop is THE
         # hot path and must not pay a labeled registry lookup per message
         self._msg_metrics: Dict[str, tuple] = {}
-        self.socket = Socket(self.id, cfg, codec, metrics=self.metrics)
+        # ``fabric``: an injected virtual-clock transport (host/fabric.py)
+        # — None outside trace replay; Socket also picks up the ambient
+        # use_fabric() context so replica factories need no new argument
+        self.socket = Socket(self.id, cfg, codec, metrics=self.metrics,
+                             fabric=fabric)
         self.db = Database(cfg.multi_version)
         self.handles: Dict[type, Callable[[Any], None]] = {}
         self.http: Optional[HTTPServer] = None

@@ -23,16 +23,30 @@ def chan_config(n: int, zones: int = 1, tag: str = "sim") -> Config:
 
 
 class Cluster:
-    """All replicas of a config in one event loop (simulation mode)."""
+    """All replicas of a config in one event loop (simulation mode).
+
+    ``fabric``: a virtual-clock fabric (host/fabric.py) to sequence all
+    peer deliveries through — the trace-replay transport.  It is made
+    ambient while the replicas are constructed, so unmodified protocol
+    factories (which only know ``(id, cfg)``) still wire into it."""
 
     def __init__(self, algorithm: str, cfg: Optional[Config] = None,
-                 n: int = 3, zones: int = 1, http: bool = True):
+                 n: int = 3, zones: int = 1, http: bool = True,
+                 fabric=None):
+        from paxi_tpu.host.fabric import use_fabric
         from paxi_tpu.protocols import host_replica
         self.cfg = cfg or chan_config(n, zones)
         if not http:
             self.cfg.http_addrs = {}
-        self.replicas: Dict[ID, object] = {
-            i: host_replica(algorithm)(i, self.cfg) for i in self.cfg.ids}
+        self.fabric = fabric
+        new = host_replica(algorithm)
+        if fabric is None:
+            self.replicas: Dict[ID, object] = {
+                i: new(i, self.cfg) for i in self.cfg.ids}
+        else:
+            with use_fabric(fabric):
+                self.replicas = {i: new(i, self.cfg)
+                                 for i in self.cfg.ids}
 
     async def start(self) -> None:
         for r in self.replicas.values():

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional
 from paxi_tpu.core.config import Config
 from paxi_tpu.core.ident import ID
 from paxi_tpu.host.codec import Codec
+from paxi_tpu.host.fabric import current_fabric
 from paxi_tpu.host.transport import Transport, listen, new_transport
 from paxi_tpu.metrics import Registry
 
@@ -50,10 +51,17 @@ class MsgMatcher:
 
 class Socket:
     def __init__(self, id: ID, cfg: Config, codec: Optional[Codec] = None,
-                 metrics: Optional[Registry] = None):
+                 metrics: Optional[Registry] = None, fabric=None):
         self.id = ID(id)
         self.cfg = cfg
         self.codec = codec or Codec("pickle")
+        # injected virtual-clock fabric (host/fabric.py): explicit, or
+        # ambient via use_fabric() so Cluster can wire unmodified
+        # replica factories into a replay.  When set, every send routes
+        # through the fabric's logical clock and the fabric owns the
+        # whole fault model — the wall-clock windows and matchers below
+        # are bypassed.
+        self.fabric = fabric if fabric is not None else current_fabric()
         # shared with the owning Node so sends/drops/faults land in the
         # same exported registry; standalone sockets get their own
         self.metrics = metrics if metrics is not None else Registry(
@@ -76,6 +84,9 @@ class Socket:
 
     # ---- lifecycle -----------------------------------------------------
     async def start(self) -> None:
+        if self.fabric is not None:
+            self.fabric.attach(str(self.id), self._deliver)
+            return
         self._server = await listen(
             self.cfg.addrs[self.id], self._deliver, self.codec)
 
@@ -90,6 +101,8 @@ class Socket:
         return await self.inbox.get()
 
     async def close(self) -> None:
+        if self.fabric is not None:
+            self.fabric.detach(str(self.id))
         if self._server:
             self._server.close()
         for t in self._peers.values():
@@ -108,6 +121,11 @@ class Socket:
             out_total = self._out_counters[mname] = met.counter(
                 "paxi_msgs_out_total", type=mname)
         out_total.inc()
+        if self.fabric is not None:
+            # virtual-clock replay: the fabric sequences delivery and
+            # applies the trace's fault schedule itself
+            self.fabric.submit(str(self.id), str(to), msg)
+            return
         now = time.monotonic()
         if now < self._crashed_until:
             met.counter("paxi_msgs_dropped_total", type=mname,

@@ -1,0 +1,23 @@
+"""Divergence-hunting campaign engine.
+
+The subsystem that turns the cross-runtime pipeline (sim fuzzing ->
+trace capture -> ddmin shrink -> TRACE_MSG_MAP projection -> host
+replay) into a systematic oracle: ``Campaign`` fuzzes every mapped
+protocol under a budget, stores deduplicated violation witnesses in a
+persistent corpus, replays each minimal witness on the host runtime
+through the virtual-clock fabric (host/fabric.py), and classifies the
+outcome — ``reproduced`` (host bug candidate), ``diverged`` (sim
+modeling gap) or ``unmappable`` (baselined mailboxes).
+
+CLI: ``python -m paxi_tpu hunt run|status|report``.
+"""
+
+from paxi_tpu.hunt.classify import (Classification, HostOutcome, OUTCOMES,
+                                    classify, classify_witness,
+                                    coverage_of, replay_witness)
+from paxi_tpu.hunt.corpus import Corpus
+from paxi_tpu.hunt.engine import Campaign
+
+__all__ = ["Campaign", "Corpus", "Classification", "HostOutcome",
+           "OUTCOMES", "classify", "classify_witness", "coverage_of",
+           "replay_witness"]

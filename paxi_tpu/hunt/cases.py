@@ -1,0 +1,110 @@
+"""The adversarial case matrix — single source of truth.
+
+Owned here so the fuzz soak (fuzz_soak.py) and the divergence-hunt
+campaign engine (hunt/engine.py) fuzz the exact same
+(protocol, geometry, schedule) space: a witness the soak trips over is
+a case the hunt can reproduce, and vice versa.
+
+Schedules: sustained loss with delay/reorder; duplication with deeper
+delay; flapping partitions with crash windows; plus a permanent
+leader-kill for the protocols with in-kernel recovery.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from paxi_tpu.sim.types import FuzzConfig, SimConfig
+
+DROP = FuzzConfig(p_drop=0.25, max_delay=2)
+DUP = FuzzConfig(p_dup=0.25, max_delay=3)
+PART = FuzzConfig(p_partition=0.3, p_crash=0.15, max_delay=2, window=8)
+KILL = FuzzConfig(p_drop=0.1, max_delay=2, perm_crash=0, perm_crash_at=25)
+
+SCHED_NAMES = {id(DROP): "drop", id(DUP): "dup", id(PART): "partition",
+               id(KILL): "perm_kill"}
+
+SEEDS = (0, 1, 2, 3, 4)
+
+# (protocol, cfg, schedules, groups, steps, progress metric)
+Case = Tuple[str, SimConfig, list, int, int, str]
+
+CASES: List[Case] = [
+    ("paxos", SimConfig(n_replicas=5, n_slots=32),
+     [DROP, DUP, PART, KILL], 64, 150, "committed_slots"),
+    ("paxos_pg", SimConfig(n_replicas=5, n_slots=32),
+     [DROP, PART], 64, 150, "committed_slots"),
+    ("epaxos", SimConfig(n_replicas=5, n_slots=16, n_keys=4),
+     [DROP, DUP, PART, KILL], 16, 120, "executed"),
+    ("wpaxos", SimConfig(n_replicas=6, n_zones=2, n_objects=4,
+                         n_slots=16, steal_threshold=3, locality=0.8),
+     [DROP, PART, KILL], 32, 140, "committed_slots"),
+    ("abd", SimConfig(n_replicas=5, n_keys=16),
+     [DROP, DUP, PART], 64, 150, "ops_done"),
+    ("chain", SimConfig(n_replicas=3, n_slots=32),
+     [DROP, DUP, PART], 64, 150, "committed_slots"),
+    ("kpaxos", SimConfig(n_replicas=3, n_slots=32),
+     [DROP, DUP, PART], 64, 150, "committed_slots"),
+    ("dynamo", SimConfig(n_replicas=5, n_keys=8, n_slots=40),
+     [DROP, DUP, PART], 64, 120, "writes"),
+    ("sdpaxos", SimConfig(n_replicas=5, n_slots=16, n_keys=8),
+     [DROP, DUP, PART, KILL], 32, 140, "committed_slots"),
+    ("wankeeper", SimConfig(n_replicas=6, n_zones=2, n_objects=4,
+                            n_slots=16, locality=0.8),
+     [DROP, PART, KILL], 32, 140, "committed_slots"),
+    # 3x3 zone-grid shapes, partition-stressed: the BASELINE geometry
+    # (grid_q2=1: Q1=3 zones, zone-local commits) and the reshaped
+    # q2=2 grid (Q1=2/Q2=2) must both stay violation-free
+    ("wpaxos", SimConfig(n_replicas=9, n_zones=3, n_objects=6,
+                         n_slots=16, steal_threshold=3, locality=0.8),
+     [PART], 16, 140, "committed_slots"),
+    ("wpaxos", SimConfig(n_replicas=9, n_zones=3, n_objects=6,
+                         n_slots=16, steal_threshold=3, locality=0.8,
+                         grid_q2=2),
+     [PART], 16, 140, "committed_slots"),
+    ("wankeeper", SimConfig(n_replicas=9, n_zones=3, n_objects=6,
+                            n_slots=16, locality=0.8),
+     [PART], 16, 140, "committed_slots"),
+    ("blockchain", SimConfig(n_replicas=5, n_slots=32,
+                             steal_threshold=4),
+     [DROP, DUP, PART], 64, 200, "committed_slots"),
+]
+
+# the seeded-bug demo case (fuzz_soak --seed-bug): EXPECTED to violate —
+# it exists to exercise the capture -> dump pipeline, never the oracle
+BUG_DEMO: Case = ("wankeeper_nofloor",
+                  SimConfig(n_replicas=6, n_zones=2, n_objects=2,
+                            n_slots=16, locality=0.1),
+                  [DROP], 16, 80, "committed_slots")
+
+# hunt-only cases for the seeded-bug twins (never correctness cases —
+# their witnesses are the pipeline's positive controls)
+DEMO_CASES: List[Case] = [
+    ("fragile_counter", SimConfig(n_replicas=3), [DROP], 8, 30,
+     "delivered"),
+    BUG_DEMO,
+]
+
+
+def sched_name(fuzz: FuzzConfig) -> str:
+    return SCHED_NAMES.get(id(fuzz), "sched")
+
+
+def hunt_cases(protocols=None, quick: bool = False
+               ) -> Dict[str, List[Case]]:
+    """The campaign's per-protocol case lists.  ``quick`` caps groups
+    and steps for smoke budgets (the capture path reruns the SAME
+    (groups, steps), so a scaled case is still exactly reproducible —
+    it just searches a smaller batch per run)."""
+    out: Dict[str, List[Case]] = {}
+    for case in CASES + DEMO_CASES:
+        name, cfg, scheds, groups, steps, pkey = case
+        if protocols is not None and name not in protocols:
+            continue
+        if name in (c[0] for c in DEMO_CASES) and protocols is None:
+            continue   # demo kernels only hunt when asked for by name
+        if quick:
+            groups, steps = min(groups, 16), min(steps, 80)
+        out.setdefault(name, []).append(
+            (name, cfg, scheds, groups, steps, pkey))
+    return out

@@ -1,0 +1,250 @@
+"""Witness classification: does a sim violation reproduce on the host?
+
+The hunt's verdict taxonomy (every witness lands in exactly one):
+
+- ``reproduced``  — the virtual-clock replay of the witness schedule
+  made the HOST runtime violate safety too (linearizability anomalies
+  in the replay workload's history, or the protocol's ``HUNT_ORACLE``
+  counter).  A host bug candidate: triage it like a failing regression
+  test (the corpus trace + ``trace host`` give the exact schedule).
+- ``diverged``    — the schedule replayed cleanly on the host.  Either
+  the sim models a fault the host implementation tolerates (sim
+  modeling gap — e.g. a seeded-bug sim twin replayed against the fixed
+  host replica) or the occurrence-indexed projection aimed a fault at
+  a send the host never made; the replay stats say which.
+- ``unmappable``  — the witness hinges on events the host surface
+  cannot express exactly: fault events on mailboxes outside the
+  protocol's ``TRACE_MSG_MAP`` (the baselined kernel-internal
+  mailboxes — wankeeper ``p2b``, epaxos ``gc``) or message
+  duplications (TCP/chan never duplicate).
+
+``classify`` is a pure function of (sim outcome, projection coverage,
+host outcome) so the taxonomy is unit-testable without booting
+clusters; ``replay_witness`` is the impure half that produces the host
+outcome via the virtual-clock fabric (host/fabric.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import importlib
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from paxi_tpu.trace.format import Trace
+from paxi_tpu.trace.host import host_algorithm, seq_schedule, trace_msg_map
+
+OUTCOMES = ("reproduced", "diverged", "unmappable")
+
+
+@dataclass
+class HostOutcome:
+    """What the host runtime did under the replayed schedule."""
+
+    anomalies: int = 0          # linearizability anomalies (history.py)
+    oracle_violations: int = 0  # protocol HUNT_ORACLE counter
+    ops_ok: int = 0
+    ops_failed: int = 0
+    steps: int = 0
+    fabric_stats: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def violated(self) -> bool:
+        return self.anomalies > 0 or self.oracle_violations > 0
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclass
+class Classification:
+    outcome: str                # one of OUTCOMES
+    reason: str
+    sim: Dict[str, int]
+    coverage: Dict[str, object]
+    host: Optional[dict] = None
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def coverage_of(trace: Trace, ids=None,
+                msg_map: Optional[Dict[str, str]] = None) -> dict:
+    """Projection-coverage summary for ``trace`` under ``msg_map``
+    (defaults to the trace's own protocol map) — the mappability half
+    of the classifier, also reused by ``trace host --all``."""
+    from paxi_tpu.core.config import local_config
+    cfg = trace.sim_config()
+    if ids is None:
+        ids = local_config(cfg.n_replicas, zones=cfg.n_zones).ids
+    sched, stats = seq_schedule(trace, ids, msg_map=msg_map)
+    return {
+        "mapped_events": stats["drops"] + stats["delays"],
+        "unmapped_events": stats["unmapped"],
+        "unmapped_mailboxes": sorted(sched.unmapped),
+        "dups": sched.dups_skipped,
+        "crashes": stats["crashes"],
+        "cuts": stats["cuts"],
+        "exact": sched.exact,
+    }
+
+
+def classify(sim_violations: int, coverage: dict,
+             host: Optional[HostOutcome]) -> Classification:
+    """The pure verdict (module docstring taxonomy)."""
+    sim = {"violations": int(sim_violations)}
+    if coverage.get("unmapped_mailboxes"):
+        return Classification(
+            outcome="unmappable",
+            reason="fault events on mailboxes outside TRACE_MSG_MAP: "
+                   + ", ".join(coverage["unmapped_mailboxes"]),
+            sim=sim, coverage=coverage)
+    if coverage.get("dups", 0) > 0:
+        return Classification(
+            outcome="unmappable",
+            reason=f"{coverage['dups']} duplication event(s) — "
+                   "TCP/chan transports never duplicate",
+            sim=sim, coverage=coverage)
+    if host is None:
+        raise ValueError("mappable witness classified without a host "
+                         "outcome — run the virtual-clock replay first")
+    if host.violated:
+        return Classification(
+            outcome="reproduced",
+            reason=f"host violated under the replayed schedule "
+                   f"(anomalies={host.anomalies}, "
+                   f"oracle={host.oracle_violations}) — host bug "
+                   "candidate",
+            sim=sim, coverage=coverage, host=host.to_json())
+    return Classification(
+        outcome="diverged",
+        reason="host replay stayed safe "
+               f"(ops ok={host.ops_ok}, failed={host.ops_failed}) — "
+               "sim modeling gap or occurrence-projection miss",
+        sim=sim, coverage=coverage, host=host.to_json())
+
+
+# ---- the impure half: virtual-clock host replay -------------------------
+async def replay_witness(trace: Trace, *, tail_steps: int = 10,
+                         op_every: int = 2, op_timeout: float = 5.0
+                         ) -> HostOutcome:
+    """Replay ``trace``'s schedule against the host runtime on the
+    virtual-clock fabric and report what the host did.
+
+    Protocol hooks (host module attributes):
+    - ``HUNT_DRIVER(cluster, fabric)``: install a protocol-specific
+      per-step driver instead of the default KV workload;
+    - ``HUNT_ORACLE(cluster) -> int``: a safety-violation counter read
+      after the replay (in addition to the history checker).
+    """
+    from paxi_tpu.host.fabric import VirtualClockFabric
+    from paxi_tpu.host.history import History
+    from paxi_tpu.host.simulation import Cluster, chan_config
+    from paxi_tpu.core.command import Command, Request
+    from paxi_tpu.protocols import _HOST_MODULES
+
+    algorithm = host_algorithm(trace.protocol)
+    if algorithm is None:
+        raise ValueError(f"{trace.protocol!r} has no host runtime")
+    scfg = trace.sim_config()
+    cfg = chan_config(scfg.n_replicas, zones=scfg.n_zones, tag="hunt")
+    sched, _ = seq_schedule(trace, cfg.ids,
+                            msg_map=trace_msg_map(trace.protocol))
+    fabric = VirtualClockFabric(sched)
+    cluster = Cluster(algorithm, cfg=cfg, http=False, fabric=fabric)
+    await cluster.start()
+    host_mod = importlib.import_module(_HOST_MODULES[algorithm])
+    out = HostOutcome(steps=sched.n_steps)
+    history = None
+    ops: list = []
+    try:
+        driver = getattr(host_mod, "HUNT_DRIVER", None)
+        if driver is not None:
+            driver(cluster, fabric)
+        else:
+            # default closed-ish-loop KV workload: deterministic op
+            # stream (trace-seeded), round-robin over replicas, writes
+            # of unique values so the history checker's read-from
+            # edges are unambiguous
+            history = History()
+            rng = random.Random(trace.seed)
+            ids = sorted(cluster.ids)
+            n_keys = max(1, min(scfg.n_keys, 4))
+
+            async def one_op(replica, key: int, value: bytes):
+                fut = asyncio.get_running_loop().create_future()
+                start = time.monotonic()
+                cluster[replica].handle_client_request(Request(
+                    command=Command(key, value, "hunt",
+                                    len(ops)), reply_to=fut))
+                try:
+                    rep = await asyncio.wait_for(fut, op_timeout)
+                except asyncio.TimeoutError:
+                    out.ops_failed += 1
+                    return
+                end = time.monotonic()
+                if rep.err is not None:
+                    out.ops_failed += 1
+                    return
+                out.ops_ok += 1
+                if value:
+                    history.add(key, value, None, start, end)
+                else:
+                    history.add(key, None, rep.value, start, end)
+
+            def issue(t: int) -> None:
+                if t % op_every:
+                    return
+                replica = ids[(t // op_every) % len(ids)]
+                key = rng.randrange(n_keys)
+                write = rng.random() < 0.6
+                value = f"w{t}".encode() if write else b""
+                ops.append(asyncio.ensure_future(
+                    one_op(replica, key, value)))
+
+            fabric.on_step(issue)
+
+        await fabric.run(sched.n_steps, drain=True)
+        # a fault-free logical tail so in-flight request/reply rounds
+        # can finish before the oracle reads the cluster
+        fabric.sched = None
+        await fabric.run(tail_steps, drain=True)
+        if ops:
+            await asyncio.wait(ops, timeout=op_timeout)
+        for f in ops:
+            if not f.done():
+                f.cancel()
+                out.ops_failed += 1
+        if history is not None:
+            out.anomalies = history.linearizable()
+        oracle = getattr(host_mod, "HUNT_ORACLE", None)
+        if oracle is not None:
+            out.oracle_violations = int(oracle(cluster))
+        out.fabric_stats = dict(fabric.stats)
+    finally:
+        await cluster.stop()
+    return out
+
+
+def classify_witness(trace: Trace, *, host_replay: bool = True,
+                     **replay_kw) -> Classification:
+    """The engine's one-stop path: coverage -> (maybe) replay ->
+    verdict.  Synchronous wrapper (each replay gets a fresh loop)."""
+    cov = coverage_of(trace)
+    if cov["unmapped_mailboxes"] or cov["dups"] > 0 or not host_replay:
+        host = None
+        if not (cov["unmapped_mailboxes"] or cov["dups"] > 0):
+            # replay disabled by caller: report honestly as a coverage
+            # gap rather than guessing a verdict
+            return Classification(
+                outcome="unmappable",
+                reason="host replay disabled (--no-host)",
+                sim={"violations":
+                     int(trace.meta.get("group_violations", -1))},
+                coverage=cov)
+    else:
+        host = asyncio.run(replay_witness(trace, **replay_kw))
+    return classify(trace.meta.get("group_violations", -1), cov, host)

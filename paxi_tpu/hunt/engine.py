@@ -1,0 +1,258 @@
+"""The divergence-hunting campaign engine.
+
+One campaign = a budgeted sweep of the adversarial case matrix
+(hunt/cases.py), per protocol:
+
+    fuzz the sim  ->  capture each violating run as a trace
+                  ->  dedup against the corpus (schedule hash)
+                  ->  ddmin-shrink new witnesses to minimal schedules
+                  ->  replay the minimal witness on the host runtime
+                      through the virtual-clock fabric
+                  ->  classify: reproduced / diverged / unmappable
+
+State lives under the campaign directory (default ``hunt/``)::
+
+    state.json        # resumable progress: done runs + witness verdicts
+    corpus/           # deduplicated witness store (hunt/corpus.py)
+    HUNT_REPORT.json  # machine-readable campaign report
+    HUNT_REPORT.md    # human triage report
+
+Campaigns are resumable: every completed (case, schedule, seed) run is
+recorded before the next starts, so an interrupted ``hunt run`` picks
+up where it left off, and raising ``--budget`` on a finished campaign
+extends the seed stream instead of redoing work.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from paxi_tpu.hunt import cases as hc
+from paxi_tpu.hunt.classify import classify_witness
+from paxi_tpu.hunt.corpus import Corpus
+
+_STATE_VERSION = 1
+
+
+def _default_traces_dir() -> str:
+    """fuzz_soak.py's dump directory (repo root), the retroactive
+    corpus seed."""
+    here = Path(__file__).resolve().parents[2]
+    return str(here / "traces")
+
+
+class Campaign:
+    def __init__(self, root, protocols: Optional[List[str]] = None,
+                 budget: int = 5, quick: bool = False,
+                 shrink_trials: int = 120, host_replay: bool = True,
+                 traces_dir: Optional[str] = None, log=None):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.corpus = Corpus(self.root / "corpus")
+        self.budget = int(budget)
+        self.quick = quick
+        self.shrink_trials = shrink_trials
+        self.host_replay = host_replay
+        self.traces_dir = (_default_traces_dir() if traces_dir is None
+                           else traces_dir)
+        self.log = log or (lambda m: print(m, flush=True))
+        self.cases = hc.hunt_cases(protocols, quick=quick)
+        if protocols:
+            missing = sorted(set(protocols) - set(self.cases))
+            if missing:
+                raise KeyError(f"no hunt cases for protocols {missing}; "
+                               f"have {sorted(set(c[0] for c in hc.CASES + hc.DEMO_CASES))}")
+        self._state_path = self.root / "state.json"
+        self.state = self._load_state()
+        # one compiled fuzz runner per (protocol, geometry, schedule):
+        # later rounds of the seed stream reuse the executable instead
+        # of re-jitting (the capture path has its own cache)
+        self._run_cache: Dict[tuple, object] = {}
+
+    # ---- state -----------------------------------------------------------
+    def _load_state(self) -> dict:
+        if self._state_path.exists():
+            with open(self._state_path) as f:
+                st = json.load(f)
+            if st.get("version") != _STATE_VERSION:
+                raise ValueError(
+                    f"{self._state_path}: campaign state v"
+                    f"{st.get('version')} != v{_STATE_VERSION}; start a "
+                    "fresh --dir")
+            return st
+        return {"version": _STATE_VERSION, "seeded": False,
+                "done": {}, "runs": [], "witnesses": {}}
+
+    def _save_state(self) -> None:
+        tmp = str(self._state_path) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.state, f, indent=1)
+        os.replace(tmp, self._state_path)
+
+    # ---- planning --------------------------------------------------------
+    def _plan(self, protocol: str) -> List[tuple]:
+        """The next runs for ``protocol``: the deterministic
+        (case, schedule, seed) enumeration minus completed runs, capped
+        at ``budget`` total completed+planned."""
+        done = set(self.state["done"].get(protocol, []))
+        plan, total = [], len(done)
+        rounds = 0
+        while total + len(plan) < self.budget and rounds < 10_000:
+            for ci, (_, cfg, scheds, groups, steps, pkey) in enumerate(
+                    self.cases[protocol]):
+                for fz in scheds:
+                    key = f"{ci}:{hc.sched_name(fz)}:{rounds}"
+                    if key in done or total + len(plan) >= self.budget:
+                        continue
+                    plan.append((key, cfg, fz, rounds, groups, steps,
+                                 pkey))
+            rounds += 1
+        return plan
+
+    # ---- one fuzz run ----------------------------------------------------
+    def _run_one(self, protocol: str, key: str, cfg, fz, seed: int,
+                 groups: int, steps: int, pkey: str) -> dict:
+        import jax.random as jr
+
+        from paxi_tpu.protocols import sim_protocol
+        from paxi_tpu.sim import make_run
+
+        proto = sim_protocol(protocol)
+        t0 = time.perf_counter()
+        ck = (protocol, cfg, fz)
+        run = self._run_cache.get(ck)
+        if run is None:
+            run = self._run_cache[ck] = make_run(proto, cfg, fz)
+        _, metrics, viols = run(jr.PRNGKey(seed), groups, steps)
+        v = int(viols)
+        rec = {"protocol": protocol, "run": key,
+               "schedule": hc.sched_name(fz), "seed": seed,
+               "groups": groups, "steps": steps, "violations": v,
+               "progress": int(metrics[pkey]),
+               "wall_s": round(time.perf_counter() - t0, 3)}
+        if v == 0:
+            return rec
+        rec.update(self._process_witness(proto, protocol, cfg, fz, seed,
+                                         groups, steps))
+        return rec
+
+    def _seen(self, h: str) -> bool:
+        """Has this schedule hash already been through the classifier
+        (as a capture or as a minimal witness)?"""
+        ws = self.state["witnesses"]
+        return h in ws or any(w.get("capture") == h for w in ws.values())
+
+    def _process_witness(self, proto, protocol: str, cfg, fz, seed: int,
+                         groups: int, steps: int) -> dict:
+        from paxi_tpu import trace as tr
+
+        t = tr.capture(proto, cfg, fz, seed, groups, steps,
+                       proto_name=protocol)
+        if t is None:
+            return {"witness": None, "note": "violation did not recapture"}
+        h, new = self.corpus.add(t, origin=f"hunt:{protocol}:s{seed}")
+        if not new and self._seen(h):
+            return {"witness": h, "note": "duplicate schedule (corpus hit)"}
+        self.log(f"  witness {h[:16]} ({t.n_events()} events) — shrinking")
+        wit = {"protocol": protocol, "capture": h,
+               "violations": int(t.meta.get("group_violations", 0)),
+               "events_before": t.n_events()}
+        try:
+            mini, sstats = tr.shrink(t, proto,
+                                     max_trials=self.shrink_trials)
+            mh, _ = self.corpus.add(mini,
+                                    origin=f"shrunk:{h[:16]}")
+            wit.update(minimal=mh, events_after=mini.n_events(),
+                       shrink_trials=sstats.get("trials"))
+        except ValueError as e:
+            # a capture that does not reproduce under shrink's oracle
+            # is still classifiable from the unshrunk schedule
+            mini = t
+            wit.update(minimal=h, events_after=t.n_events(),
+                       shrink_error=str(e))
+        try:
+            c = classify_witness(mini, host_replay=self.host_replay)
+            wit["classification"] = c.to_json()
+            self.log(f"  -> {c.outcome}: {c.reason}")
+        except Exception:
+            wit["classification"] = {
+                "outcome": "unclassified",
+                "reason": traceback.format_exc(limit=3)}
+            self.log("  -> UNCLASSIFIED (replay error)")
+        self.state["witnesses"][wit.get("minimal") or h] = wit
+        return {"witness": h,
+                "outcome": wit["classification"]["outcome"]}
+
+    # ---- the campaign ----------------------------------------------------
+    def run(self) -> dict:
+        if not self.state["seeded"]:
+            added, skipped = self.corpus.seed_from(self.traces_dir)
+            self.state["seeded"] = True
+            if added or skipped:
+                self.log(f"corpus: seeded {added} trace(s) from "
+                         f"{self.traces_dir} ({skipped} skipped)")
+            self._save_state()
+        for protocol in sorted(self.cases):
+            plan = self._plan(protocol)
+            if not plan:
+                continue
+            self.log(f"{protocol}: {len(plan)} run(s) "
+                     f"({len(self.state['done'].get(protocol, []))} done)")
+            for key, cfg, fz, seed, groups, steps, pkey in plan:
+                rec = self._run_one(protocol, key, cfg, fz, seed,
+                                    groups, steps, pkey)
+                self.state["runs"].append(rec)
+                self.state["done"].setdefault(protocol, []).append(key)
+                self._save_state()
+                if rec["violations"]:
+                    self.log(f"  {key}: {rec['violations']} violation(s)")
+        self._classify_backlog()
+        return self.write_report()
+
+    def _classify_backlog(self) -> None:
+        """Verdicts for corpus entries that never went through the
+        classifier — seeded traces (fuzz_soak dumps imported on first
+        run) for the campaign's protocols."""
+        for h, e in sorted(self.corpus.index.items(),
+                           key=lambda kv: kv[1]["ordinal"]):
+            if e["protocol"] not in self.cases or self._seen(h):
+                continue
+            self.log(f"backlog witness {h[:16]} ({e['protocol']}, "
+                     f"{e['origin']})")
+            t = self.corpus.load(h)
+            wit = {"protocol": e["protocol"], "capture": h, "minimal": h,
+                   "violations": e["violations"],
+                   "events_before": e["events"],
+                   "events_after": e["events"]}
+            try:
+                c = classify_witness(t, host_replay=self.host_replay)
+                wit["classification"] = c.to_json()
+                self.log(f"  -> {c.outcome}: {c.reason}")
+            except Exception:
+                wit["classification"] = {
+                    "outcome": "unclassified",
+                    "reason": traceback.format_exc(limit=3)}
+                self.log("  -> UNCLASSIFIED (replay error)")
+            self.state["witnesses"][h] = wit
+            self._save_state()
+
+    # ---- reporting -------------------------------------------------------
+    def status(self) -> dict:
+        from paxi_tpu.hunt.report import summarize
+        return summarize(self.state, self.corpus, self.budget,
+                         sorted(self.cases))
+
+    def write_report(self) -> dict:
+        from paxi_tpu.hunt.report import build_report, render_markdown
+        rep = build_report(self.state, self.corpus, self.budget,
+                           sorted(self.cases))
+        with open(self.root / "HUNT_REPORT.json", "w") as f:
+            json.dump(rep, f, indent=1)
+        with open(self.root / "HUNT_REPORT.md", "w") as f:
+            f.write(render_markdown(rep))
+        return rep

@@ -34,6 +34,9 @@ _SIM_MODULES = {
 }
 
 _HOST_MODULES = {
+    # host twin of the trace-subsystem demo kernel: the hunt engine's
+    # end-to-end reproduction fixture (see trace/demo_host.py)
+    "fragile_counter": "paxi_tpu.trace.demo_host",
     "paxos": "paxi_tpu.protocols.paxos.host",
     "abd": "paxi_tpu.protocols.abd.host",
     "chain": "paxi_tpu.protocols.chain.host",

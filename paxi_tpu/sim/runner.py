@@ -39,6 +39,10 @@ class SimResult:
     violations: jax.Array        # total invariant violations (int32)
     steps: int
     groups: int
+    # per-step counter time series ({name: (T,) int32}, prefix
+    # stripped) — the scan's ys before the time reduction; populated
+    # only when ``simulate(..., series=True)`` asked for the export
+    counter_series: Optional[Dict[str, jax.Array]] = None
 
     @property
     def counters(self) -> Dict[str, jax.Array]:
@@ -208,11 +212,17 @@ def finish_run(proto: SimProtocol, cfg: SimConfig, carry, viols,
 
 
 def make_run(proto: SimProtocol, cfg: SimConfig,
-             fuzz: FuzzConfig = FAULT_FREE):
+             fuzz: FuzzConfig = FAULT_FREE, series: bool = False):
     """Build ``run(rng, n_groups, n_steps) -> SimResult`` (jitted).
 
     n_groups / n_steps are static; the whole simulation is one XLA
     computation (scan over steps of a vmapped group transition).
+
+    ``series=True`` additionally returns the per-step ``net_*``
+    counter stack ({name: (T,)}) as a fourth output — the scan's ys
+    BEFORE the time reduction, i.e. a counter time series at zero
+    extra on-device cost (the reduction output is unchanged, so the
+    default signature stays three-valued for every existing caller).
     """
     body = make_scan_body(proto, cfg, fuzz)
 
@@ -221,7 +231,10 @@ def make_run(proto: SimProtocol, cfg: SimConfig,
         carry = init_carry(proto, cfg, fuzz, n_groups, rng)
         carry, (viols, counts) = jax.lax.scan(body, carry,
                                               jnp.arange(n_steps))
-        return finish_run(proto, cfg, carry, viols, counts)
+        out = finish_run(proto, cfg, carry, viols, counts)
+        if series:
+            return (*out, counts)
+        return out
 
     return run
 
@@ -307,13 +320,17 @@ def make_pinned_run(proto: SimProtocol, cfg: SimConfig,
 
 def simulate(proto: SimProtocol, cfg: SimConfig, n_groups: int,
              n_steps: int, fuzz: FuzzConfig = FAULT_FREE,
-             seed: int = 0) -> SimResult:
-    """Convenience one-shot entry (compiles on first call per shape)."""
-    run = make_run(proto, cfg, fuzz)
-    state, metrics, viols = run(jr.PRNGKey(seed), n_groups, n_steps)
+             seed: int = 0, series: bool = False) -> SimResult:
+    """Convenience one-shot entry (compiles on first call per shape).
+    ``series=True`` also exports the per-step counter time series on
+    ``SimResult.counter_series``."""
+    run = make_run(proto, cfg, fuzz, series=series)
+    out = run(jr.PRNGKey(seed), n_groups, n_steps)
+    state, metrics, viols = out[:3]
     jax.block_until_ready(viols)
+    cs = (counters_of(out[3]) if series else None)
     return SimResult(state=state, metrics=metrics, violations=viols,
-                     steps=n_steps, groups=n_groups)
+                     steps=n_steps, groups=n_groups, counter_series=cs)
 
 
 _CONTINUE_CACHE: dict = {}

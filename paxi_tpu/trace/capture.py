@@ -20,7 +20,7 @@ import numpy as np
 from paxi_tpu.sim.runner import make_recorded_run
 from paxi_tpu.sim.types import FuzzConfig, SimConfig, SimProtocol
 from paxi_tpu.trace import replay as _replay
-from paxi_tpu.trace.format import Trace, make_meta
+from paxi_tpu.trace.format import Trace, make_meta, schedule_hash
 
 
 def _slice_group(sched, g: int, batched: bool):
@@ -90,4 +90,8 @@ def capture(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
         capture_counters={k: int(v)
                           for k, v in counters_of(metrics).items()},
         shrunk=False)
-    return Trace(meta=meta, sched=gsched)
+    t = Trace(meta=meta, sched=gsched)
+    # dedup identity (hunt corpus): stamped here so the in-memory trace
+    # and its saved form carry identical meta
+    meta["schedule_hash"] = schedule_hash(t)
+    return t

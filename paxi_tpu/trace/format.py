@@ -26,6 +26,7 @@ Schedule pytree (single group, time-major)::
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 from dataclasses import dataclass
 from typing import Any, Dict
@@ -91,7 +92,29 @@ class Trace:
 
     def with_sched(self, sched, **meta_updates) -> "Trace":
         meta = dict(self.meta, **meta_updates)
-        return Trace(meta=meta, sched=sched)
+        t = Trace(meta=meta, sched=sched)
+        if "schedule_hash" in meta and "schedule_hash" not in meta_updates:
+            # an inherited stamp describes the OLD schedule — refresh it
+            # so corpus dedup (hunt/corpus.py) never aliases an edited
+            # (e.g. shrunk) trace to its parent
+            meta["schedule_hash"] = schedule_hash(t)
+        return t
+
+
+def schedule_hash(trace: "Trace") -> str:
+    """Content hash of (protocol, schedule planes) — the corpus dedup
+    key (hunt/corpus.py).  Deliberately independent of provenance
+    (seed, group, fuzz knobs): two fuzz runs that produced the same
+    effective fault schedule for the same protocol are the same
+    witness."""
+    h = hashlib.sha256()
+    h.update(trace.protocol.encode())
+    for name, arr in sorted(_flatten(trace.sched).items()):
+        h.update(name.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
 
 
 def make_meta(proto_name: str, cfg: SimConfig, fuzz: FuzzConfig,
@@ -126,6 +149,10 @@ def save(path: str, trace: Trace) -> str:
     flat = _flatten(trace.sched)
     meta = dict(trace.meta)
     meta.setdefault("trace_version", TRACE_VERSION)
+    # every dumped trace carries its dedup identity (and `protocol` is
+    # already in meta), so corpora seeded from pre-existing trace dirs
+    # dedup without re-deriving anything
+    meta.setdefault("schedule_hash", schedule_hash(trace))
     flat[_META_KEY] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8)
     path = _norm(path)

@@ -118,10 +118,84 @@ def directives_json(dirs: Sequence[Directive]) -> List[dict]:
             for d in dirs]
 
 
+# ---- sequenced (virtual-clock) vocabulary -------------------------------
+@dataclass
+class SeqFault:
+    """One occurrence-indexed fault for the virtual-clock fabric
+    (host/fabric.py): act on the ``occurrence``-th (0-based) host send
+    of class ``msg_type`` on src->dst.  Unlike ``DelayMsg``'s wall-clock
+    window, ``delay_steps`` is an exact number of LOGICAL steps, so a
+    recorded reorder replays as the same delivery order, not a time
+    smear.  ``step`` is provenance (the recorded sim step)."""
+
+    src: str
+    dst: str
+    msg_type: str
+    occurrence: int
+    action: str                # "drop" | "delay"
+    delay_steps: int = 0       # extra logical steps beyond the normal 1
+    step: int = 0
+
+
+@dataclass
+class SeqSchedule:
+    """A trace projected onto the virtual-clock fabric's fault surface:
+    occurrence-indexed per-message faults plus per-logical-step crash
+    and partition-cut sets — the exact-order alternative to the
+    windowed ``host_directives`` projection."""
+
+    n_steps: int
+    faults: List[SeqFault] = dataclasses.field(default_factory=list)
+    crashed: Dict[str, List[int]] = dataclasses.field(default_factory=dict)
+    cut: Dict[Tuple[str, str], List[int]] = dataclasses.field(
+        default_factory=dict)
+    # fault events the fabric cannot replay exactly: planes with no
+    # TRACE_MSG_MAP entry (mailbox -> event count) and duplications
+    # (neither TCP nor the chan fabric ever duplicate)
+    unmapped: Dict[str, int] = dataclasses.field(default_factory=dict)
+    dups_skipped: int = 0
+
+    def __post_init__(self):
+        self._idx: Dict[Tuple[str, str, str], Dict[int, SeqFault]] = {}
+        for f in self.faults:
+            self._idx.setdefault(
+                (f.src, f.dst, f.msg_type), {})[f.occurrence] = f
+        self._crashed = {i: frozenset(ts) for i, ts in self.crashed.items()}
+        self._cut = {e: frozenset(ts) for e, ts in self.cut.items()}
+
+    # fabric-facing lookups (hot path: one dict probe per send)
+    def fault_for(self, src: str, dst: str, msg_type: str,
+                  occurrence: int) -> Optional[SeqFault]:
+        m = self._idx.get((src, dst, msg_type))
+        return m.get(occurrence) if m else None
+
+    def is_crashed(self, id: str, step: int) -> bool:
+        return step in self._crashed.get(id, ())
+
+    def is_cut(self, src: str, dst: str, step: int) -> bool:
+        return step in self._cut.get((src, dst), ())
+
+    @property
+    def exact(self) -> bool:
+        """True when every recorded fault event replays exactly."""
+        return not self.unmapped and self.dups_skipped == 0
+
+    def to_json(self) -> dict:
+        return {
+            "n_steps": self.n_steps,
+            "faults": [dataclasses.asdict(f) for f in self.faults],
+            "crashed": {i: list(ts) for i, ts in self.crashed.items()},
+            "cut": {f"{s}->{d}": list(ts)
+                    for (s, d), ts in self.cut.items()},
+            "unmapped": dict(self.unmapped),
+            "dups_skipped": self.dups_skipped,
+        }
+
+
 # ---- projection ---------------------------------------------------------
-def trace_msg_map(protocol: str) -> Dict[str, str]:
-    """The protocol's sim-mailbox-name -> host-message-class map
-    (``TRACE_MSG_MAP`` in its host module; {} when it has none).
+def host_algorithm(protocol: str) -> Optional[str]:
+    """The host-registry name a sim protocol replays against, or None
+    for sim-only protocols.
 
     Variant protocols (seeded-bug twins like ``wankeeper_nofloor``)
     register in ``_SIM_MODULES`` pointing at the base protocol's sim
@@ -133,10 +207,17 @@ def trace_msg_map(protocol: str) -> Dict[str, str]:
         sim_mod = _SIM_MODULES.get(protocol, "").partition(":")[0]
         parts = sim_mod.rsplit(".", 2)
         base = parts[-2] if len(parts) >= 2 else protocol
-    mod = _HOST_MODULES.get(base)
-    if mod is None:
+    return base if base in _HOST_MODULES else None
+
+
+def trace_msg_map(protocol: str) -> Dict[str, str]:
+    """The protocol's sim-mailbox-name -> host-message-class map
+    (``TRACE_MSG_MAP`` in its host module; {} when it has none)."""
+    from paxi_tpu.protocols import _HOST_MODULES
+    base = host_algorithm(protocol)
+    if base is None:
         return {}
-    return dict(getattr(importlib.import_module(mod),
+    return dict(getattr(importlib.import_module(_HOST_MODULES[base]),
                         "TRACE_MSG_MAP", {}))
 
 
@@ -225,6 +306,80 @@ def host_directives(trace: Trace, ids: Sequence, step_s: float = 0.05,
                 dirs.append(DropWin(ids[i], ids[j], lo * step_s,
                                     (hi + 1) * step_s))
     return dirs, stats
+
+
+def seq_schedule(trace: Trace, ids: Sequence,
+                 msg_map: Optional[Dict[str, str]] = None
+                 ) -> Tuple[SeqSchedule, Dict[str, int]]:
+    """Project ``trace`` onto the virtual-clock fabric's sequenced
+    fault surface (the exact-order sibling of ``host_directives``).
+
+    Same occurrence approximation as ``DropMsg`` (the host runtime has
+    no lock-step rounds, so the i-th recorded fault event on an
+    (edge, class) aims at the i-th matching host send), but delays keep
+    their exact per-event logical magnitude instead of degrading to a
+    time window, and crashes/cuts become per-logical-step sets the
+    fabric consults at send/delivery time — so reorder witnesses replay
+    as the same delivery ORDER the sim saw."""
+    from paxi_tpu.core.ident import ID
+    ids = [str(i) for i in sorted(ID(str(i)) for i in ids)]
+    if msg_map is None:
+        msg_map = trace_msg_map(trace.protocol)
+    sched = trace.sched
+    stats = {"drops": 0, "delays": 0, "unmapped": 0, "dups_skipped": 0,
+             "crashes": 0, "cuts": 0}
+    unmapped: Dict[str, int] = {}
+
+    # per (edge, class): fault events ordered by recorded step share one
+    # occurrence counter — drop-then-delay on one edge aims at the 1st
+    # and 2nd matching sends respectively
+    per_edge: Dict[Tuple[str, int, int], List[Tuple[int, str, int]]] = {}
+    for name in sorted(sched["faults"]):
+        f = sched["faults"][name]
+        drop = np.asarray(f["drop"])
+        delay = np.asarray(f["delay"])
+        stats["dups_skipped"] += int(np.sum(np.asarray(f["dup"])))
+        if name not in msg_map:
+            n_ev = int(np.sum(drop)) + int(np.sum(delay > 1))
+            if n_ev:
+                unmapped[name] = unmapped.get(name, 0) + n_ev
+                stats["unmapped"] += n_ev
+            continue
+        for t, i, j in np.argwhere(drop):
+            per_edge.setdefault((msg_map[name], int(i), int(j)),
+                                []).append((int(t), "drop", 0))
+            stats["drops"] += 1
+        for t, i, j in np.argwhere(delay > 1):
+            per_edge.setdefault((msg_map[name], int(i), int(j)),
+                                []).append(
+                                    (int(t), "delay",
+                                     int(delay[t, i, j]) - 1))
+            stats["delays"] += 1
+    faults: List[SeqFault] = []
+    for (mt, i, j), evs in sorted(per_edge.items()):
+        for occ, (t, action, extra) in enumerate(sorted(evs)):
+            faults.append(SeqFault(ids[i], ids[j], mt, occurrence=occ,
+                                   action=action, delay_steps=extra,
+                                   step=t))
+
+    crashed = np.asarray(sched["crashed"])
+    crash_map: Dict[str, List[int]] = {}
+    for t, i in np.argwhere(crashed):
+        crash_map.setdefault(ids[int(i)], []).append(int(t))
+        stats["crashes"] += 1
+    conn = np.asarray(sched["conn"])
+    cut_map: Dict[Tuple[str, str], List[int]] = {}
+    for t, i, j in np.argwhere(~conn):
+        if i == j:
+            continue
+        cut_map.setdefault((ids[int(i)], ids[int(j)]), []).append(int(t))
+        stats["cuts"] += 1
+    out = SeqSchedule(n_steps=trace.n_steps, faults=faults,
+                      crashed={k: sorted(v) for k, v in crash_map.items()},
+                      cut={k: sorted(v) for k, v in cut_map.items()},
+                      unmapped=unmapped,
+                      dups_skipped=stats["dups_skipped"])
+    return out, stats
 
 
 # ---- application --------------------------------------------------------

@@ -11,12 +11,29 @@
 #                                # analysis")
 #   scripts/verify.sh --metrics  # prepend the observability smoke stage
 #                                # (5 s chan bench + /metrics scrape)
-# Stage flags stack: `verify.sh --lint --metrics` runs both.
+#   scripts/verify.sh --hunt     # prepend the divergence-hunt smoke
+#                                # stage: a ~40 s micro-campaign
+#                                # (paxos + abd + the fragile_counter
+#                                # positive control) that must end with
+#                                # zero UNCLASSIFIED outcomes
+# Stage flags stack: `verify.sh --lint --metrics --hunt` runs all.
 set -o pipefail
 cd "$(dirname "$0")/.."
 
-while [ "${1:-}" = "--lint" ] || [ "${1:-}" = "--metrics" ]; do
-  if [ "$1" = "--lint" ]; then
+while [ "${1:-}" = "--lint" ] || [ "${1:-}" = "--metrics" ] \
+    || [ "${1:-}" = "--hunt" ]; do
+  if [ "$1" = "--hunt" ]; then
+    shift
+    echo "== hunt micro-campaign (paxi_tpu/hunt/) =="
+    # fresh campaign dir each time: the smoke checks the whole loop
+    # (fuzz -> capture -> shrink -> fabric replay -> classify), and
+    # `hunt run` exits 2 on any unclassified witness
+    HUNT_DIR=$(mktemp -d /tmp/paxi_hunt_smoke.XXXXXX)
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python -m paxi_tpu hunt run \
+      --budget 2 --quick --protocols paxos,abd,fragile_counter \
+      --dir "$HUNT_DIR" --traces-dir "$HUNT_DIR/noseed" || exit $?
+    rm -rf "$HUNT_DIR"
+  elif [ "$1" = "--lint" ]; then
     shift
     echo "== static analysis (paxi-lint) =="
     # pure AST — no jax import, sub-second; exits 1 on any violation

@@ -94,8 +94,14 @@ def test_tracemap_registry_sees_every_protocol():
     assert {"paxos", "paxos_pg", "abd", "chain", "wpaxos", "epaxos",
             "kpaxos", "dynamo", "sdpaxos", "wankeeper",
             "blockchain"} <= protos
-    # sim-only protocols must not demand a host map
-    assert "fragile_counter" not in protos
+    # fragile_counter gained a host twin with the hunt subsystem
+    # (trace/demo_host.py) — the rule must check its map like any other
+    # pair, so the hunt's reproduction fixture can't silently lose
+    # projection coverage
+    assert "fragile_counter" in protos
+    # the seeded-bug variant dedups onto the wankeeper pair rather than
+    # demanding its own host module
+    assert "wankeeper_nofloor" not in protos
 
 
 def test_tracemap_runs_under_directory_restriction():

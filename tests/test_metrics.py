@@ -205,6 +205,32 @@ def test_sim_counters_on_simresult():
     assert c["crash_steps"] == 0 and c["cut_edge_steps"] == 0
 
 
+@pytest.mark.jax
+def test_counter_series_export():
+    """simulate(series=True) exports the per-step counter time series
+    (the ROADMAP metrics item): one (T,) int32 per counter whose time
+    sum equals the aggregated counter — the fast fragile kernel keeps
+    this inside the tier-1 budget."""
+    import numpy as np
+
+    from paxi_tpu.metrics.simcount import COUNTER_NAMES
+    from paxi_tpu.protocols import sim_protocol
+    from paxi_tpu.sim import FuzzConfig, SimConfig, simulate
+
+    res = simulate(sim_protocol("fragile_counter"), SimConfig(n_replicas=3),
+                   4, 20, fuzz=FuzzConfig(p_drop=0.2, max_delay=2),
+                   seed=0, series=True)
+    assert set(res.counter_series) == set(COUNTER_NAMES)
+    for k, v in res.counter_series.items():
+        arr = np.asarray(v)
+        assert arr.shape == (20,)
+        assert int(arr.sum()) == int(res.counters[k]), k
+    assert int(np.asarray(res.counter_series["msgs_dropped"]).sum()) > 0
+    # the default path stays series-free (no extra transfer)
+    assert simulate(sim_protocol("fragile_counter"), SimConfig(n_replicas=3),
+                    4, 20, seed=0).counter_series is None
+
+
 def _assert_counter_roundtrip(name: str):
     """Capture's whole-batch counters reproduce exactly under pinned
     replay — the counter half of the determinism guarantee."""
