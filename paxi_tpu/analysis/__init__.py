@@ -1,15 +1,24 @@
 """paxi-lint: protocol-aware static analysis for the two runtimes.
 
-Four AST rule families over the repo, each exploiting an invariant the
-architecture already promises (see each module's docstring):
+Stage 1 — per-function AST rule families, each exploiting an invariant
+the architecture already promises (see each module's docstring):
 
 - ``kernel-purity``        (purity.py,      PXK1xx)
 - ``handler-completeness`` (handlers.py,    PXH2xx)
 - ``trace-map``            (tracemap.py,    PXT3xx)
-- ``host-concurrency``     (concurrency.py, PXC4xx)
+- ``host-concurrency``     (concurrency.py, PXC4xx + PXC45x)
 
-Entry points: ``python -m paxi_tpu lint [--rule ...] [--json]`` (cli.py)
-and :func:`run_lint` for tests/tooling.  Intentional exceptions live in
+Stage 2 — protocol-*semantics* dataflow families on the shared
+interprocedural engine (flow.py: module-local call graph, symbolic
+int-expression evaluator, guard domination):
+
+- ``quorum-safety``        (quorum.py,      PXQ5xx)
+- ``ballot-guard``         (ballots.py,     PXB6xx)
+- ``sim-host-parity``      (parity.py,      PXS7xx)
+
+Entry points: ``python -m paxi_tpu lint [--rule ...] [--json]`` (cli.py;
+``--rule`` takes family names or code prefixes like ``PXQ,PXB``) and
+:func:`run_lint` for tests/tooling.  Intentional exceptions live in
 ``analysis/baseline.toml``; one-line escapes use an inline
 ``# paxi-lint: disable=CODE`` comment.  Purely static — no module under
 analysis is ever imported, so the linter needs no jax and is safe on
@@ -21,14 +30,15 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence
 
-from paxi_tpu.analysis import astutil, concurrency, handlers, purity, \
-    tracemap
+from paxi_tpu.analysis import astutil, ballots, concurrency, handlers, \
+    parity, purity, quorum, tracemap
 from paxi_tpu.analysis.model import (LintReport, Suppression, Violation,
                                      apply_suppressions, inline_disables,
                                      load_baseline)
 
-__all__ = ["RULES", "DEFAULT_BASELINE", "LintReport", "Suppression",
-           "Violation", "repo_root", "run_lint"]
+__all__ = ["RULES", "CODE_PREFIXES", "DEFAULT_BASELINE", "LintReport",
+           "Suppression", "Violation", "repo_root", "resolve_rules",
+           "run_lint"]
 
 # rule family name -> module exposing check(root, files=None)
 RULES = {
@@ -36,7 +46,47 @@ RULES = {
     handlers.RULE: handlers,
     tracemap.RULE: tracemap,
     concurrency.RULE: concurrency,
+    quorum.RULE: quorum,
+    ballots.RULE: ballots,
+    parity.RULE: parity,
 }
+
+# violation-code prefix -> rule family, the CLI's short spelling
+# (`--rule PXQ,PXB`); PXC covers both the stage-1 checks and the
+# PXC45x deepening (one module)
+CODE_PREFIXES = {
+    "PXK": purity.RULE,
+    "PXH": handlers.RULE,
+    "PXT": tracemap.RULE,
+    "PXC": concurrency.RULE,
+    "PXQ": quorum.RULE,
+    "PXB": ballots.RULE,
+    "PXS": parity.RULE,
+}
+
+# pair-driven rules (registry-derived sim/host pairs instead of globs)
+_PAIR_RULES = {tracemap.RULE: tracemap, parity.RULE: parity}
+
+
+def resolve_rules(specs: Sequence[str]) -> List[str]:
+    """Family names, ``PXQ``-style code prefixes, and comma-separated
+    combinations thereof -> unique family names (raises KeyError on
+    anything unknown)."""
+    out: List[str] = []
+    for spec in specs:
+        for token in spec.split(","):
+            token = token.strip()
+            if not token:
+                continue
+            name = (token if token in RULES
+                    else CODE_PREFIXES.get(token.upper()))
+            if name is None:
+                raise KeyError(
+                    f"unknown rule {token!r}; have {sorted(RULES)} "
+                    f"or prefixes {sorted(CODE_PREFIXES)}")
+            if name not in out:
+                out.append(name)
+    return out
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.toml"
 
@@ -72,10 +122,7 @@ def run_lint(root: Optional[Path] = None,
     layers.  ``baseline_path=None`` disables the baseline (the
     "show me everything" mode)."""
     root = (root or repo_root()).resolve()
-    selected = list(rules) if rules else list(RULES)
-    unknown = [r for r in selected if r not in RULES]
-    if unknown:
-        raise KeyError(f"unknown rule(s) {unknown}; have {sorted(RULES)}")
+    selected = resolve_rules(rules) if rules else list(RULES)
     if paths is not None:
         missing = [str(p) for p in paths if not Path(p).exists()]
         if missing:
@@ -85,11 +132,11 @@ def run_lint(root: Optional[Path] = None,
     checked: set = set()
     for name in selected:
         mod = RULES[name]
-        if name == tracemap.RULE:
+        if name in _PAIR_RULES:
             # pair-based, registry-driven: restriction matches the sim
             # or host module, directories match their subtrees
-            for protocol, sp, hp in tracemap.analyzed_pairs(root, paths):
-                raw.extend(tracemap.check_pair(protocol, sp, hp, root))
+            for protocol, sp, hp in mod.analyzed_pairs(root, paths):
+                raw.extend(mod.check_pair(protocol, sp, hp, root))
                 checked.update((sp, hp))
             continue
         files = (None if paths is None

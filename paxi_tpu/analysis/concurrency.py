@@ -23,11 +23,28 @@ Checks:
   ``self`` attribute (or an item of one) outside the lock
 - **PXC402** a mutating container call (``self.x.append(...)``,
   ``.pop``, ``.update``, ``.clear``, ...) outside the lock
+
+Stage-2 deepening (PXC45x) — the single-``with`` check above judges
+each statement in place, which leaves two real race shapes invisible:
+
+- **PXC451** a *deferred callable* (nested ``def``/``lambda`` handed to
+  the socket/fabric/event loop, assigned to state, or returned) that
+  writes or mutates ``self`` state without acquiring the lock
+  *itself*.  Registration may well happen inside ``with self._lock:``
+  — the callback still runs later, lock-free, on whatever thread the
+  transport uses; the lock state at the registration site is
+  irrelevant, so these bodies are analyzed as unlocked roots instead
+  of being skipped.
+- **PXC452** a mutating call through a local **alias** of a ``self``
+  attribute (``d = self.items`` ... ``d.append(x)``) outside the lock
+  — same shared structure, laundered through a name the per-statement
+  check cannot see.
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from pathlib import Path
 from typing import List, Optional, Sequence, Set, Tuple
 
@@ -97,21 +114,28 @@ def _acquires_lock(node: ast.With, lock_attrs: Set[str]) -> bool:
 
 class _MethodChecker:
     def __init__(self, relpath: str, cls: str, method: str,
-                 lock_attrs: Set[str]):
+                 lock_attrs: Set[str], deferred: bool = False):
         self.relpath = relpath
         self.cls = cls
         self.method = method
         self.lock_attrs = lock_attrs
+        self.deferred = deferred      # body is a deferred callback
+        self.aliases: dict = {}       # local name -> aliased self attr
         self.out: List[Violation] = []
 
     def _add(self, code: str, node: ast.AST, msg: str) -> None:
+        if self.deferred:
+            code = "PXC451"
+            why = (" — the callback runs later without the lock, "
+                   "whatever the registration site held")
+        else:
+            why = (" — the class declares itself cross-thread shared "
+                   "by owning that lock")
         self.out.append(Violation(
             rule=RULE, code=code, path=self.relpath,
             line=node.lineno, col=node.col_offset,
             message=f"{msg} in `{self.cls}.{self.method}` outside "
-                    f"`with self.{sorted(self.lock_attrs)[0]}` — the "
-                    "class declares itself cross-thread shared by "
-                    "owning that lock"))
+                    f"`with self.{sorted(self.lock_attrs)[0]}`{why}"))
 
     def _check_write_target(self, target: ast.AST, node: ast.AST) -> None:
         if isinstance(target, (ast.Tuple, ast.List)):
@@ -131,6 +155,18 @@ class _MethodChecker:
             for s in stmt.body:
                 self._check_stmt(s, True)
             return
+        if isinstance(stmt, ast.Assign):
+            # alias bookkeeping (lock state irrelevant: the alias may
+            # outlive the with-block it was taken in)
+            for t in stmt.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                src = _self_attr(stmt.value) \
+                    if isinstance(stmt.value, ast.Attribute) else None
+                if src is not None and src not in self.lock_attrs:
+                    self.aliases[t.id] = src
+                else:
+                    self.aliases.pop(t.id, None)
         if not locked:
             if isinstance(stmt, ast.Assign):
                 for t in stmt.targets:
@@ -174,11 +210,76 @@ class _MethodChecker:
                         "PXC402", node,
                         f"unlocked mutating call "
                         f"`self.{attr}.{node.func.attr}(...)`")
+                elif isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id in self.aliases:
+                    src = self.aliases[node.func.value.id]
+                    self._add(
+                        "PXC452", node,
+                        f"unlocked mutating call "
+                        f"`{node.func.value.id}.{node.func.attr}(...)` "
+                        f"through an alias of `self.{src}`")
 
     def run(self, fn: ast.AST) -> List[Violation]:
         for stmt in fn.body:
             self._check_stmt(stmt, False)
         return self.out
+
+    def run_expr(self, expr: ast.expr) -> List[Violation]:
+        """Lambda bodies (deferred callbacks) — expressions only."""
+        self._check_expr(expr)
+        return self.out
+
+
+# call texts that defer their callable argument to another thread/tick
+_DEFER_RE = re.compile(
+    r"(call_soon|call_later|call_at|create_task|ensure_future|submit|"
+    r"run_in_executor|add_done_callback|on_[a-z_]+|register|"
+    r"\bsocket\.|\bfabric\.|\bloop\.|Timer|Thread)")
+
+
+def _escaping_callables(method: ast.AST) -> List[ast.AST]:
+    """Nested defs and lambdas of ``method`` that outlive it.  A nested
+    def escapes when referenced outside the function position of a call
+    (assigned, returned, stored, passed along); a lambda escapes when
+    its enclosing call looks like a deferral sink (``loop.call_soon``,
+    ``socket.on_*``, executor submission...) — lambdas fed to
+    synchronous combinators (``sorted(key=...)``) run under the call
+    site's own lock state and stay the per-statement check's business."""
+    nested = {n.name: n for n in ast.walk(method)
+              if isinstance(n, astutil.FuncNode) and n is not method}
+    out: List[ast.AST] = []
+    for node in ast.walk(method):
+        if isinstance(node, ast.Call) and _DEFER_RE.search(
+                ast.unparse(node.func)):
+            for arg in [*node.args,
+                        *(kw.value for kw in node.keywords)]:
+                if isinstance(arg, ast.Lambda):
+                    out.append(arg)
+        # a lambda stored or returned outlives the method just like a
+        # named nested def (`self.on_x = lambda: ...`, `return lambda`)
+        # — descending through container literals but NOT through calls
+        # (a lambda fed to sorted(key=...) runs synchronously and is
+        # only deferred when the call matches _DEFER_RE above)
+        if isinstance(node, (ast.Assign, ast.Return)) and \
+                node.value is not None:
+            work = [node.value]
+            while work:
+                v = work.pop()
+                if isinstance(v, ast.Lambda):
+                    out.append(v)
+                elif isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+                    work.extend(v.elts)
+                elif isinstance(v, ast.Dict):
+                    work.extend(x for x in v.values if x is not None)
+    call_funcs = {id(n.func) for n in ast.walk(method)
+                  if isinstance(n, ast.Call)}
+    for name, fn in nested.items():
+        refs = [n for n in ast.walk(method)
+                if isinstance(n, ast.Name) and n.id == name
+                and isinstance(n.ctx, ast.Load)]
+        if any(id(r) not in call_funcs for r in refs):
+            out.append(fn)
+    return out
 
 
 def check_file(path: Path, root: Path) -> List[Violation]:
@@ -198,6 +299,16 @@ def check_file(path: Path, root: Path) -> List[Violation]:
                 continue
             out.extend(_MethodChecker(relpath, node.name, item.name,
                                       lock_attrs).run(item))
+            # stage-2 deepening: escaped callbacks run lock-free later
+            for cb in _escaping_callables(item):
+                name = getattr(cb, "name", "<lambda>")
+                checker = _MethodChecker(
+                    relpath, node.name, f"{item.name}.{name}",
+                    lock_attrs, deferred=True)
+                if isinstance(cb, ast.Lambda):
+                    out.extend(checker.run_expr(cb.body))
+                else:
+                    out.extend(checker.run(cb))
     return out
 
 

@@ -187,13 +187,19 @@ def cmd_trace(args) -> int:
                 if t.meta.get("shrunk") else
                 t.meta.get("capture_state_hash"))
         # counter determinism rides along with the state hash: a replay
-        # must reproduce the recorded whole-batch message/fault counters
+        # must reproduce the recorded whole-batch message/fault
+        # counters.  Compared over the RECORDED keys, so traces
+        # captured before a counter existed (e.g. delay_collisions)
+        # still replay clean — new counters ride along unchecked.
         want_counts = t.meta.get("replay_counters"
                                  if t.meta.get("shrunk") else
                                  "capture_counters")
+        counts_ok = (want_counts is None
+                     or all(r.counters.get(k) == v
+                            for k, v in want_counts.items()))
         ok = (r.violations == t.meta.get("group_violations", -1)
               and (want is None or r.state_hash == want)
-              and (want_counts is None or r.counters == want_counts))
+              and counts_ok)
         print(json.dumps({
             "violations": r.violations,
             "first_violation_step": r.first_violation_step(),
@@ -381,6 +387,13 @@ def cmd_lint(args) -> int:
         print(report.to_json())
     else:
         print(report.render(verbose=args.verbose))
+    if args.strict_unused and report.unused_baseline:
+        # the baseline-shrink policy (scripts/verify.sh --lint): stale
+        # suppressions are an error there, a warning in the bare CLI
+        print("lint: stale baseline entries (see warnings above) — "
+              "baselines may only shrink; delete them",
+              file=sys.stderr)
+        return 1
     return 0 if report.ok else 1
 
 
@@ -498,15 +511,15 @@ def main(argv=None) -> int:
         hp.add_argument("-quiet", "--quiet", action="store_true")
     h.set_defaults(fn=cmd_hunt)
 
-    from paxi_tpu.analysis import RULES as _LINT_RULES  # stdlib-only
     li = sub.add_parser(
         "lint", help="protocol-aware static analysis (paxi-lint)")
     li.add_argument("paths", nargs="*", default=[],
                     help="restrict to these files/directories "
                          "(default: whole repo)")
     li.add_argument("-rule", "--rule", action="append", default=[],
-                    choices=sorted(_LINT_RULES),
-                    help="run only this rule family (repeatable)")
+                    help="run only these rule families: names "
+                         "(`quorum-safety`) or code prefixes "
+                         "(`PXQ,PXB`); repeatable")
     li.add_argument("-json", "--json", action="store_true",
                     help="machine-readable report")
     li.add_argument("-verbose", "--verbose", action="store_true",
@@ -516,6 +529,11 @@ def main(argv=None) -> int:
     li.add_argument("-no_baseline", "--no-baseline", dest="no_baseline",
                     action="store_true",
                     help="ignore the baseline (show every finding)")
+    li.add_argument("-strict_unused", "--strict-unused",
+                    dest="strict_unused", action="store_true",
+                    help="exit 1 on stale (unused) baseline entries — "
+                         "the verify.sh --lint gate's baseline-shrink "
+                         "policy")
     li.set_defaults(fn=cmd_lint)
 
     me = sub.add_parser("metrics",

@@ -27,7 +27,7 @@ from __future__ import annotations
 import asyncio
 import json
 import time
-from typing import TYPE_CHECKING, Dict, Tuple
+from typing import TYPE_CHECKING, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from paxi_tpu.core.command import TXN_MAGIC, Command, Request
@@ -39,14 +39,14 @@ from paxi_tpu.host.transport import parse_addr
 
 
 def _response(status: int, body: bytes = b"",
-              headers: Dict[str, str] = {}) -> bytes:
+              headers: Optional[Dict[str, str]] = None) -> bytes:
     reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
               405: "Method Not Allowed",
               500: "Internal Server Error"}.get(status, "OK")
     head = [f"HTTP/1.1 {status} {reason}",
             f"Content-Length: {len(body)}",
             "Connection: keep-alive"]
-    head += [f"{k}: {v}" for k, v in headers.items()]
+    head += [f"{k}: {v}" for k, v in (headers or {}).items()]
     return ("\r\n".join(head) + "\r\n\r\n").encode() + body
 
 

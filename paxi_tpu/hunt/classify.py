@@ -15,8 +15,11 @@ The hunt's verdict taxonomy (every witness lands in exactly one):
 - ``unmappable``  — the witness hinges on events the host surface
   cannot express exactly: fault events on mailboxes outside the
   protocol's ``TRACE_MSG_MAP`` (the baselined kernel-internal
-  mailboxes — wankeeper ``p2b``, epaxos ``gc``) or message
-  duplications (TCP/chan never duplicate).
+  mailboxes — wankeeper ``p2b``, epaxos ``gc``), message duplications
+  (TCP/chan never duplicate), or a *lone-delay* schedule whose sim
+  violation rides the one-slot wheel's collision-as-loss semantics
+  (``net_delay_collisions``) — the host fabric delivers both colliding
+  messages, so the loss itself has no host expression.
 
 ``classify`` is a pure function of (sim outcome, projection coverage,
 host outcome) so the taxonomy is unit-testable without booting
@@ -81,13 +84,29 @@ def coverage_of(trace: Trace, ids=None,
     if ids is None:
         ids = local_config(cfg.n_replicas, zones=cfg.n_zones).ids
     sched, stats = seq_schedule(trace, ids, msg_map=msg_map)
+    # delay-collision count of the sim replay that stamped the trace
+    # (shrink stamps replay_counters; capture stamps capture_counters).
+    # Counters are WHOLE-BATCH: the traced group plus its scaffolding
+    # groups, so a zero PROVES the traced group was collision-free,
+    # while a nonzero only means collision-possible — classify()'s
+    # lone-delay arm is deliberately conservative in that direction
+    # (it may call a collision-free witness unmappable when scaffolding
+    # collided, but never calls a collision-tainted one diverged).
+    # None = recorded before the counter existed (also
+    # collision-possible).
+    counters = trace.meta.get("replay_counters"
+                              if trace.meta.get("shrunk")
+                              else "capture_counters") or {}
     return {
         "mapped_events": stats["drops"] + stats["delays"],
         "unmapped_events": stats["unmapped"],
         "unmapped_mailboxes": sorted(sched.unmapped),
         "dups": sched.dups_skipped,
+        "drops": stats["drops"],
+        "delays": stats["delays"],
         "crashes": stats["crashes"],
         "cuts": stats["cuts"],
+        "delay_collisions": counters.get("delay_collisions"),
         "exact": sched.exact,
     }
 
@@ -118,6 +137,33 @@ def classify(sim_violations: int, coverage: dict,
                    f"(anomalies={host.anomalies}, "
                    f"oracle={host.oracle_violations}) — host bug "
                    "candidate",
+            sim=sim, coverage=coverage, host=host.to_json())
+    # lone-delay witnesses: the sim's one-slot wheel models a colliding
+    # delayed message as a LOSS (mailbox.py collision semantics, counted
+    # as net_delay_collisions), which the host's FIFO/virtual-clock
+    # fabric cannot express — the delivery schedule projects, the loss
+    # does not.  Unless the (whole-batch, see coverage_of) counter
+    # proves zero collisions happened, a clean host replay of a
+    # delays-only schedule is diverged-by-construction and classifies
+    # as unmappable; the conservative direction suppresses at worst a
+    # diverged verdict, never fabricates one.
+    lone_delay = (coverage.get("delays", 0) > 0
+                  and not (coverage.get("drops", 0)
+                           or coverage.get("dups", 0)
+                           or coverage.get("crashes", 0)
+                           or coverage.get("cuts", 0)))
+    if lone_delay and coverage.get("delay_collisions") != 0:
+        known = coverage.get("delay_collisions")
+        detail = (f"{known} collision(s) counted in the replay batch"
+                  if known is not None
+                  else "collision count unrecorded (pre-counter trace)")
+        return Classification(
+            outcome="unmappable",
+            reason="lone-delay witness: the one-slot delay wheel "
+                   f"models colliding delayed messages as losses "
+                   f"({detail}) — a loss the host fabric cannot "
+                   "express, so a clean host replay is "
+                   "diverged-by-construction",
             sim=sim, coverage=coverage, host=host.to_json())
     return Classification(
         outcome="diverged",

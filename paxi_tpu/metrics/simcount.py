@@ -32,11 +32,11 @@ NET_PREFIX = "net_"
 # the fixed counter vocabulary (stripped names, as surfaced on
 # SimResult.counters / trace meta / FUZZ_SOAK.json records)
 COUNTER_NAMES = ("msgs_sent", "msgs_delivered", "msgs_dropped",
-                 "msgs_duplicated", "msgs_delayed", "crash_steps",
-                 "cut_edge_steps")
+                 "msgs_duplicated", "msgs_delayed", "delay_collisions",
+                 "crash_steps", "cut_edge_steps")
 
 
-def step_counts(inbox, outbox, faults, fs, n: int
+def step_counts(inbox, outbox, faults, fs, n: int, wheel=None
                 ) -> Dict[str, jax.Array]:
     """One lock-step round's counter increments, summed over the whole
     batch (per-group under vmap — the caller sums the group axis).
@@ -46,6 +46,13 @@ def step_counts(inbox, outbox, faults, fs, n: int
     - ``msgs_dropped/duplicated/delayed``: EFFECTIVE fault events —
       masked by ``valid & live`` exactly like the trace recorder's
       neutralization, so schedule noise on empty edges never counts.
+    - ``delay_collisions``: messages this step's ``wheel_insert`` will
+      land on an already-occupied wheel cell, overwriting the earlier
+      in-flight message on that (type, src, dst) edge — the sim's
+      modeled-as-loss collision semantics (mailbox.py module docstring;
+      the hunt engine's first real finding).  ``wheel`` is the
+      post-delivery, pre-insert wheel; ``None`` (no wheel in scope)
+      reports 0, keeping the counter total stable for fault-free runs.
     - ``crash_steps`` / ``cut_edge_steps``: fault-mask occupancy
       (replica-steps crashed, directed-edge-steps severed).
     """
@@ -64,6 +71,7 @@ def step_counts(inbox, outbox, faults, fs, n: int
     dropped = jnp.int32(0)
     duplicated = jnp.int32(0)
     delayed = jnp.int32(0)
+    collisions = jnp.int32(0)
     for name in sorted(outbox.keys()):
         valid = outbox[name]["valid"] & live
         f = faults[name]
@@ -71,12 +79,26 @@ def step_counts(inbox, outbox, faults, fs, n: int
         kept = valid & ~f["drop"]
         duplicated = duplicated + tot(f["dup"] & kept)
         delayed = delayed + tot((f["delay"] > 1) & kept)
+        if wheel is not None and wheel[name]["valid"].shape[0] > 1:
+            # mirror wheel_insert's slot targeting exactly: a put onto
+            # a cell whose valid bit is already set is an overwrite.
+            # A one-slot wheel (max_delay=1) is rotated empty before
+            # every insert, so collisions are structurally impossible
+            # there — skipped statically to keep fault-free runs free.
+            d = wheel[name]["valid"].shape[0]
+            dup_delay = jnp.minimum(f["delay"] + 1, d)
+            for slot in range(d):
+                put = kept & ((f["delay"] == slot + 1)
+                              | (f["dup"] & (dup_delay == slot + 1)))
+                collisions = collisions + tot(
+                    put & wheel[name]["valid"][slot])
     return {
         NET_PREFIX + "msgs_sent": sent,
         NET_PREFIX + "msgs_delivered": delivered,
         NET_PREFIX + "msgs_dropped": dropped,
         NET_PREFIX + "msgs_duplicated": duplicated,
         NET_PREFIX + "msgs_delayed": delayed,
+        NET_PREFIX + "delay_collisions": collisions,
         NET_PREFIX + "crash_steps": tot(fs["crashed"]),
         NET_PREFIX + "cut_edge_steps": tot(~fs["conn"]),
     }

@@ -198,3 +198,24 @@ TRACE_MSG_MAP = {
     "query": "Query", "query_r": "QueryReply",
     "store": "Store", "store_r": "StoreReply",
 }
+
+# sim state field -> host attribute, for the static parity check
+# (analysis/parity.py PXS7xx).  Empty string = kernel-internal.
+SIM_STATE_MAP = {
+    "store_ts":    "store",      # (ts, writer) tag half of the register
+    "store_val":   "store",
+    "op_read":     "is_read",    # in-flight op planes <-> _Op fields
+    "op_key":      "request",
+    "op_tag":      "tag",
+    "op_ts":       "ts",
+    "op_val":      "max_value",
+    "acks":        "quorum",     # bit-packed ack mask <-> Quorum
+    "best_ts":     "max_ts",
+    "best_val":    "max_value",
+    "op_snap":     "",  # linearizability-oracle snapshot at op start
+    "op_age":      "",  # step-count phase timeout; host op GC is wall-clock
+    "reads_done":  "",  # workload counters (metrics, not protocol state)
+    "writes_done": "",
+    "done_max_ts": "",  # atomicity-oracle bookkeeping
+    "atomic_viol": "",  # invariant accumulator
+}

@@ -209,3 +209,12 @@ def new_replica(id: ID, cfg: Config) -> BlockchainReplica:
 TRACE_MSG_MAP = {
     "head": "BlockMsg",
 }
+
+# sim state field -> host attribute, for the static parity check
+# (analysis/parity.py PXS7xx).  Empty string = kernel-internal.
+SIM_STATE_MAP = {
+    "ring":       "blocks",  # height-ring of block ids <-> block store
+    "miner_ring": "blocks",  # miner-per-height plane <-> Block.miner
+    "mined":      "",  # per-replica mined counter (metrics)
+    "reorgs":     "",  # rewind counter (metrics)
+}

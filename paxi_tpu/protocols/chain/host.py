@@ -128,3 +128,17 @@ def new_replica(id: ID, cfg: Config) -> ChainReplica:
 TRACE_MSG_MAP = {
     "prop": "Propagate", "rep": "Propagate", "ack": "Ack",
 }
+
+# sim state field -> host attribute, for the static parity check
+# (analysis/parity.py PXS7xx).  Empty string = kernel-internal.
+SIM_STATE_MAP = {
+    "log_key":    "chain",   # slot-ring planes <-> the chain list
+    "log_val":    "chain",
+    "applied":    "pos",     # in-order applied prefix <-> chain position
+    "committed":  "head",    # known tail-applied <-> head bookkeeping
+    "known_succ": "succ",    # successor progress <-> successor link
+    "seen_succ":  "succ",
+    "kv":         "db",
+    "stall":      "",  # retransmit ticks: host retries are wall-clock
+    "reads_done": "",  # workload counter (metrics, not protocol state)
+}

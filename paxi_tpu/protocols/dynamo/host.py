@@ -253,3 +253,11 @@ def new_replica(id: ID, cfg: Config) -> DynamoReplica:
 TRACE_MSG_MAP = {
     "gossip": "RWrite",
 }
+
+# sim state field -> host attribute, for the static parity check
+# (analysis/parity.py PXS7xx).  Empty string = kernel-internal.
+SIM_STATE_MAP = {
+    "ver_c": "store",   # (counter, node) version halves of the store tag
+    "ver_n": "store",
+    "writes": "",  # workload counter (metrics, not protocol state)
+}

@@ -768,3 +768,53 @@ TRACE_MSG_MAP = {
     "prep": "Prepare", "prepr": "PrepareReply",
     "racc": "Accept", "raccr": "AcceptReply", "rcmt": "Commit",
 }
+
+# sim state field -> host attribute, for the static parity check
+# (analysis/parity.py PXS7xx).  Empty string = kernel-internal.
+SIM_STATE_MAP = {
+    # instance ring SoA <-> Instance aggregates in self.insts
+    "cmd":       "insts",
+    "seq":       "insts",
+    "deps":      "insts",
+    "status":    "insts",
+    "executed":  "status",           # EXECUTED is a status on the host
+    "bal":       "ballot",           # promised ballot per cell
+    "abal":      "accepted_ballot",
+    "age":       "born",             # frontier-block steps <-> wall-clock age
+    # command-leader driving state
+    "cur":       "next_inst",
+    "pa_acks":   "acked",            # PreAccept ack bitmask <-> set
+    "ac_acks":   "accept_acked",
+    "agree":     "changed",          # fast-path attr agreement (inverse)
+    "seq0":      "seq",              # original vs merged attrs: the host
+    "deps0":     "deps",             # folds both into the Instance
+    "mseq":      "seq",
+    "mdeps":     "deps",
+    # recovery driving state <-> Recovery entries
+    "rphase":    "recoveries",
+    "rowner":    "owner",
+    "rinst":     "inst",
+    "rballot":   "ballot",
+    "racks":     "replies",          # prepare-round replies
+    "rstat":     "replies",          # per-replier recorded state
+    "rcmd":      "replies",
+    "rseq2":     "replies",
+    "rabal":     "replies",
+    "rdeps2":    "replies",
+    "rcseq":     "replies",
+    "rcdeps":    "replies",
+    "rdcmd":     "recoveries",       # decided attrs driven via Accept
+    "rdseq":     "recoveries",
+    "rddeps":    "recoveries",
+    "aacks":     "accept_acked",
+    "base":      "",  # instance ring window: host insts dicts are unbounded
+    "stuck":     "",  # leader retry ticks: host fallback timer is wall-clock
+    "rstuck":    "",  # recovery retry ticks (kernel-only)
+    "recovered": "",  # completed-recovery counter (metrics)
+    "gfront":    "",  # GC gossip frontier: the host log never recycles
+                      # (see the PXT302 `gc` baseline entry)
+    "ccount":    "",  # commit counter (metrics)
+    "xcount":    "",  # execution counter (metrics)
+    "kcount":    "",  # per-key execution oracle (invariant bookkeeping)
+    "khash":     "",
+}

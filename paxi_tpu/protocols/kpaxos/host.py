@@ -168,3 +168,15 @@ def new_replica(id: ID, cfg: Config) -> KPaxosReplica:
 TRACE_MSG_MAP = {
     "p2a": "KP2a", "p2b": "KP2b", "p3": "KP3",
 }
+
+# sim state field -> host attribute, for the static parity check
+# (analysis/parity.py PXS7xx).  Empty string = kernel-internal.
+SIM_STATE_MAP = {
+    "log_cmd":    "log",     # per-partition ring <-> _Part.log entries
+    "log_commit": "log",
+    "acks":       "quorum",  # leader ack bitmask <-> Entry.quorum
+    "next_slot":  "slot",
+    "kv":         "db",
+    "base":       "",  # ring-window base: host logs are unbounded dicts
+    "stuck":      "",  # frontier-stall retry counter (kernel-only)
+}

@@ -387,3 +387,21 @@ def new_replica(id: ID, cfg: Config) -> PaxosReplica:
 TRACE_MSG_MAP = {
     "p1a": "P1a", "p1b": "P1b", "p2a": "P2a", "p2b": "P2b", "p3": "P3",
 }
+
+# sim state field -> host attribute, for the static parity check
+# (analysis/parity.py PXS7xx).  Empty string = kernel-internal, no host
+# analog.  Serves both `paxos` (sim.py) and `paxos_pg` (sim_pg.py) —
+# the two kernels share one state vocabulary.
+SIM_STATE_MAP = {
+    "p1_acks":    "p1_quorum",  # phase-1 ack bitmask <-> Quorum
+    "log_bal":    "log",        # accepted-ballot plane <-> Entry.ballot
+    "log_cmd":    "log",        # command plane <-> Entry.command
+    "log_commit": "log",        # commit plane <-> Entry.commit
+    "log_acks":   "log",        # per-slot P2b bitmask <-> Entry.quorum
+    "next_slot":  "slot",
+    "kv":         "db",         # executed state <-> Database
+    "base":       "",   # ring-window base: the host log is an unbounded dict
+    "proposed":   "",   # own-ballot P2a mask: implied by Entry existence
+    "timer":      "",   # election step-timer: host elections are wall-clock
+    "stuck":      "",   # go-back-N retry counter (kernel-only)
+}

@@ -566,3 +566,27 @@ TRACE_MSG_MAP = {
     "oreq": "OReq", "p1a": "Seq1a", "p1b": "Seq1b",
     "p2a": "OAccept", "p2b": "OAck", "p3": "OCommit",
 }
+
+# sim state field -> host attribute, for the static parity check
+# (analysis/parity.py PXS7xx).  Empty string = kernel-internal.
+SIM_STATE_MAP = {
+    # C-plane (decentralized command replication)
+    "c_next":     "cnext",       # my proposed command count
+    "c_stored":   "cstore",      # per-owner stored commands
+    "c_ack":      "cquorum",     # store acks <-> per-command Quorum
+    "o_seen":     "cchosen",     # chosen (majority-stored) frontier
+    "o_enq":      "queued",      # owner tokens handed to the sequencer
+    "exec_c":     "executed",    # per-owner executed frontier
+    # O-log (centralized ordering; shared ballot-ring planes)
+    "p1_acks":    "seq_quorum",  # sequencer-election ack bitmask
+    "log_bal":    "olog",        # O-log ring planes <-> OEntry fields
+    "log_cmd":    "olog",
+    "log_commit": "olog",
+    "log_acks":   "olog",        # OAck bitmask <-> OEntry.quorum
+    "next_slot":  "oslot",
+    "kv":         "db",
+    "base":       "",  # ring-window base: gc_base prunes the host dict
+    "proposed":   "",  # own-ballot OAccept mask: implied by OEntry
+    "timer":      "",  # election step-timer: host elections are wall-clock
+    "stuck":      "",  # frontier-stall retry counter (kernel-only)
+}

@@ -518,3 +518,32 @@ TRACE_MSG_MAP = {
     "zrep": "ZWrite", "zack": "ZAck", "treq": "TReq", "rel": "Rel",
     "p1a": "Root1a", "p1b": "Root1b", "p2a": "Grant", "p3": "Grant",
 }
+
+# sim state field -> host attribute, for the static parity check
+# (analysis/parity.py PXS7xx).  Empty string = kernel-internal.
+SIM_STATE_MAP = {
+    # token table + zone replication
+    "token_zone": "tokens",      # holder zone per object
+    "prev_zone":  "transit",     # releasing zone during a handoff
+    "aver":       "flushq",      # member acked versions <-> flush Quorum
+    "relv":       "revoking",    # reported release version (gen-gated)
+    "pend":       "transit",     # revoke-in-flight mark at the root
+    "pgen":       "gen_seen",    # executed-revoke generation fence
+    "rgen":       "gen_seen",    # my zone's release generation
+    "gver":       "granted",     # durable grant floor (host form)
+    # root log (shared ballot-ring planes; cf. paxos/host.py)
+    "p1_acks":    "root_quorum",
+    "log_bal":    "granted_log", # root-log planes: the host root drives
+    "log_cmd":    "granted_log", # grants off a leader lease + dedup log
+    "log_commit": "granted_log", # (see the PXT302 p2b baseline entry)
+    "log_acks":   "granted_log",
+    "next_slot":  "gen",         # root command counter <-> generation
+    "execute":    "_done",       # executed-prefix <-> progress counter
+    "base":       "",  # ring-window base (kernel-only)
+    "proposed":   "",  # own-ballot P2a mask (kernel-only)
+    "timer":      "",  # election step-timer: host root uses wall-clock
+    "stuck":      "",  # frontier-stall retry counter (kernel-only)
+    "viol_acc":   "",  # invariant accumulator (oracle)
+    "writes":     "",  # leader write counter (metrics)
+    "transfers":  "",  # token-transfer counter (metrics)
+}

@@ -413,3 +413,22 @@ TRACE_MSG_MAP = {
     "p1a": "WP1a", "p1b": "WP1b", "p2a": "WP2a", "p2b": "WP2b",
     "p3": "WP3",
 }
+
+# sim state field -> host attribute, for the static parity check
+# (analysis/parity.py PXS7xx).  Empty string = kernel-internal.  The
+# sim's per-object planes correspond to per-key ``KeyObject``
+# aggregates on the host.
+SIM_STATE_MAP = {
+    "log_bal":     "log",        # per-object ring planes <-> KeyObject.log
+    "log_cmd":     "log",
+    "log_commit":  "log",
+    "log_acks":    "log",        # P2b bitmask <-> Entry.quorum
+    "next_slot":   "slot",
+    "kv":          "db",
+    "p1_acks":     "p1_quorum",  # in-flight steal ack bitmask
+    "hits":        "policies",   # demand counters <-> Policy state
+    "steal_obj":   "steals",     # in-flight steal target; completed count
+    "base":        "",  # ring-window base: host logs are unbounded dicts
+    "proposed":    "",  # own-ballot P2a mask: implied by Entry existence
+    "steal_timer": "",  # steal retry step-timer: host retries are wall-clock
+}

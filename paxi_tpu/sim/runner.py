@@ -122,12 +122,15 @@ def _group_step(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
                 name: {k: mix(v, sched_t["faults"][name][k])
                        for k, v in f.items()}
                 for name, f in faults.items()}
-    wheel = ops.wheel_insert(wheel, outbox, fs, fuzz, faults)
     # on-device metrics carry: pure reductions over the same planes
     # delivery consumed — AFTER the sched_t substitution, so a pinned
     # replay counts the recorded schedule and reproduces the captured
-    # counters exactly (see metrics/simcount.py)
-    counts = step_counts(inbox, outbox, faults, fs, cfg.n_replicas)
+    # counters exactly (see metrics/simcount.py).  Computed BEFORE the
+    # insert so the pre-insert wheel exposes delay collisions (a put
+    # overwriting an in-flight message on the same edge cell).
+    counts = step_counts(inbox, outbox, faults, fs, cfg.n_replicas,
+                         wheel=wheel)
+    wheel = ops.wheel_insert(wheel, outbox, fs, fuzz, faults)
     if record and proto.batched:
         viol = per_group_invariants(proto, cfg, state, new_state)
     else:

@@ -36,9 +36,11 @@ while [ "${1:-}" = "--lint" ] || [ "${1:-}" = "--metrics" ] \
   elif [ "$1" = "--lint" ]; then
     shift
     echo "== static analysis (paxi-lint) =="
-    # pure AST — no jax import, sub-second; exits 1 on any violation
-    # not covered by analysis/baseline.toml
-    timeout -k 10 120 python -m paxi_tpu lint || exit $?
+    # pure AST — no jax import, seconds; exits 1 on any violation not
+    # covered by analysis/baseline.toml.  --strict-unused is the
+    # baseline-shrink policy: a stale suppression fails the gate here
+    # (the bare CLI only warns), so baselines can only shrink
+    timeout -k 10 120 python -m paxi_tpu lint --strict-unused || exit $?
     echo "== compileall (syntax tier) =="
     timeout -k 10 120 python -m compileall -q paxi_tpu tests scripts \
       || exit $?

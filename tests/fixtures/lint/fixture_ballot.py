@@ -1,0 +1,52 @@
+# Seeded ballot-guard (PXB6xx) violations for tests/test_lint.py.
+# Parsed only, never imported.  One handler per failure mode plus the
+# guarded control paths the rule must NOT flag (including the
+# interprocedural guarded-call-site case).
+
+from dataclasses import dataclass
+
+from paxi_tpu.host.codec import register_message
+
+
+@register_message
+@dataclass
+class Vote:
+    ballot: int
+    slot: int
+
+
+@register_message
+@dataclass
+class Heartbeat:
+    alive: bool          # no ballot-like field: handler exempt
+
+
+class SeededReplica:
+    def __init__(self):
+        self.ballot = 0
+        self.log = {}
+        self.beats = 0
+        self.register(Vote, self.handle_unguarded)
+        self.register(Vote, self.handle_eq_only)
+        self.register(Vote, self.handle_guarded)
+        self.register(Heartbeat, self.handle_beat)
+
+    def handle_unguarded(self, m):
+        self.ballot = m.ballot           # PXB601: no comparison at all
+        self.log[m.slot] = m.ballot      # PXB603: accept sans promise
+
+    def handle_eq_only(self, m):
+        if m.ballot != self.ballot:
+            self.ballot = m.ballot       # PXB602: != can go backwards
+
+    def handle_guarded(self, m):
+        if m.ballot < self.ballot:
+            return                       # the early-return idiom
+        self.ballot = m.ballot           # fine: >= established
+        self._store(m)                   # fine: guarded call site
+
+    def _store(self, m):
+        self.log[m.slot] = m.ballot      # fine through handle_guarded
+
+    def handle_beat(self, m):
+        self.beats += 1                  # exempt: no epoch field

@@ -31,8 +31,33 @@ class SharedThing:
 
     def deferred(self):
         def cb(n):
-            self.count = n        # nested def: judged at call site, ok
+            self.count = n        # PXC451: returned callback runs later,
+        return cb                 # lock-free (stage-2 deepening)
+
+    def register(self, loop):
+        with self._lock:
+            loop.call_soon(lambda: self.items.clear())  # PXC451: the
+            # registration holds the lock; the callback won't
+
+    def alias_race(self):
+        d = self.items            # alias taken...
+        with self._lock:
+            self.count += 1
+        d.append(9)               # PXC452: ...mutated outside the lock
+
+    def deferred_lambda(self):
+        return lambda: self.items.pop()   # PXC451: returned lambda
+                                          # outlives the method too
+
+    def locked_callback_is_fine(self):
+        def cb(n):
+            with self._lock:
+                self.items.append(n)   # callback takes the lock itself
         return cb
+
+    def sync_lambda_is_fine(self):
+        with self._lock:
+            return sorted(self.items, key=lambda v: -v)
 
     def reads_are_fine(self):
         return self.count + len(self.items)

@@ -1,0 +1,17 @@
+# Host half of the seeded sim/host parity (PXS7xx) pair — parsed only.
+# Seeds one violation of each mapped-but-stale kind alongside the
+# legitimate entries (see fixture_parity_sim.py).
+
+
+class FixtureReplica:
+    def __init__(self, cfg):
+        self.ballot = 0
+        self.log = {}
+
+
+SIM_STATE_MAP = {
+    "log_bal": "log",          # fine
+    "timer": "",               # fine: declared kernel-internal
+    "vanished": "log",         # PXS703: names no sim field
+    "log_bal2": "no_such",     # PXS703 + PXS704: stale both ways
+}

@@ -1,0 +1,7 @@
+# Map-less host half for the PXS701 case: unmatched sim fields and no
+# SIM_STATE_MAP declared at all.
+
+
+class BareReplica:
+    def __init__(self, cfg):
+        self.ballot = 0

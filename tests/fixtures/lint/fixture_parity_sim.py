@@ -1,0 +1,14 @@
+# Sim half of the seeded sim/host parity (PXS7xx) pair — parsed only.
+
+
+def mailbox_spec(cfg):
+    return {"ping": ("v",)}
+
+
+def init_state(cfg, rng, n_groups):
+    return dict(
+        ballot=None,       # matches host attr by name
+        log_bal=None,      # mapped to `log` in the good host fixture
+        ghost_field=None,  # unmapped anywhere: PXS702 drift seed
+        timer=None,        # mapped to "" (kernel-internal)
+    )

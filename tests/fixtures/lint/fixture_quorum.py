@@ -1,0 +1,38 @@
+# Seeded quorum-safety (PXQ5xx) violations for tests/test_lint.py.
+# Parsed only, never imported.  A "dynamo-style" replica whose R/W
+# knobs are set sub-majority: R + W <= N for every odd N >= 3, so the
+# read quorum can miss the latest write entirely — the intersection
+# failure PXQ501 exists to catch.  The mystery-threshold site seeds
+# PXQ502 (silence must be earned, not defaulted).
+
+from paxi_tpu.core.quorum import Quorum
+
+
+class LeakyReplica:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.W = cfg.n // 3          # sub-majority write quorum
+        self.R = cfg.n // 3          # sub-majority read quorum
+        self.mystery = external()    # unresolvable threshold
+
+    def handle_write_ack(self, m):
+        op = self.ops[m.tag]
+        op.quorum.ack(m.src)
+        self._write_done(op)
+
+    def _write_done(self, op):
+        if op.quorum.size() >= self.W:
+            op.request.reply(None)
+
+    def _read_done(self, op):
+        if op.quorum.size() < self.R:
+            return
+        op.request.reply(op.best)
+
+    def _strange_done(self, op):
+        if op.quorum.size() >= self.mystery:
+            op.request.reply(None)
+
+    def _new_op(self):
+        q = Quorum(self.cfg.ids)
+        return q

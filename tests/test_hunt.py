@@ -80,6 +80,38 @@ def test_classifier_refuses_mappable_without_host_outcome():
         classify(1, cov, None)
 
 
+def test_classifier_lone_delay_collision_arm():
+    """The delay-wheel collision semantics, classified explicitly (the
+    ROADMAP item): a delays-only schedule that replays clean on the
+    host is diverged-by-construction UNLESS the sim replay proved zero
+    collisions — the counter is the discriminator."""
+    t = fixture_trace([("delay", 1, 0, 2)])
+    # no recorded counters (old trace): collision-possible -> unmappable
+    cov = coverage_of(t)
+    assert cov["delays"] == 1 and cov["drops"] == 0
+    assert cov["delay_collisions"] is None
+    c = classify(1, cov, HostOutcome(ops_ok=5))
+    assert c.outcome == "unmappable" and "lone-delay" in c.reason
+    # counted collisions: still unmappable, with the count in the reason
+    t2 = fixture_trace([("delay", 1, 0, 2)])
+    t2.meta["capture_counters"] = {"delay_collisions": 2}
+    c = classify(1, coverage_of(t2), HostOutcome(ops_ok=5))
+    assert c.outcome == "unmappable" and "2 collision(s)" in c.reason
+    # PROVEN collision-free delays: a clean host replay is a genuine
+    # divergence signal again
+    t3 = fixture_trace([("delay", 1, 0, 2)])
+    t3.meta["capture_counters"] = {"delay_collisions": 0}
+    c = classify(1, coverage_of(t3), HostOutcome(ops_ok=5))
+    assert c.outcome == "diverged"
+    # a delay mixed with a drop is not a lone-delay witness
+    t4 = fixture_trace([("delay", 1, 0, 2), ("drop", 2, 0, 1)])
+    c = classify(1, coverage_of(t4), HostOutcome(ops_ok=5))
+    assert c.outcome == "diverged"
+    # ...and a host violation still wins over the collision arm
+    c = classify(1, coverage_of(t), HostOutcome(oracle_violations=1))
+    assert c.outcome == "reproduced"
+
+
 # ---- end-to-end fixtures through the virtual-clock fabric ---------------
 def test_hand_built_drop_reproduces_on_host():
     """The acceptance round-trip in miniature: a known sim violation
@@ -155,11 +187,20 @@ def test_micro_campaign_is_clean_and_resumable(tmp_path):
     assert tot["runs"] == 1 and tot["witnesses"] >= 1
     assert tot["unclassified"] == 0
     # fragile witnesses land in reproduced (drop witnesses: the host
-    # twin breaks identically) or diverged (delay witnesses: the sim's
-    # one-slot delay wheel models a collision LOSS the host's FIFO
-    # fabric doesn't have — a real modeling gap this engine surfaced on
-    # its first campaign); never unmappable, never unclassified
-    assert tot["reproduced"] + tot["diverged"] == tot["witnesses"]
+    # twin breaks identically), unmappable (lone delay witnesses: the
+    # sim's one-slot delay wheel models a collision LOSS the host's
+    # FIFO fabric doesn't have — counted as net_delay_collisions and
+    # classified explicitly since the collision-semantics PR), or
+    # diverged (proven-collision-free delays / phantom occurrences);
+    # never unclassified
+    assert (tot["reproduced"] + tot["diverged"] + tot["unmappable"]
+            == tot["witnesses"])
+    # every unmappable verdict must be the collision arm, not a
+    # projection-coverage regression
+    for w in rep["witnesses"].values():
+        c = w.get("classification", {})
+        if c.get("outcome") == "unmappable":
+            assert "lone-delay" in c.get("reason", ""), c
     assert (tmp_path / "hunt" / "HUNT_REPORT.json").exists()
     md = (tmp_path / "hunt" / "HUNT_REPORT.md").read_text()
     assert "reproduced" in md and "Taxonomy" in md

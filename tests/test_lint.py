@@ -16,7 +16,8 @@ from pathlib import Path
 import pytest
 
 from paxi_tpu import analysis
-from paxi_tpu.analysis import concurrency, handlers, purity, tracemap
+from paxi_tpu.analysis import (ballots, concurrency, handlers, parity,
+                               purity, quorum, tracemap)
 from paxi_tpu.analysis.model import (Suppression, Violation,
                                      apply_suppressions, inline_disables,
                                      load_baseline)
@@ -140,11 +141,137 @@ def test_concurrency_fixture():
                                         # suppression is tested below)
         ("PXC401", "self.items"),       # bad_item_write (post-with)
         ("PXC402", "self.items.append(...)"),   # bad_mutate
+        # stage-2 deepening: deferred callbacks + alias mutations
+        ("PXC451", "self.count"),               # deferred.cb (returned)
+        ("PXC451", "self.items.clear(...)"),    # register's lambda
+        ("PXC451", "self.items.pop(...)"),      # returned lambda
+        ("PXC452", "d.append(...)"),            # alias_race
     ]
+    msgs = " | ".join(v.message for v in vs)
+    # negative controls: a callback that takes the lock itself and a
+    # synchronous lambda stay clean
+    assert "locked_callback_is_fine" not in msgs
+    assert "sync_lambda_is_fine" not in msgs
 
 
 def test_concurrency_repo_tree_is_clean():
     assert concurrency.check(ROOT) == []
+
+
+# ---- quorum safety (stage 2) ---------------------------------------------
+def test_quorum_fixture_intersection_and_unresolved():
+    vs = quorum.check(ROOT, files=[FIX / "fixture_quorum.py"])
+    by_code = {c: [v for v in vs if v.code == c] for c in codes(vs)}
+    assert set(by_code) == {"PXQ501", "PXQ502"}
+    msg = by_code["PXQ501"][0].message
+    # sub-majority R/W pair with a concrete counterexample size
+    assert "can fail to intersect" in msg and "self.W" in msg \
+        and "self.R" in msg and "n=" in msg
+    assert "self.mystery" in by_code["PXQ502"][0].message
+
+
+def test_quorum_model_derivation():
+    """The predicate model is derived from core/quorum.py's own source,
+    and SimConfig's quorum properties from sim/types.py — refactors
+    re-derive it, hardcoded drift is impossible."""
+    preds = quorum.load_predicates(ROOT)
+    assert preds.count["majority"](5) == 3
+    assert preds.count["majority"](4) == 3
+    assert preds.count["fast_quorum"](5) == 4   # ceil(3n/4)
+    assert preds.count["all"](5) == 5
+    props = quorum.load_sim_props(ROOT)
+    assert props["majority"](7) == 4 and props["fast_size"](7) == 6
+
+
+def test_quorum_strict_fractional_threshold(tmp_path):
+    """`size > n/3` passes from floor(n/3)+1, NOT ceil(n/3)+1 — the
+    counterexample must surface at the first unsafe size (n=2: 1+1<=2;
+    the ceil bug only found n=6, where the fraction happens to be
+    exact)."""
+    (tmp_path / "host.py").write_text(
+        "class R:\n"
+        "    def _write_done(self, op):\n"
+        "        if op.quorum.size() > self.cfg.n / 3: pass\n"
+        "    def _read_done(self, op):\n"
+        "        if op.quorum.size() > self.cfg.n / 3: pass\n")
+    preds = quorum.load_predicates(ROOT)
+    props = quorum.load_sim_props(ROOT)
+    vs = quorum.check_file(tmp_path / "host.py", tmp_path, preds, props)
+    assert [v.code for v in vs] == ["PXQ501"]
+    assert "n=2" in vs[0].message and "1+1 <= 2" in vs[0].message
+
+
+def test_quorum_repo_tree_is_clean():
+    # every protocol's quorum pairs provably intersect (tier-1 pin)
+    assert quorum.check(ROOT) == []
+
+
+# ---- ballot-guard domination (stage 2) -----------------------------------
+def test_ballot_fixture_catches_each_check():
+    vs = ballots.check(ROOT, files=[FIX / "fixture_ballot.py"])
+    got = sorted((v.code, v.line) for v in vs)
+    src = (FIX / "fixture_ballot.py").read_text().splitlines()
+
+    def line_of(marker):
+        return next(i for i, l in enumerate(src, 1) if marker in l)
+
+    assert got == [
+        ("PXB601", line_of("PXB601")),
+        ("PXB602", line_of("PXB602")),
+        ("PXB603", line_of("PXB603")),
+    ]
+    msgs = " | ".join(v.message for v in vs)
+    # guarded writes, guarded call chains and no-epoch handlers are
+    # negative controls
+    assert "handle_guarded" not in msgs and "_store" not in msgs \
+        and "handle_beat" not in msgs
+
+
+def test_ballot_repo_findings_are_baselined():
+    """The three real PXB603 findings (commit-path applications) are
+    suppressed with written reasons; nothing else fires (tier-1 pin)."""
+    report = analysis.run_lint(rules=["ballot-guard"])
+    assert report.ok, report.render()
+    assert sorted(v.path for v, _ in report.suppressed) == [
+        "paxi_tpu/protocols/epaxos/host.py",
+        "paxi_tpu/protocols/paxos/host.py",
+        "paxi_tpu/protocols/sdpaxos/host.py",
+    ]
+    assert all(v.code == "PXB603" for v, _ in report.suppressed)
+
+
+# ---- sim/host parity (stage 2) -------------------------------------------
+def test_parity_fixture_drift_and_stale_map():
+    vs = parity.check_pair("fixture", FIX / "fixture_parity_sim.py",
+                           FIX / "fixture_parity_host.py", ROOT)
+    by_code = {c: [v for v in vs if v.code == c] for c in codes(vs)}
+    assert set(by_code) == {"PXS702", "PXS703", "PXS704"}
+    assert "`ghost_field`" in by_code["PXS702"][0].message
+    assert {k for v in by_code["PXS703"]
+            for k in ("vanished", "log_bal2") if f"`{k}`" in v.message} \
+        == {"vanished", "log_bal2"}
+    assert "`no_such`" in by_code["PXS704"][0].message
+
+
+def test_parity_fixture_missing_map_entirely():
+    vs = parity.check_pair("fixture", FIX / "fixture_parity_sim.py",
+                           FIX / "fixture_parity_nomap.py", ROOT)
+    assert codes(vs) == ["PXS701"]
+    assert "exports no SIM_STATE_MAP" in vs[0].message
+
+
+def test_parity_repo_tree_is_clean():
+    """Every protocol's sim state vocabulary is accounted for against
+    its host twin — by name or through SIM_STATE_MAP (tier-1 pin; the
+    static closure of the ROADMAP hunt-divergence root cause)."""
+    assert parity.check(ROOT) == []
+
+
+def test_parity_covers_every_registry_pair():
+    protos = {p for p, _, _ in parity.analyzed_pairs(ROOT)}
+    assert {"paxos", "paxos_pg", "abd", "chain", "wpaxos", "epaxos",
+            "kpaxos", "dynamo", "sdpaxos", "wankeeper", "blockchain",
+            "fragile_counter"} <= protos
 
 
 # ---- suppression layers --------------------------------------------------
@@ -158,7 +285,7 @@ def test_inline_disable_comment_suppresses():
                         if "disable=PXC401" in l)
     assert (escaped_line, "inline") in dropped
     assert escaped_line not in kept
-    assert len(kept) == 3
+    assert len(kept) == 7      # everything seeded except the escape
 
 
 def test_baseline_parse_and_match(tmp_path):
@@ -217,8 +344,19 @@ def test_cli_lint_json_on_fixture(capsys):
 
 def test_cli_lint_unknown_rule_rejected(capsys):
     from paxi_tpu.cli import main
-    with pytest.raises(SystemExit):
-        main(["lint", "-rule", "no-such-rule"])
+    assert main(["lint", "-rule", "no-such-rule"]) == 2
+    assert "unknown rule" in capsys.readouterr().err
+
+
+def test_cli_rule_code_prefixes():
+    """`--rule PXQ,PXB` (the stage-2 CLI spelling) selects families by
+    violation-code prefix, mixed freely with family names."""
+    assert analysis.resolve_rules(["PXQ,PXB"]) == \
+        ["quorum-safety", "ballot-guard"]
+    assert analysis.resolve_rules(["pxs"]) == ["sim-host-parity"]
+    assert analysis.resolve_rules(["trace-map", "PXT"]) == ["trace-map"]
+    with pytest.raises(KeyError):
+        analysis.resolve_rules(["PXZ"])
 
 
 # ---- the repo-wide gate --------------------------------------------------

@@ -206,6 +206,24 @@ def test_sim_counters_on_simresult():
 
 
 @pytest.mark.jax
+def test_delay_collision_counter():
+    """The wheel's collision-as-loss semantics, modeled explicitly
+    (ROADMAP delay-collision item): fragile_counter broadcasts every
+    step, so randomized delays on one edge MUST overwrite in-flight
+    messages — and a fault-free run proves the counter's zero."""
+    from paxi_tpu.protocols import sim_protocol
+    from paxi_tpu.sim import FuzzConfig, SimConfig, simulate
+
+    proto = sim_protocol("fragile_counter")
+    cfg = SimConfig(n_replicas=3)
+    res = simulate(proto, cfg, 8, 40,
+                   fuzz=FuzzConfig(max_delay=3), seed=1)
+    assert int(res.counters["delay_collisions"]) > 0
+    clean = simulate(proto, cfg, 8, 40, seed=1)
+    assert int(clean.counters["delay_collisions"]) == 0
+
+
+@pytest.mark.jax
 def test_counter_series_export():
     """simulate(series=True) exports the per-step counter time series
     (the ROADMAP metrics item): one (T,) int32 per counter whose time

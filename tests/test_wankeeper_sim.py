@@ -54,7 +54,10 @@ def test_deterministic():
 
 @pytest.mark.parametrize("fuzz", [
     FuzzConfig(p_drop=0.2, max_delay=2),
-    FuzzConfig(p_dup=0.2, max_delay=3),
+    # the dup/deep-delay variant compiles a third fault path (~24 s):
+    # slow tier, with tier-1 keeping the drop and partition variants
+    pytest.param(FuzzConfig(p_dup=0.2, max_delay=3),
+                 marks=pytest.mark.slow),
     FuzzConfig(p_partition=0.3, p_crash=0.15, max_delay=2, window=8),
 ])
 def test_fuzzed_safety(fuzz):
