@@ -21,9 +21,27 @@ zero-valued artifact:
   `"device": "cpu-fallback"`.  Failure degrades to a smaller labelled
   measurement, never to value 0.
 - Worker (BENCH_STAGE=worker): inits the backend, picks the shape for
-  that backend (north-star 100k x 5 on an accelerator; the judge's
-  2048-group anchor shape on CPU), runs the sliding-ring Multi-Paxos
-  kernel (n_slots=64 regardless of horizon), and prints the JSON line.
+  that backend (north-star 100k x 5 on an accelerator; the north-star
+  group count on the CPU mesh, or the judge's 2048-group anchor shape
+  single-device), runs the sliding-ring Multi-Paxos kernel (n_slots=64
+  regardless of horizon), and prints the JSON line.
+
+Knobs (flags set the matching env var; env wins so the launcher can
+forward everything to the worker unchanged):
+
+- ``--mesh [N]`` / BENCH_MESH=N: shard the group batch over an
+  N-device mesh (default: every device; on CPU the worker forces
+  ``--xla_force_host_platform_device_count`` to N, default 8) via
+  parallel/mesh.make_sharded_run.  Warm-up/compile time is reported
+  separately (``compile_s`` / ``warmup_s``) from the steady-state
+  ``wall_s``.
+- ``--backend pallas`` / BENCH_BACKEND=pallas: run the lane-major
+  kernel with the fused Pallas exchange (paxi_tpu/ops/exchange) — the
+  staged TPU fast path.  On CPU this runs interpret-mode at a tiny
+  labelled shape (a correctness/staging run, not a rate measurement).
+- Every run appends its scaling points to BENCH_SCALING.json as a
+  labelled curve (``BENCH_LABEL`` overrides the label), so per-change
+  contributions (mesh-only vs mesh+fusion) stay visible side by side.
 """
 
 import json
@@ -39,11 +57,69 @@ BASELINE_SLOTS_PER_SEC = 10_000_000 / 60.0
 READY_MARKER = "BENCH-WORKER-READY"
 
 
+def _mesh_devices() -> int:
+    """BENCH_MESH: 0/unset = single device; ``all``/``auto`` (what the
+    bare ``--mesh`` flag sets) = every device; N = an N-device mesh
+    (N=1 is honored literally and degrades to the single-device
+    runner, so contribution ladders can sweep N honestly)."""
+    v = os.environ.get("BENCH_MESH", "0").strip().lower()
+    if v in ("", "0"):
+        return 0
+    if v in ("all", "auto"):
+        return -1
+    return int(v)
+
+
+def _append_scaling_curve(curve: dict) -> None:
+    """Append one labelled curve to BENCH_SCALING.json (schema:
+    ``{"curves": [{label, kernel, device, mesh, backend, points}]}``);
+    a legacy single-sweep file is folded in as its own curve."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_SCALING.json")
+    doc = {"curves": []}
+    try:
+        with open(path) as f:
+            old = json.load(f)
+        if "curves" in old:
+            doc = old
+        elif "scaling" in old:   # pre-curve schema: one unlabelled sweep
+            doc["curves"].append({
+                "label": "legacy single-device sweep",
+                "kernel": old.get("kernel"), "device": old.get("device"),
+                "mesh": 0, "backend": "dense",
+                "points": old["scaling"]})
+    except (OSError, ValueError):
+        pass
+    doc["curves"] = [c for c in doc["curves"]
+                     if c.get("label") != curve["label"]] + [curve]
+    try:
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+    except OSError:
+        pass
+
+
 # --------------------------------------------------------------------------
 # Worker stage: actually measure.
 # --------------------------------------------------------------------------
 
 def worker() -> int:
+    mesh_n = _mesh_devices()
+    if mesh_n:
+        # virtual CPU mesh: XLA_FLAGS is read lazily at client creation
+        # (sitecustomize imports jax early, but no backend exists yet —
+        # same seam tests/conftest.py uses).  Injected regardless of
+        # JAX_PLATFORMS: the flag only shapes the *host* platform, so
+        # an accelerator attempt is unaffected, and a CPU-only box
+        # without JAX_PLATFORMS set still gets its mesh instead of
+        # silently degrading to one device.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            n = 8 if mesh_n < 0 else mesh_n
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+
     import jax
     from paxi_tpu.utils import ensure_env_platform
     ensure_env_platform()
@@ -58,7 +134,22 @@ def worker() -> int:
     from paxi_tpu.sim import SimConfig, make_run
 
     on_cpu = jax.default_backend() == "cpu"
-    if on_cpu:
+    backend = os.environ.get("BENCH_BACKEND", "auto")
+    n_dev = len(jax.devices()) if mesh_n < 0 else min(mesh_n,
+                                                      len(jax.devices()))
+    use_mesh = mesh_n != 0 and n_dev > 1
+    if backend == "pallas" and on_cpu:
+        # interpret-mode staging run: validates the fused-exchange
+        # executable end-to-end, NOT a rate measurement (the Pallas
+        # interpreter is a Python loop)
+        n_groups = int(os.environ.get("BENCH_CPU_GROUPS", 64))
+        target_slots = int(os.environ.get("BENCH_CPU_SLOTS", 2048))
+    elif on_cpu and use_mesh:
+        # the mesh makes the north-star group count tractable on CPU:
+        # 100k groups x 36 steps sharded over the virtual mesh
+        n_groups = int(os.environ.get("BENCH_CPU_GROUPS", 100_000))
+        target_slots = int(os.environ.get("BENCH_CPU_SLOTS", 3_200_000))
+    elif on_cpu:
         # Judge-anchor shape (VERDICT r2): 2048 groups x 104 steps on one
         # CPU core finished in ~34s; keep the fallback inside any driver
         # budget while still producing a real sustained-rate measurement.
@@ -77,17 +168,29 @@ def worker() -> int:
 
     # layout by backend: lane-major (G-last) feeds the TPU vector lanes;
     # the per-group kernel vmapped over a leading G axis is ~6x faster
-    # on XLA:CPU (VERDICT r4 weak #1)
-    proto = sim_protocol("paxos_pg" if on_cpu else "paxos")
+    # on XLA:CPU (VERDICT r4 weak #1).  --backend pallas forces the
+    # lane-major kernel (the layout the fused exchange was built for).
+    proto = sim_protocol("paxos" if (backend == "pallas" or not on_cpu)
+                         else "paxos_pg")
     cfg = SimConfig(n_replicas=n_replicas, n_slots=n_slots)
-    run = make_run(proto, cfg)
+    exchange = "pallas" if backend == "pallas" else "dense"
+    if use_mesh:
+        from paxi_tpu.parallel import make_mesh, make_sharded_run
+        run = make_sharded_run(proto, cfg, mesh=make_mesh(n_dev),
+                               exchange=exchange)
+    else:
+        run = make_run(proto, cfg, exchange=exchange)
 
-    # AOT-compile the exact executable; one warm-up invocation pays the
-    # first-touch allocator/constant-transfer costs so the timed run
-    # measures steady-state throughput (same methodology as the
-    # scaling sweep below)
+    # AOT-compile the exact executable, then one warm-up invocation to
+    # pay the first-touch allocator/constant-transfer costs — both
+    # reported separately so the timed run is steady-state throughput
+    # only (same methodology as the scaling sweep below)
+    t0 = time.perf_counter()
     compiled = run.lower(jr.PRNGKey(0), n_groups, n_steps).compile()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
     jax.block_until_ready(compiled(jr.PRNGKey(1)))
+    warmup_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     state, metrics, viols = compiled(jr.PRNGKey(0))
@@ -103,12 +206,17 @@ def worker() -> int:
         "vs_baseline": round(slots_per_sec / BASELINE_SLOTS_PER_SEC, 3),
         "committed_slots": committed,
         "wall_s": round(dt, 3),
+        "compile_s": round(compile_s, 3),
+        "warmup_s": round(warmup_s, 3),
         "invariant_violations": int(viols),
         "groups": n_groups,
         "replicas": n_replicas,
         "steps": n_steps,
         "ring_slots": n_slots,
         "kernel": proto.name,
+        "mesh": n_dev if use_mesh else 0,
+        "backend": ("pallas-interpret" if backend == "pallas" and on_cpu
+                    else backend),
         "device": ("cpu-fallback" if os.environ.get("BENCH_FALLBACK")
                    else str(dev)),
     }
@@ -120,14 +228,23 @@ def worker() -> int:
 
     # lane-occupancy proof: wall time vs group count at fixed steps.
     # On a TPU the lane-major kernel should be near wall-flat until the
-    # vector lanes saturate; on the CPU fallback the curve is linear.
-    # Emitted on stderr (stdout carries exactly ONE json line) and
-    # saved next to the repo for the round artifact.
-    if os.environ.get("BENCH_SCALING", "1") == "1":
-        sweep = ((256, 4096, 32768) if not on_cpu else (256, 1024, 2048))
+    # vector lanes saturate; on the CPU fallback the curve is linear
+    # (mesh runs: linear at 1/n_dev slope).  Emitted on stderr (stdout
+    # carries exactly ONE json line) and appended to BENCH_SCALING.json
+    # as a labelled curve for the per-change trajectory.
+    if os.environ.get("BENCH_SCALING", "1") == "1" \
+            and backend != "pallas":
+        sweep = ((256, 4096, 32768) if not on_cpu
+                 else (2048, 16384) if use_mesh
+                 else (256, 1024, 2048))
+        # a deliberately shrunk run must not be followed by a sweep
+        # orders of magnitude bigger than what was asked for — and the
+        # primary measurement doubles as its own curve point, so the
+        # n_groups shape is never compiled and timed twice
+        sweep = tuple(g for g in sweep if g < n_groups)
         sweep_steps = 36
         curve = []
-        for g in sweep:
+        for g in sorted(set(sweep)):
             c = run.lower(jr.PRNGKey(0), g, sweep_steps).compile()
             out = c(jr.PRNGKey(0))            # warm the allocator
             jax.block_until_ready(out)
@@ -137,17 +254,17 @@ def worker() -> int:
             curve.append({"groups": g, "steps": sweep_steps,
                           "wall_s": round(time.perf_counter() - t0, 4),
                           "committed": int(mtr["committed_slots"])})
-        sc = {"scaling": curve, "device": result["device"],
-              "kernel": proto.name}
+        curve.append({"groups": n_groups, "steps": n_steps,
+                      "wall_s": result["wall_s"],
+                      "committed": committed})
+        label = os.environ.get("BENCH_LABEL") or (
+            f"{proto.name}" + (f"-mesh{n_dev}" if use_mesh else "-single"))
+        sc = {"label": label, "kernel": proto.name,
+              "device": result["device"], "mesh": result["mesh"],
+              "backend": result["backend"], "points": curve}
         print("bench-scaling: " + json.dumps(sc), file=sys.stderr,
               flush=True)
-        try:
-            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "BENCH_SCALING.json")
-            with open(path, "w") as f:
-                json.dump(sc, f)
-        except OSError:
-            pass
+        _append_scaling_curve(sc)
 
     return 0 if int(viols) == 0 else 1
 
@@ -157,6 +274,8 @@ def worker() -> int:
 # --------------------------------------------------------------------------
 
 def _spawn_worker(env) -> subprocess.Popen:
+    # flags were already folded into env by main(), so the bare path
+    # re-runs the worker with identical knobs
     return subprocess.Popen(
         [sys.executable, os.path.abspath(__file__)],
         env=env, stdout=subprocess.PIPE, stderr=sys.stderr,
@@ -312,7 +431,35 @@ def launcher() -> int:
     return worker()
 
 
-if __name__ == "__main__":
+def main(argv=None) -> int:
+    """Thin flag layer: every flag sets its env var (env wins if both
+    are given), so launcher->worker forwarding stays env-only."""
+    import argparse
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--mesh", nargs="?", const="all", default=None,
+                   metavar="N",
+                   help="shard groups over an N-device mesh "
+                        "(default all devices; BENCH_MESH)")
+    p.add_argument("--backend", choices=("auto", "pallas"), default=None,
+                   help="pallas = lane-major kernel + fused Pallas "
+                        "exchange (BENCH_BACKEND)")
+    p.add_argument("--force-cpu", action="store_true",
+                   help="skip accelerator attempts (BENCH_FORCE_CPU=1)")
+    p.add_argument("--label", default=None,
+                   help="BENCH_SCALING.json curve label (BENCH_LABEL)")
+    args = p.parse_args(argv)
+    if args.mesh is not None:
+        os.environ.setdefault("BENCH_MESH", args.mesh)
+    if args.backend is not None:
+        os.environ.setdefault("BENCH_BACKEND", args.backend)
+    if args.force_cpu:
+        os.environ.setdefault("BENCH_FORCE_CPU", "1")
+    if args.label is not None:
+        os.environ.setdefault("BENCH_LABEL", args.label)
     if os.environ.get("BENCH_STAGE") == "worker":
-        sys.exit(worker())
-    sys.exit(launcher())
+        return worker()
+    return launcher()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

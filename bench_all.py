@@ -10,12 +10,25 @@ accelerator tunnel is wedged — set BENCH_ALL_DEVICE=native to use the
 environment's default backend instead).  Each config is compiled AOT,
 warmed once, then timed on a second cold-state invocation, mirroring
 bench.py's methodology.
+
+``--mesh [N]`` (or BENCH_ALL_MESH=N) shards every config's group batch
+over an N-device mesh via parallel/mesh.make_sharded_run (default 8
+virtual CPU devices); group counts that don't divide the mesh ride the
+inert-padding path.
 """
 
 import json
 import os
 import sys
 import time
+
+if "--mesh" in sys.argv:
+    i = sys.argv.index("--mesh")
+    nxt = sys.argv[i + 1] if len(sys.argv) > i + 1 else ""
+    os.environ.setdefault("BENCH_ALL_MESH", nxt if nxt.isdigit() else "8")
+    sys.argv = [a for j, a in enumerate(sys.argv)
+                if j != i and not (j == i + 1 and nxt.isdigit())]
+MESH_N = int(os.environ.get("BENCH_ALL_MESH", "0"))
 
 if (os.environ.get("BENCH_ALL_DEVICE", "cpu") == "cpu"
         and os.environ.get("_BENCH_ALL_STAGE") != "run"):
@@ -25,6 +38,11 @@ if (os.environ.get("BENCH_ALL_DEVICE", "cpu") == "cpu"
     # Re-exec with a clean environment before jax ever loads.
     env = dict(os.environ, _BENCH_ALL_STAGE="run", JAX_PLATFORMS="cpu")
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    if MESH_N and "xla_force_host_platform_device_count" not in \
+            env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count="
+                            f"{MESH_N}").strip()
     os.execve(sys.executable,
               [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
               env)
@@ -91,12 +109,19 @@ def _cfgs():
 
 def main() -> int:
     dev = str(jax.devices()[0])
+    mesh = None
+    if MESH_N and len(jax.devices()) > 1:
+        from paxi_tpu.parallel import make_mesh, make_sharded_run
+        mesh = make_mesh(min(MESH_N, len(jax.devices())))
     results = []
     worst = 0
     for (label, proto_name, cfg, fuzz, groups, steps, key,
          unit) in _cfgs():
         proto = sim_protocol(proto_name)
-        run = make_run(proto, cfg, fuzz)
+        if mesh is not None:
+            run = make_sharded_run(proto, cfg, fuzz=fuzz, mesh=mesh)
+        else:
+            run = make_run(proto, cfg, fuzz)
         compiled = run.lower(jr.PRNGKey(0), groups, steps).compile()
         jax.block_until_ready(compiled(jr.PRNGKey(1)))
         t0 = time.perf_counter()
@@ -116,6 +141,7 @@ def main() -> int:
             "invariant_violations": int(viols),
             "groups": groups,
             "steps": steps,
+            "mesh": mesh.shape["i"] if mesh is not None else 0,
             "device": dev,
         }
         worst = max(worst, int(viols))

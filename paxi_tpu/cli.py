@@ -172,6 +172,23 @@ def _run_sim(args, proto, cfg, fuzz) -> int:
     return 0 if out["invariant_violations"] == 0 else 1
 
 
+def cmd_profile(args) -> int:
+    """Per-phase wall timings for a bench-shaped run (lower/compile/
+    warmup/steady-state), optional jax.profiler trace — the regression
+    diagnosis surface for the north-star speed work (paxi_tpu/
+    profiling.py)."""
+    from paxi_tpu.profiling import main_json
+    from paxi_tpu.sim import FuzzConfig
+    fuzz = FuzzConfig(p_drop=args.p_drop, p_dup=args.p_dup,
+                      max_delay=args.max_delay)
+    return main_json(algorithm=args.algorithm, groups=args.groups,
+                     steps=args.steps, replicas=args.replicas,
+                     slots=args.slots, seed=args.seed,
+                     shard=args.shard, repeats=args.repeats,
+                     exchange=args.exchange, trace_dir=args.trace_dir,
+                     fuzz=fuzz)
+
+
 def cmd_trace(args) -> int:
     """Trace artifacts: inspect, deterministically replay, minimize,
     and project onto the host runtime (see paxi_tpu/trace/)."""
@@ -454,6 +471,31 @@ def main(argv=None) -> int:
     m.add_argument("-profile", "--profile", default="",
                    help="write a JAX/XLA profiler trace to this dir")
     m.set_defaults(fn=cmd_sim)
+
+    pr = sub.add_parser("profile",
+                        help="per-phase wall timings (lower/compile/"
+                             "warmup/run) + optional XLA profile")
+    pr.add_argument("-algorithm", "--algorithm", default="paxos_pg")
+    pr.add_argument("-groups", type=int, default=2048)
+    pr.add_argument("-steps", type=int, default=36)
+    pr.add_argument("-replicas", type=int, default=5)
+    pr.add_argument("-slots", type=int, default=64)
+    pr.add_argument("-seed", type=int, default=0)
+    pr.add_argument("-shard", type=int, default=0, metavar="N",
+                    help="profile on an N-device mesh (0 = single)")
+    pr.add_argument("-repeats", type=int, default=3,
+                    help="timed re-invocations; best wall reported")
+    pr.add_argument("-exchange", choices=("dense", "pallas"),
+                    default="dense",
+                    help="message-exchange backend (lane-major only)")
+    pr.add_argument("-p_drop", type=float, default=0.0)
+    pr.add_argument("-p_dup", type=float, default=0.0)
+    pr.add_argument("-max_delay", type=int, default=1)
+    pr.add_argument("-trace_dir", "-trace-dir", "--trace-dir",
+                    dest="trace_dir", default="",
+                    help="also write a jax.profiler trace here "
+                         "(view with tensorboard/xprof)")
+    pr.set_defaults(fn=cmd_profile)
 
     t = sub.add_parser("trace", help="violation traces: replay/shrink")
     tsub = t.add_subparsers(dest="trace_cmd", required=True)

@@ -1,0 +1,233 @@
+"""Pallas lane-major message-exchange kernels (staged TPU fast path).
+
+The dense exchange (`sim/mailbox.py`, shared by `sim/lanes.py`) builds
+the per-step wheel rotate + masked insert out of ~10 XLA ops per
+message-type field; on TPU every one of them round-trips the (delay,
+src, dst, G) planes through HBM.  This module fuses each half into one
+Pallas kernel over lane-major planes — the layout the 8x128 vector
+unit tiles natively (see sim/lanes.py) — so a step's exchange touches
+each plane once:
+
+- ``wheel_deliver`` / ``wheel_insert``: drop-in replacements for the
+  ``sim.mailbox`` pair with identical semantics (same collision rule:
+  a new message overwrites an undelivered one in the same wheel cell).
+  All fields of a message type move through one kernel invocation as a
+  stacked int32 block, gridded over the group (lane) axis.  On
+  non-TPU backends the kernels run in interpret mode, which is what
+  the CPU correctness test exercises — semantics are pinned to the
+  dense exchange bit-for-bit before the TPU ever sees the kernel.
+- ``make_remote_lane_shift``: the staged inter-chip half
+  (``pltpu.make_async_remote_copy``, SNIPPETS.md [1][2]): rotate a
+  lane-major shard to the right mesh neighbor over ICI — the
+  group-migration / zone-exchange primitive the cross-device protocols
+  (wpaxos zones <-> mesh axis) need.  Real-RDMA only: it traces on a
+  TPU mesh and raises elsewhere, so the moment the tunnel heals we run
+  the layout this was designed for instead of re-discovering it.
+
+Select at the bench level with ``--backend pallas`` (bench.py); the
+runner threads it through ``make_run(..., exchange="pallas")`` for
+lane-major kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from paxi_tpu.sim import mailbox as mb
+from paxi_tpu.sim.lanes import (empty_wheel, fault_state_init,  # noqa: F401
+                                fault_state_refresh)
+from paxi_tpu.sim.types import FuzzConfig, Mailboxes
+
+MailSpec = Dict[str, Tuple[str, ...]]
+
+
+def _interpret() -> bool:
+    """Interpret everywhere but real TPU — the CPU-pinned semantics are
+    the contract; the compiled path is the same kernel body."""
+    return jax.default_backend() != "tpu"
+
+
+def _block_g(g: int) -> int:
+    """Grid the lane (group) axis: the largest divisor of ``g`` that
+    fits a 128-lane tile, so an off-multiple batch (e.g. the 100k
+    north-star shape, 100000 % 128 == 32) still grids into
+    VMEM-sized blocks instead of one whole-batch block."""
+    for b in range(min(g, 128), 0, -1):
+        if g % b == 0:
+            return b
+    return g
+
+
+# --------------------------------------------------------------------------
+# fused deliver: pop slot 0, rotate the wheel forward
+# --------------------------------------------------------------------------
+
+def _deliver_kernel(wheel_ref, inbox_ref, rolled_ref):
+    d = wheel_ref.shape[0]
+    inbox_ref[...] = wheel_ref[0]
+    if d > 1:
+        rolled_ref[:d - 1] = wheel_ref[1:]
+    rolled_ref[d - 1] = jnp.zeros_like(wheel_ref[0])
+
+
+def _stack(box, fields):
+    """Stack a message type's {valid, *fields} planes into one int32
+    block (valid first) so the whole type moves through one kernel."""
+    planes = [box["valid"].astype(jnp.int32)]
+    planes += [box[f] for f in fields]
+    return jnp.stack(planes, axis=-4)   # (..., F, src, dst, G)
+
+
+def _unstack(stacked, fields):
+    out = {"valid": stacked[..., 0, :, :, :] != 0}
+    for i, f in enumerate(fields):
+        out[f] = stacked[..., i + 1, :, :, :]
+    return out
+
+
+def wheel_deliver(wheel: Mailboxes) -> Tuple[Mailboxes, Mailboxes]:
+    """Pop slot 0 as this step's inbox; rotate the wheel forward.
+    Pallas-fused per message type; semantics = mailbox.wheel_deliver."""
+    inbox, rolled = {}, {}
+    for name, box in wheel.items():
+        fields = tuple(k for k in box if k != "valid")
+        st = _stack(box, fields)                    # (d, F, R, R, G)
+        d, F, R, _, G = st.shape
+        gb = _block_g(G)
+        out = pl.pallas_call(
+            _deliver_kernel,
+            grid=(G // gb,),
+            in_specs=[pl.BlockSpec((d, F, R, R, gb),
+                                   lambda i: (0, 0, 0, 0, i))],
+            out_shape=(jax.ShapeDtypeStruct((F, R, R, G), jnp.int32),
+                       jax.ShapeDtypeStruct((d, F, R, R, G), jnp.int32)),
+            out_specs=(pl.BlockSpec((F, R, R, gb),
+                                    lambda i: (0, 0, 0, i)),
+                       pl.BlockSpec((d, F, R, R, gb),
+                                    lambda i: (0, 0, 0, 0, i))),
+            interpret=_interpret(),
+        )(st)
+        inbox[name] = _unstack(out[0], fields)
+        rolled[name] = _unstack(out[1], fields)
+    return inbox, rolled
+
+
+# --------------------------------------------------------------------------
+# fused insert: masked scatter of the outbox into the wheel
+# --------------------------------------------------------------------------
+
+def _insert_kernel(wheel_ref, out_ref, eff_ref, delay_ref, dup_ref,
+                   new_ref):
+    d, F = wheel_ref.shape[0], wheel_ref.shape[1]
+    eff = eff_ref[...] != 0
+    delay = delay_ref[...]
+    dup = dup_ref[...] != 0
+    dup_delay = jnp.minimum(delay + 1, d)
+    for slot in range(d):
+        put = eff & ((delay == slot + 1) | (dup & (dup_delay == slot + 1)))
+        new_ref[slot, 0] = ((wheel_ref[slot, 0] != 0) | put).astype(
+            jnp.int32)
+        for f in range(1, F):
+            new_ref[slot, f] = jnp.where(put, out_ref[f],
+                                         wheel_ref[slot, f])
+
+
+def wheel_insert(wheel: Mailboxes, outbox: Mailboxes, fs,
+                 fuzz: FuzzConfig, faults: Mailboxes) -> Mailboxes:
+    """Push this step's outbox into the wheel under the fault schedule.
+    Pallas-fused per message type; semantics = mailbox.wheel_insert
+    (one definition of the delivery-validity predicate — live_mask —
+    keeps the replay guarantee shared with the dense exchange)."""
+    d = fuzz.wheel
+    new_wheel = {}
+    for name in sorted(outbox.keys()):
+        box, wbox = outbox[name], wheel[name]
+        fields = tuple(k for k in wbox if k != "valid")
+        n = box["valid"].shape[0]
+        f = faults[name]
+        eff = (box["valid"] & mb.live_mask(fs, box["valid"].ndim, n)
+               & ~f["drop"])
+        st = _stack(wbox, fields)                   # (d, F, R, R, G)
+        ob = _stack(box, fields)                    # (F, R, R, G)
+        _, F, R, _, G = st.shape
+        gb = _block_g(G)
+        spec3 = pl.BlockSpec((R, R, gb), lambda i: (0, 0, i))
+        out = pl.pallas_call(
+            _insert_kernel,
+            grid=(G // gb,),
+            in_specs=[pl.BlockSpec((d, F, R, R, gb),
+                                   lambda i: (0, 0, 0, 0, i)),
+                      pl.BlockSpec((F, R, R, gb),
+                                   lambda i: (0, 0, 0, i)),
+                      spec3, spec3, spec3],
+            out_shape=jax.ShapeDtypeStruct((d, F, R, R, G), jnp.int32),
+            out_specs=pl.BlockSpec((d, F, R, R, gb),
+                                   lambda i: (0, 0, 0, 0, i)),
+            interpret=_interpret(),
+        )(st, ob, eff.astype(jnp.int32), f["delay"],
+          f["dup"].astype(jnp.int32))
+        new_wheel[name] = _unstack(out, fields)
+    return new_wheel
+
+
+# --------------------------------------------------------------------------
+# staged: inter-chip lane shift over ICI (real RDMA, TPU only)
+# --------------------------------------------------------------------------
+
+def make_remote_lane_shift(mesh, axis: str = "i"):
+    """Build ``shift(x)``: rotate each device's lane-major shard
+    ``(..., G_local)`` to its right mesh neighbor with one async remote
+    copy (``pltpu.make_async_remote_copy`` — SNIPPETS.md [1][2]).  The
+    staged group-migration primitive: when groups (or WPaxos zones) map
+    onto the mesh axis, a leadership steal is this shift instead of a
+    host round-trip.
+
+    Traces only on a TPU mesh — the DMA semaphores and ICI routing have
+    no CPU analog (the CPU-testable exchange above is interpret-mode;
+    this one is the hardware half)."""
+    if jax.default_backend() != "tpu":   # pragma: no cover - TPU only
+        raise NotImplementedError(
+            "remote lane shift needs real ICI RDMA; on CPU use "
+            "jnp.roll over the gathered axis (the sim's mesh psum "
+            "path) — this kernel is staged for the TPU backend")
+
+    from jax.experimental.pallas import tpu as pltpu  # pragma: no cover
+
+    def _kernel(x_ref, out_ref, send_sem, recv_sem):  # pragma: no cover
+        my = jax.lax.axis_index(axis)
+        right = jax.lax.rem(my + 1, jax.lax.axis_size(axis))
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=x_ref, dst_ref=out_ref,
+            send_sem=send_sem, recv_sem=recv_sem,
+            device_id=(right,),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        rdma.start()
+        rdma.wait()
+
+    def shift(x):  # pragma: no cover - TPU only
+        # one version-compat shim for shard_map, owned by mesh.py
+        from paxi_tpu.parallel.mesh import _shard_map
+        shard = functools.partial(
+            _shard_map, mesh=mesh,
+            in_specs=jax.sharding.PartitionSpec(axis),
+            out_specs=jax.sharding.PartitionSpec(axis),
+            check_rep=False)
+
+        @shard
+        def _shifted(xs):
+            return pl.pallas_call(
+                _kernel,
+                out_shape=jax.ShapeDtypeStruct(xs.shape, xs.dtype),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+                scratch_shapes=[pltpu.SemaphoreType.DMA] * 2,
+            )(xs)
+
+        return _shifted(x)
+
+    return shift

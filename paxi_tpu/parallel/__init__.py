@@ -1,5 +1,6 @@
 """Device-mesh parallelism for the sim runtime."""
 
-from paxi_tpu.parallel.mesh import make_mesh, make_sharded_run
+from paxi_tpu.parallel.mesh import (make_mesh, make_sharded_pinned_run,
+                                    make_sharded_run)
 
-__all__ = ["make_mesh", "make_sharded_run"]
+__all__ = ["make_mesh", "make_sharded_run", "make_sharded_pinned_run"]

@@ -9,6 +9,24 @@ are independent, so they shard perfectly over ICI — each device simulates
 (jax.distributed + a bigger mesh): the collective rides whatever links
 the mesh spans.
 
+Group counts need not divide the mesh: the batch is padded with inert
+tail groups to the next multiple and their contribution is subtracted
+from (per-group kernels) or masked out of (lane-major kernels) the
+psum'd metrics, so arbitrary ``n_groups`` shard.
+
+PRNG parity (per-group kernels): the carry is initialized at the REAL
+group count outside ``shard_map`` — exactly the layout the
+single-device runner builds — padded (if needed) with independently
+keyed inert groups, and sharded along the leading group axis, so every
+real group consumes the same per-group key chain it would on one
+device, divisible batch or not.  Sharded runs of per-group kernels are therefore *bit-for-bit*
+equal to single-device runs (metrics, ``net_*`` counters, violations),
+which is what lets ``make_sharded_pinned_run`` replay a captured trace
+inside a sharded batch with the state-hash + counter check intact.
+Lane-major kernels draw whole-batch shaped randomness from one key, so
+their shards get independent streams: aggregate behavior matches, bits
+do not (and sharded pinned replay is per-group-kernel only).
+
 WPaxos zone-sharding (zones <-> mesh axis, Multicast(zone) <->
 ppermute) is a planned refinement; see paxi_tpu/protocols/wpaxos.
 """
@@ -32,7 +50,8 @@ if _shard_map is None:  # pragma: no cover - version-dependent
     from jax.experimental.shard_map import shard_map as _shard_map
 _HAS_VMA = hasattr(jax.lax, "pcast") and hasattr(jax, "typeof")
 
-from paxi_tpu.sim.runner import finish_run, init_carry, make_scan_body
+from paxi_tpu.sim.runner import (_group_step, finish_run, init_carry,
+                                 make_scan_body)
 from paxi_tpu.sim.types import FAULT_FREE, FuzzConfig, SimConfig, SimProtocol
 
 
@@ -44,51 +63,211 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "i") -> Mesh:
     return Mesh(np.array(devs[:n]), (axis,))
 
 
+def _vary(x, axis):
+    """Mark a mesh-invariant leaf as varying over the shard axis so the
+    scan carry types match (no-op without the vma type system)."""
+    if not _HAS_VMA:
+        return x
+    if axis in getattr(jax.typeof(x), "vma", frozenset()):
+        return x
+    return jax.lax.pcast(x, (axis,), to="varying")
+
+
+def _padded_carry(proto, cfg, fuzz, n_groups: int, n_pad: int, rng):
+    """Full-batch per-group carry with the real groups' key chains
+    EXACTLY as the single-device runner derives them, padded with
+    independently-keyed inert groups.  ``jr.split(k, g_pad)[:G]`` is
+    NOT ``jr.split(k, G)`` on current jax, so the pad groups must come
+    from their own fold — otherwise padding would silently change every
+    real group's schedule and break the bit-parity/replay contract."""
+    carry = init_carry(proto, cfg, fuzz, n_groups, rng)
+    if not n_pad:
+        return carry
+    pad = init_carry(proto, cfg, fuzz, n_pad, jr.fold_in(rng, 0x9ad))
+    return jax.tree.map(lambda a, b: jnp.concatenate([a, b], axis=0),
+                        carry, pad)
+
+
 def make_sharded_run(proto: SimProtocol, cfg: SimConfig,
                      fuzz: FuzzConfig = FAULT_FREE,
-                     mesh: Optional[Mesh] = None, axis: str = "i"):
+                     mesh: Optional[Mesh] = None, axis: str = "i",
+                     exchange: str = "dense"):
     """Build ``run(rng, n_groups, n_steps)`` with the group axis sharded
-    over ``mesh``; returns (sharded final state, psum'd metrics, psum'd
-    violation count)."""
+    over ``mesh``; returns (final state, psum'd metrics, psum'd
+    violation count).  ``n_groups`` may be any positive count (see the
+    module docstring for the padding contract); the returned state is
+    trimmed back to ``n_groups``.
+
+    Padding fine print: protocol metrics always exclude the pad groups.
+    For per-group kernels the ``net_*`` counters and the violation
+    count exclude them too (per-group masking); for lane-major kernels
+    the counters/violations are whole-batch reductions inside the
+    kernel, so pad groups ride along there — counters over-count pad
+    traffic and a pad-group violation still trips the oracle (it would
+    be a real protocol bug, just in a group nobody asked for).
+
+    ``exchange`` selects the lane-major message-exchange backend
+    (``dense`` or ``pallas``), as in ``runner.make_run``; per-group
+    kernels always use the dense per-group planes."""
     mesh = mesh or make_mesh()
     n_dev = mesh.shape[axis]
-    body = make_scan_body(proto, cfg, fuzz)
 
     @functools.partial(jax.jit, static_argnums=(1, 2))
     def run(rng, n_groups: int, n_steps: int):
-        if n_groups % n_dev:
-            raise ValueError(f"n_groups={n_groups} not divisible by "
-                             f"mesh axis {axis}={n_dev}")
-        g_local = n_groups // n_dev
+        n_pad = (-n_groups) % n_dev
+        g_pad = n_groups + n_pad
+        g_local = g_pad // n_dev
+
+        if proto.batched:
+            body = make_scan_body(proto, cfg, fuzz, exchange=exchange)
+            # pallas_call has no shard_map replication rule; psums make
+            # the outputs' replication explicit anyway, so the checker
+            # adds nothing on that path
+            rep_kw = {"check_rep": False} if exchange == "pallas" else {}
+
+            @functools.partial(
+                _shard_map, mesh=mesh,
+                in_specs=P(axis),
+                out_specs=(P(axis), P(), P()), **rep_kw)
+            def sharded(rngs):
+                carry = init_carry(proto, cfg, fuzz, g_local, rngs[0])
+                state0 = carry[0]
+                carry = jax.tree.map(lambda x: _vary(x, axis), carry)
+                carry, (viols, counts) = jax.lax.scan(body, carry,
+                                                      jnp.arange(n_steps))
+                if n_pad:
+                    # neutralize pad groups before the metrics
+                    # reduction: blend their final state back to the
+                    # (metric-zero) initial state.  Group-additive
+                    # metrics — the same contract the psum below
+                    # already relies on — then exclude them exactly.
+                    d = jax.lax.axis_index(axis)
+                    real = d * g_local + jnp.arange(g_local) < n_groups
+                    carry = (jax.tree.map(
+                        lambda cur, ini: jnp.where(real, cur, ini),
+                        carry[0], jax.tree.map(lambda x: _vary(x, axis),
+                                               state0)),) + carry[1:]
+                state, metrics, viol = finish_run(proto, cfg, carry,
+                                                  viols, counts)
+                metrics = {k: jax.lax.psum(v, axis)
+                           for k, v in metrics.items()}
+                viol = jax.lax.psum(viol, axis)
+                return state, metrics, viol
+
+            state, metrics, viol = sharded(jr.split(rng, n_dev))
+        else:
+            # per-group kernel: full-batch init OUTSIDE the shard_map
+            # (single-device PRNG layout => bit-for-bit parity), then
+            # shard every carry leaf along its leading group axis
+            step1 = functools.partial(_group_step, proto, cfg, fuzz)
+            carry = _padded_carry(proto, cfg, fuzz, n_groups, n_pad, rng)
+
+            @functools.partial(
+                _shard_map, mesh=mesh,
+                in_specs=P(axis),
+                out_specs=(P(axis), P(), P()))
+            def sharded(carry):
+                d = jax.lax.axis_index(axis)
+                real = (d * g_local + jnp.arange(g_local) < n_groups
+                        if n_pad else None)
+
+                def body(c, t):
+                    c, (viol, counts) = jax.vmap(
+                        step1, in_axes=(0, None))(c, t)
+                    if real is not None:
+                        viol = jnp.where(real, viol, 0)
+                        counts = {k: jnp.sum(jnp.where(real, v, 0))
+                                  for k, v in counts.items()}
+                    else:
+                        counts = {k: jnp.sum(v) for k, v in counts.items()}
+                    return c, (jnp.sum(viol), counts)
+
+                carry, (viols, counts) = jax.lax.scan(body, carry,
+                                                      jnp.arange(n_steps))
+                # the shared aggregation tail, then reduce across
+                # shards — the psum covers the runner's ``net_*``
+                # counters too, so sharded runs report whole-batch
+                # message/fault totals
+                state, metrics, viol = finish_run(proto, cfg, carry,
+                                                  viols, counts,
+                                                  group_mask=real)
+                metrics = {k: jax.lax.psum(v, axis)
+                           for k, v in metrics.items()}
+                viol = jax.lax.psum(viol, axis)
+                return state, metrics, viol
+
+            state, metrics, viol = sharded(carry)
+        if n_pad:
+            state = jax.tree.map(lambda x: x[:n_groups], state)
+        return state, metrics, viol
+
+    return run
+
+
+def make_sharded_pinned_run(proto: SimProtocol, cfg: SimConfig,
+                            fuzz: FuzzConfig, group: int,
+                            mesh: Optional[Mesh] = None, axis: str = "i"):
+    """Sharded twin of ``sim/runner.make_pinned_run``: replay a captured
+    single-group schedule inside a batch sharded over ``mesh``.
+
+    Because the per-group carry is initialized at the full-batch
+    geometry outside the shard_map (see module docstring), every group
+    — traced and scaffolding alike — consumes exactly the key chain of
+    the single-device pinned run, so the replay reproduces the captured
+    state hash and ``net_*`` counters bit-for-bit.  Per-group kernels
+    only: lane-major kernels draw whole-batch randomness that cannot be
+    re-sliced per shard (their pinned replay stays single-device)."""
+    if proto.batched:
+        raise NotImplementedError(
+            "sharded pinned replay needs per-group PRNG streams; "
+            f"lane-major kernel {proto.name!r} draws whole-batch "
+            "randomness — replay it with sim/runner.make_pinned_run")
+    mesh = mesh or make_mesh()
+    n_dev = mesh.shape[axis]
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def run(rng, n_groups: int, sched):
+        n_pad = (-n_groups) % n_dev
+        g_pad = n_groups + n_pad
+        g_local = g_pad // n_dev
+        carry = _padded_carry(proto, cfg, fuzz, n_groups, n_pad, rng)
+        n_steps = jax.tree_util.tree_leaves(sched)[0].shape[0]
 
         @functools.partial(
             _shard_map, mesh=mesh,
-            in_specs=P(axis),
-            out_specs=(P(axis), P(), P()))
-        def sharded(rngs):
-            carry = init_carry(proto, cfg, fuzz, g_local, rngs[0])
-            # zero-initialized leaves are mesh-invariant; mark them as
-            # varying over the shard axis so the scan carry types match
-            # (a no-op on jax builds without the vma type system)
-            def _vary(x):
-                if not _HAS_VMA:
-                    return x
-                if axis in getattr(jax.typeof(x), "vma", frozenset()):
-                    return x
-                return jax.lax.pcast(x, (axis,), to="varying")
-            carry = jax.tree.map(_vary, carry)
-            carry, (viols, counts) = jax.lax.scan(body, carry,
-                                                  jnp.arange(n_steps))
-            # the shared aggregation tail (group-major public state for
-            # either layout), then reduce across shards — the psum
-            # covers the runner's ``net_*`` counters too, so sharded
-            # runs report whole-batch message/fault totals
-            state, metrics, viol = finish_run(proto, cfg, carry, viols,
-                                              counts)
-            metrics = {k: jax.lax.psum(v, axis) for k, v in metrics.items()}
-            viol = jax.lax.psum(viol, axis)
-            return state, metrics, viol
+            in_specs=(P(axis), P()),
+            out_specs=(P(axis), P(), P(), P()))
+        def sharded(carry, sched):
+            d = jax.lax.axis_index(axis)
+            gidx = d * g_local + jnp.arange(g_local)
+            on_local = gidx == group
+            real = gidx < n_groups
 
-        return sharded(jr.split(rng, n_dev))
+            def body(c, xt):
+                t, sched_t = xt
+                c, (viol, counts) = jax.vmap(
+                    lambda cg, on: _group_step(proto, cfg, fuzz, cg, t,
+                                               sched_t=sched_t, pin_on=on),
+                    in_axes=(0, 0))(c, on_local)
+                # violations: traced group only (the replay oracle);
+                # counters: whole real batch, like make_pinned_run
+                viol_g = jnp.sum(jnp.where(on_local, viol, 0))
+                counts = {k: jnp.sum(jnp.where(real, v, 0))
+                          for k, v in counts.items()}
+                return c, (viol_g, counts)
+
+            carry, (viols, counts) = jax.lax.scan(
+                body, carry, (jnp.arange(n_steps), sched))
+            state, metrics, total = finish_run(proto, cfg, carry, viols,
+                                               counts, group_mask=real)
+            metrics = {k: jax.lax.psum(v, axis) for k, v in metrics.items()}
+            total = jax.lax.psum(total, axis)
+            viols = jax.lax.psum(viols, axis)
+            return state, metrics, total, viols
+
+        state, metrics, total, viols = sharded(carry, sched)
+        if n_pad:
+            state = jax.tree.map(lambda x: x[:n_groups], state)
+        return state, metrics, total, viols
 
     return run

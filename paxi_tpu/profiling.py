@@ -1,0 +1,117 @@
+"""Phase-timed profiling harness for the sim runtime.
+
+``python -m paxi_tpu profile`` answers "where did the wall time go?"
+for a bench-shaped run without reading bench.py's artifact plumbing:
+it splits the run into the phases that matter for regressions —
+trace/lower, XLA compile, first-touch warmup, steady-state execution —
+wall-times each, derives per-step and per-slot rates, and (optionally)
+wraps the timed run in ``jax.profiler.trace`` so the op-level XLA
+profile lands in a TensorBoard/xprof-readable directory.
+
+The timed run reuses the exact executable the warmup compiled (AOT
+``lower().compile()``), so a regression in any phase is attributable:
+compile_s regressions are kernel-graph growth, warmup_s regressions
+are allocator/transfer behavior, run_s regressions are the scan body
+itself.  ``steps_per_s`` at two group counts separates per-step
+overhead from per-group compute.  Everything stays on device until the
+final metric readout — the harness adds no per-step host syncs (that
+is the property it exists to police; see ``repeats``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Optional
+
+__all__ = ["run_profile"]
+
+
+def run_profile(algorithm: str = "paxos_pg", groups: int = 2048,
+                steps: int = 36, replicas: int = 5, slots: int = 64,
+                seed: int = 0, shard: int = 0, repeats: int = 3,
+                exchange: str = "dense",
+                trace_dir: str = "",
+                fuzz=None) -> dict:
+    """Run one bench-shaped simulation with per-phase wall timings.
+
+    ``shard`` > 0 builds the run on an N-device mesh
+    (parallel/mesh.make_sharded_run); ``repeats`` re-invokes the timed
+    executable and reports the best wall (steady state, no compile).
+    Returns the report dict (the CLI prints it as one JSON line)."""
+    import jax
+    import jax.random as jr
+
+    from paxi_tpu.protocols import sim_protocol
+    from paxi_tpu.sim import FuzzConfig, SimConfig, make_run
+
+    t0 = time.perf_counter()
+    proto = sim_protocol(algorithm)
+    cfg = SimConfig(n_replicas=replicas, n_slots=slots)
+    fuzz = fuzz or FuzzConfig()
+    # the fused exchange exists for lane-major kernels only; report
+    # what actually ran so dense-vs-pallas profile diffs can't lie
+    if not proto.batched:
+        exchange = "dense"
+    if shard:
+        from paxi_tpu.parallel import make_mesh, make_sharded_run
+        mesh = make_mesh(min(shard, len(jax.devices())))
+        run = make_sharded_run(proto, cfg, fuzz=fuzz, mesh=mesh,
+                               exchange=exchange)
+        n_dev = mesh.shape["i"]
+    else:
+        run = make_run(proto, cfg, fuzz=fuzz, exchange=exchange)
+        n_dev = 1
+    build_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    lowered = run.lower(jr.PRNGKey(seed), groups, steps)
+    lower_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(compiled(jr.PRNGKey(seed + 1)))
+    warmup_s = time.perf_counter() - t0
+
+    prof = (jax.profiler.trace(trace_dir) if trace_dir
+            else contextlib.nullcontext())
+    best = float("inf")
+    with prof:
+        for _ in range(max(repeats, 1)):
+            t0 = time.perf_counter()
+            _, metrics, viols = compiled(jr.PRNGKey(seed))
+            jax.block_until_ready(viols)
+            best = min(best, time.perf_counter() - t0)
+
+    committed = int(metrics.get("committed_slots", 0))
+    return {
+        "algorithm": algorithm,
+        "groups": groups,
+        "steps": steps,
+        "replicas": replicas,
+        "ring_slots": slots,
+        "mesh": n_dev if shard else 0,
+        "exchange": exchange,
+        "device": str(jax.devices()[0]),
+        "phases": {
+            "build_s": round(build_s, 4),
+            "lower_s": round(lower_s, 4),
+            "compile_s": round(compile_s, 4),
+            "warmup_s": round(warmup_s, 4),
+            "run_s": round(best, 4),
+        },
+        "steps_per_s": round(steps / best, 2),
+        "slots_per_s": round(committed / best, 1),
+        "committed_slots": committed,
+        "invariant_violations": int(viols),
+        "profile_dir": trace_dir or None,
+    }
+
+
+def main_json(**kw) -> int:
+    rep = run_profile(**kw)
+    print(json.dumps(rep))
+    return 0 if rep["invariant_violations"] == 0 else 1

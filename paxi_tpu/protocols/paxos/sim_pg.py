@@ -14,25 +14,37 @@ under a majority quorum, P3 commit broadcast, in-order execution
 
 TPU re-design (not a translation):
 - Per-replica state is a struct-of-arrays over a fixed **ring** of S
-  slots: ring position ``i`` holds absolute slot ``base + i``; the
-  window slides forward as the execute frontier advances, retaining the
-  last ``S//2`` executed slots for laggard healing (the reference's
-  unbounded ``log map[int]*entry`` becomes O(window), the SURVEY §7
-  slot-recycling requirement — 10M slots run in a 64-slot ring).
+  slots with a *fixed cell mapping*: absolute slot ``a`` always lives
+  in cell ``a % S``.  The window ``[base, base + S)`` slides forward as
+  the execute frontier advances, retaining the last ``S//2`` executed
+  slots for laggard healing (the reference's unbounded
+  ``log map[int]*entry`` becomes O(window) — 10M slots run in a
+  64-slot ring).  Because the mapping is position-invariant, sliding
+  the window is a masked *clear* of recycled cells — no data movement —
+  and any two replicas' cells line up without per-pair realignment
+  gathers: cell ``c`` refers to the same absolute slot at replicas
+  ``x`` and ``y`` exactly when that slot is inside both windows.  (An
+  earlier revision kept ring position 0 at ``base`` and paid 13
+  per-row shift gathers per step — ~40% of north-star bench wall time
+  on XLA:CPU, where gathers scalarize.)
 - All handlers run every step on every replica as fully *masked*
   updates (leader/follower divergence is `where`-selected).
 - Ballots are ``round * ballot_stride + replica_idx`` int32s
   (paxos ballot.go packs n<<16|id the same way).
-- ``Quorum.ACK`` becomes a boolean ack-matrix OR + popcount
-  (p1_acks (R,R); log_acks (R,S,R)) [driver].
+- ``Quorum.ACK`` becomes a **bit-packed int32 ack mask** with
+  ``lax.population_count`` for ``Majority()`` (quorum.go [driver]) —
+  ``p1_acks (R,)``, ``log_acks (R, S)``, bit ``src`` = ack from that
+  replica.  (Same packing as the lane-major kernel; the earlier
+  boolean ``(R, S, R)`` planes dominated the window-slide cost.)
 - Messages carry ABSOLUTE slot numbers; receivers mask them against
   their own window (out-of-window = silently ignored, like a TCP
   segment for a closed connection).
 - P1b log payloads are passed *by reference*: on winning phase-1 the
-  new leader merges the current logs of its ackers, base-aligned via a
-  per-(leader, acker) gather.  A laggard winner first adopts the most
-  advanced acker's (kv, execute, base) — the state-transfer/log-
-  compaction analog of the host runtime's P1b snapshot.
+  new leader merges the current logs of its ackers — with the fixed
+  cell mapping this is a pure elementwise masked reduction over the
+  ``(ldr, src, S)`` ack cube (no gathers).  A laggard winner first
+  adopts the most advanced acker's (kv, execute, base) — the state-
+  transfer/log-compaction analog of the host runtime's P1b snapshot.
 - P3 carries (slot, cmd) plus a commit frontier ``upto``: a follower
   commits any in-window slot < upto accepted at the leader's exact
   ballot.  A follower whose frontier fell below the leader's window
@@ -57,36 +69,32 @@ from paxi_tpu.ops.hashing import fib_key  # noqa: F401 (re-export parity)
 # to either must reach the parity test and the bench backend switch
 from paxi_tpu.protocols.paxos.sim import (NO_CMD, NOOP, cmd_key,
                                           encode_cmd, mailbox_spec)
+from paxi_tpu.sim.ring import require_packable
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 
-def _shift(arr, adv, fill):
-    """Slide rows of ``arr`` (R, S, ...) forward along the slot axis by
-    per-row ``adv`` >= 0: out[r, i] = arr[r, i + adv[r]] (or ``fill``
-    past the end).  The ring-recycling / base-alignment primitive."""
-    S = arr.shape[1]
-    idx = jnp.arange(S, dtype=jnp.int32)[None, :] + adv[:, None]
-    valid = (idx >= 0) & (idx < S)
-    idxc = jnp.clip(idx, 0, S - 1)
-    if arr.ndim == 2:
-        return jnp.where(valid, jnp.take_along_axis(arr, idxc, axis=1), fill)
-    return jnp.where(valid[:, :, None],
-                     jnp.take_along_axis(arr, idxc[:, :, None], axis=1),
-                     fill)
+def _cell_abs(base, S: int):
+    """The absolute slot cell ``c`` currently holds at each replica:
+    the unique element of ``[base_r, base_r + S)`` congruent to ``c``
+    (mod S).  Pure elementwise — the fixed-mapping replacement for the
+    old shift-to-ring-position bookkeeping."""
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    return base[:, None] + jnp.remainder(sidx[None, :] - base[:, None], S)
 
 
 def init_state(cfg: SimConfig, rng: jax.Array):
     R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
     del rng
+    require_packable(R)   # ack bitmasks: int32 shifts wrap at 32
     return dict(
         ballot=jnp.zeros((R,), jnp.int32),        # highest ballot seen/promised
         active=jnp.zeros((R,), bool),             # leader with phase-1 done
-        p1_acks=jnp.zeros((R, R), bool),          # [ldr, src] phase-1 acks
-        base=jnp.zeros((R,), jnp.int32),          # abs slot of ring pos 0
+        p1_acks=jnp.zeros((R,), jnp.int32),       # [ldr] phase-1 ack bitmask
+        base=jnp.zeros((R,), jnp.int32),          # window start (absolute)
         log_bal=jnp.zeros((R, S), jnp.int32),     # accepted ballot per slot
         log_cmd=jnp.full((R, S), NO_CMD, jnp.int32),
         log_commit=jnp.zeros((R, S), bool),
-        log_acks=jnp.zeros((R, S, R), bool),      # [ldr, slot, src] P2b acks
+        log_acks=jnp.zeros((R, S), jnp.int32),    # [ldr, slot] P2b ack bitmask
         proposed=jnp.zeros((R, S), bool),         # P2a sent under my ballot
         next_slot=jnp.zeros((R,), jnp.int32),     # absolute
         execute=jnp.zeros((R,), jnp.int32),       # absolute frontier
@@ -102,8 +110,10 @@ def step(state, inbox, ctx: StepCtx):
     R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
     MAJ, STRIDE = cfg.majority, cfg.ballot_stride
     RETAIN = max(S // 2, 1)
+    BIG = jnp.int32(2**30)
     ridx = jnp.arange(R, dtype=jnp.int32)
     sidx = jnp.arange(S, dtype=jnp.int32)
+    bit = jnp.int32(1) << ridx                    # ack bit per source
 
     ballot = state["ballot"]
     active = state["active"]
@@ -126,7 +136,7 @@ def step(state, inbox, ctx: StepCtx):
     promote = p1a_bal > ballot
     ballot = jnp.maximum(ballot, p1a_bal)
     active = active & ~promote
-    p1_acks = jnp.where(promote[:, None], False, p1_acks)  # my old round died
+    p1_acks = jnp.where(promote, 0, p1_acks)             # my old round died
     # P1b out (log payload by reference; see module docstring)
     p1b_valid = promote[:, None] & (ridx[None, :] == p1a_src[:, None])
     out_p1b = {"valid": p1b_valid,
@@ -137,9 +147,10 @@ def step(state, inbox, ctx: StepCtx):
     # ---------------- P1b: collect phase-1 acks -------------------------
     m = inbox["p1b"]
     ack = m["valid"].T & (m["bal"].T == ballot[:, None]) & own_bal[:, None]
-    p1_acks = p1_acks | ack                               # (ldr, src)
-    p1_win = own_bal & ~active & (jnp.sum(p1_acks, axis=1) >= MAJ)
-    amask = p1_acks                                       # includes self
+    p1_acks = p1_acks | jnp.sum(jnp.where(ack, bit[None, :], 0), axis=1)
+    p1_win = own_bal & ~active & \
+        (jax.lax.population_count(p1_acks) >= MAJ)
+    amask = (p1_acks[:, None] >> ridx[None, :]) & 1 != 0  # (ldr, src) w/ self
 
     # ---------------- phase-1 win: state transfer from best acker -------
     # A laggard winner's window may sit below its ackers' windows; adopt
@@ -152,45 +163,42 @@ def step(state, inbox, ctx: StepCtx):
     kv = jnp.where(el_ad[:, None], kv[f_src], kv)
     execute = jnp.where(el_ad, front, execute)
     next_slot = jnp.where(el_ad, jnp.maximum(next_slot, front), next_slot)
-    # never adopt a LOWER base: a negative self-shift would drop my own
-    # top-of-window entries (possibly committed via P3).  The merge below
+    # never adopt a LOWER base: dropping my own top-of-window entries
+    # (possibly committed via P3) is never safe.  The merge below
     # tolerates ackers whose base is below mine (front-fill only).
-    adv_el = jnp.where(el_ad, jnp.maximum(base[f_src] - base, 0), 0)
+    A_old = _cell_abs(base, S)
     base = jnp.where(el_ad, jnp.maximum(base[f_src], base), base)
-    log_bal = _shift(log_bal, adv_el, 0)
-    log_cmd = _shift(log_cmd, adv_el, NO_CMD)
-    log_commit = _shift(log_commit, adv_el, False)
-    proposed = _shift(proposed, adv_el, False)
-    log_acks = _shift(log_acks, adv_el, False)
+    # recycled cells (abs slot now below the adopted base) reset in
+    # place — the fixed mapping's no-copy equivalent of the old shift
+    drop = A_old < base[:, None]
+    log_bal = jnp.where(drop, 0, log_bal)
+    log_cmd = jnp.where(drop, NO_CMD, log_cmd)
+    log_commit = log_commit & ~drop
+    proposed = proposed & ~drop
+    log_acks = jnp.where(drop, 0, log_acks)
 
-    # ---------------- phase-1 win: merge ackers' logs (base-aligned) ----
-    # leader ring pos j <-> abs base[ldr]+j <-> acker ring pos j+off
-    off = base[:, None] - base[None, :]                   # (ldr, src)
-    idx3 = sidx[None, None, :] + off[:, :, None]          # (ldr, src, S)
-    valid3 = (idx3 >= 0) & (idx3 < S)
-    idx3c = jnp.clip(idx3, 0, S - 1)
-    lb_src = jnp.take_along_axis(
-        jnp.broadcast_to(log_bal[None], (R, R, S)), idx3c, axis=2)
-    lc_src = jnp.take_along_axis(
-        jnp.broadcast_to(log_cmd[None], (R, R, S)), idx3c, axis=2)
-    lm_src = jnp.take_along_axis(
-        jnp.broadcast_to(log_commit[None], (R, R, S)), idx3c, axis=2)
-    sel = amask[:, :, None] & valid3
-    lb = jnp.where(sel, lb_src, -1)
+    # ---------------- phase-1 win: merge ackers' logs -------------------
+    # Fixed cell mapping: leader cell c and acker cell c hold the SAME
+    # absolute slot exactly when the leader's slot A[l, c] is inside the
+    # acker's window — a pure mask, no base-alignment gather.
+    A = _cell_abs(base, S)
+    Al = A[:, None, :]                                    # (ldr, 1, S)
+    in_src = (Al >= base[None, :, None]) & (Al < base[None, :, None] + S)
+    sel = amask[:, :, None] & in_src                      # (ldr, src, S)
+    lb = jnp.where(sel, log_bal[None], -1)
     src_best = jnp.argmax(lb, axis=1)                     # (ldr, S)
     best_bal = jnp.max(lb, axis=1)
-    merged_cmd = jnp.take_along_axis(
-        lc_src, src_best[:, None, :], axis=1)[:, 0, :]
-    cmask = sel & lm_src
+    oh_best = ridx[None, :, None] == src_best[:, None, :]
+    merged_cmd = jnp.sum(jnp.where(oh_best, log_cmd[None], 0), axis=1)
+    cmask = sel & log_commit[None]
     merged_commit = jnp.any(cmask, axis=1)                # (ldr, S)
     csrc = jnp.argmax(cmask, axis=1)
-    committed_cmd = jnp.take_along_axis(
-        lc_src, csrc[:, None, :], axis=1)[:, 0, :]
-    abs_ = base[:, None] + sidx[None, :]                  # (R, S)
+    oh_csrc = ridx[None, :, None] == csrc[:, None, :]
+    committed_cmd = jnp.sum(jnp.where(oh_csrc, log_cmd[None], 0), axis=1)
     has_acc = (best_bal > 0) | merged_commit
-    top = jnp.max(jnp.where(has_acc, abs_ + 1, 0), axis=1)  # (ldr,) absolute
+    top = jnp.max(jnp.where(has_acc, A + 1, 0), axis=1)   # (ldr,) absolute
     new_next = jnp.maximum(next_slot, top)
-    in_win = abs_ < new_next[:, None]                     # slots to own
+    in_win = A < new_next[:, None]                        # slots to own
     w = p1_win[:, None]
     # committed slots adopt the committed value; accepted adopt merged;
     # holes below the frontier become NOOP re-proposals.
@@ -200,9 +208,7 @@ def step(state, inbox, ctx: StepCtx):
     log_bal = jnp.where(w & in_win, ballot[:, None], log_bal)
     log_commit = jnp.where(w & in_win, merged_commit | log_commit, log_commit)
     proposed = jnp.where(w, in_win & (merged_commit | log_commit), proposed)
-    self_only = (ridx[None, None, :] == ridx[:, None, None])  # (R,1->S,R)
-    log_acks = jnp.where(w[:, :, None],
-                         in_win[:, :, None] & self_only, log_acks)
+    log_acks = jnp.where(w, jnp.where(in_win, bit[:, None], 0), log_acks)
     next_slot = jnp.where(p1_win, new_next, next_slot)
     active = active | p1_win
 
@@ -218,10 +224,10 @@ def step(state, inbox, ctx: StepCtx):
     demote = acc_ok & (a_bal > ballot)                    # someone else leads
     ballot = jnp.where(acc_ok, a_bal, ballot)
     active = active & ~demote
-    p1_acks = jnp.where(demote[:, None], False, p1_acks)
-    a_rel = a_slot - base                                 # ring position
-    a_inw = (a_rel >= 0) & (a_rel < S)
-    oh = acc_ok[:, None] & (sidx[None, :] == a_rel[:, None])
+    p1_acks = jnp.where(demote, 0, p1_acks)
+    a_inw = (a_slot >= base) & (a_slot < base + S)
+    oh = (acc_ok & a_inw)[:, None] & \
+        (sidx[None, :] == jnp.remainder(a_slot, S)[:, None])
     writable = oh & (log_bal <= a_bal[:, None]) & ~log_commit
     log_bal = jnp.where(writable, a_bal[:, None], log_bal)
     log_cmd = jnp.where(writable, a_cmd[:, None], log_cmd)
@@ -240,10 +246,13 @@ def step(state, inbox, ctx: StepCtx):
     m = inbox["p2b"]
     okb = m["valid"].T & (m["bal"].T == ballot[:, None]) & \
         (active & own_bal)[:, None]                       # (ldr, src)
-    brel = m["slot"].T - base[:, None]                    # (ldr, src) ring
-    add = okb[:, :, None] & (sidx[None, None, :] == brel[:, :, None])
-    log_acks = log_acks | jnp.transpose(add, (0, 2, 1))   # (ldr, slot, src)
-    acks_n = jnp.sum(log_acks, axis=2)                    # (ldr, slot)
+    bslot = m["slot"].T                                   # (ldr, src) absolute
+    okb = okb & (bslot >= base[:, None]) & (bslot < base[:, None] + S)
+    oh3 = okb[:, :, None] & \
+        (sidx[None, None, :] == jnp.remainder(bslot, S)[:, :, None])
+    log_acks = log_acks | jnp.sum(
+        jnp.where(oh3, bit[None, :, None], 0), axis=1)    # (ldr, slot)
+    acks_n = jax.lax.population_count(log_acks)
     newly = ((active & own_bal)[:, None] & (acks_n >= MAJ)
              & ~log_commit & (log_cmd != NO_CMD) & proposed)
     log_commit = log_commit | newly
@@ -266,61 +275,62 @@ def step(state, inbox, ctx: StepCtx):
     promote3 = c_has & (c_bal > ballot)
     ballot = jnp.where(promote3, c_bal, ballot)
     active = active & ~promote3
-    p1_acks = jnp.where(promote3[:, None], False, p1_acks)
-    abs_ = base[:, None] + sidx[None, :]
-    c_rel = c_slot - base
-    oh = c_has[:, None] & (sidx[None, :] == c_rel[:, None])
+    p1_acks = jnp.where(promote3, 0, p1_acks)
+    c_inw = (c_slot >= base) & (c_slot < base + S)
+    oh = (c_has & c_inw)[:, None] & \
+        (sidx[None, :] == jnp.remainder(c_slot, S)[:, None])
     log_cmd = jnp.where(oh, c_cmd[:, None], log_cmd)
     log_bal = jnp.where(oh, jnp.maximum(log_bal, c_bal[:, None]), log_bal)
     log_commit = log_commit | oh
     # frontier commit: slots < upto accepted at the leader's exact ballot
-    ohu = (fresh3[:, None] & (abs_ < c_upto[:, None])
+    ohu = (fresh3[:, None] & (A < c_upto[:, None])
            & (log_bal == c_bal[:, None]) & (log_cmd != NO_CMD))
     log_commit = log_commit | ohu
 
     # ---------------- P3: snapshot catch-up for deep laggards -----------
     # My frontier fell below the sender's window base: the slots I still
     # need were recycled everywhere ahead of me.  Adopt the sender's
-    # (kv, execute, base) by reference and keep my own in-window commits.
+    # (kv, execute, base) by reference and keep my own in-window commits
+    # — under the fixed mapping the sender's cells are already aligned
+    # with mine, so the overlay is elementwise.
     src_base = base[c_src]
     adopt = c_has & (execute < src_base)
-    adv_a = jnp.where(adopt, src_base - base, 0)
-    my_bal = _shift(log_bal, adv_a, 0)
-    my_cmd = _shift(log_cmd, adv_a, NO_CMD)
-    my_com = _shift(log_commit, adv_a, False)
+    keep = A >= src_base[:, None]            # my cells still in the new window
+    my_bal = jnp.where(keep, log_bal, 0)
+    my_cmd = jnp.where(keep, log_cmd, NO_CMD)
+    my_com = keep & log_commit
     s_bal, s_cmd, s_com = log_bal[c_src], log_cmd[c_src], log_commit[c_src]
     a2 = adopt[:, None]
     log_bal = jnp.where(a2, jnp.where(s_com, s_bal, my_bal), log_bal)
     log_cmd = jnp.where(a2, jnp.where(s_com, s_cmd, my_cmd), log_cmd)
     log_commit = jnp.where(a2, s_com | my_com, log_commit)
     proposed = jnp.where(a2, False, proposed)
-    log_acks = jnp.where(adopt[:, None, None], False, log_acks)
+    log_acks = jnp.where(a2, 0, log_acks)
     kv = jnp.where(a2, kv[c_src], kv)
     execute = jnp.where(adopt, execute[c_src], execute)
     next_slot = jnp.where(adopt, jnp.maximum(next_slot, execute), next_slot)
     base = jnp.where(adopt, src_base, base)
-    abs_ = base[:, None] + sidx[None, :]
+    A = _cell_abs(base, S)
 
     # ---------------- leader proposes (new cmd or re-proposal) ----------
     is_leader = active & own_bal
-    mask_re = (~log_commit) & (~proposed) & (abs_ < next_slot[:, None])
-    first_re = jnp.argmin(jnp.where(mask_re, sidx[None, :], S), axis=1)
+    mask_re = (~log_commit) & (~proposed) & (A < next_slot[:, None])
+    re_abs = jnp.min(jnp.where(mask_re, A, BIG), axis=1)
     has_re = jnp.any(mask_re, axis=1)
     can_new = (next_slot - base) < S                      # window flow control
-    rel_next = jnp.clip(next_slot - base, 0, S - 1)
-    prop_rel = jnp.where(has_re, first_re, rel_next).astype(jnp.int32)
-    prop_slot = base + prop_rel                           # absolute
+    prop_slot = jnp.where(has_re, re_abs, next_slot)      # absolute
+    prop_cell = jnp.remainder(prop_slot, S)
     is_new = ~has_re & can_new
     new_cmd = encode_cmd(ballot, prop_slot)
-    re_cmd = jnp.take_along_axis(log_cmd, prop_rel[:, None], axis=1)[:, 0]
+    re_cmd = jnp.take_along_axis(log_cmd, prop_cell[:, None], axis=1)[:, 0]
     re_cmd = jnp.where(re_cmd == NO_CMD, NOOP, re_cmd)
     prop_cmd = jnp.where(is_new, new_cmd, re_cmd)
     do = is_leader & (has_re | can_new)
-    oh = do[:, None] & (sidx[None, :] == prop_rel[:, None])
+    oh = do[:, None] & (sidx[None, :] == prop_cell[:, None])
     log_bal = jnp.where(oh, ballot[:, None], log_bal)
     log_cmd = jnp.where(oh & ~log_commit, prop_cmd[:, None], log_cmd)
     proposed = proposed | oh
-    log_acks = log_acks | (oh[:, :, None] & self_only)
+    log_acks = log_acks | jnp.where(oh, bit[:, None], 0)  # self ack
     next_slot = next_slot + (is_new & do)
     out_p2a = {
         "valid": jnp.broadcast_to(do[:, None], (R, R)),
@@ -330,39 +340,40 @@ def step(state, inbox, ctx: StepCtx):
     }
 
     # ---------------- execute committed prefix, apply to KV -------------
-    advanced = jnp.zeros((R,), jnp.int32)
-    running = jnp.ones((R,), bool)
-    for e in range(cfg.exec_window):
-        rel = execute + e - base                          # ring position
-        inb = rel < S
-        idx = jnp.clip(rel, 0, S - 1)
-        com = jnp.take_along_axis(log_commit, idx[:, None], axis=1)[:, 0]
-        running = running & com & inb
-        cmd_e = jnp.take_along_axis(log_cmd, idx[:, None], axis=1)[:, 0]
-        key_e = cmd_key(cmd_e, K)
-        wr = running & (cmd_e >= 0)
-        ohk = wr[:, None] & (jnp.arange(K)[None, :] == key_e[:, None])
+    # one fused gather over the exec window, then masked KV writes
+    E = cfg.exec_window
+    absE = execute[:, None] + jnp.arange(E, dtype=jnp.int32)[None, :]
+    inbE = absE < base[:, None] + S                       # execute >= base
+    cellE = jnp.remainder(absE, S)
+    comE = jnp.take_along_axis(log_commit, cellE, axis=1) & inbE
+    cmdE = jnp.take_along_axis(log_cmd, cellE, axis=1)
+    running = jnp.cumprod(comE, axis=1).astype(bool)      # (R, E) prefix
+    advanced = jnp.sum(running, axis=1).astype(jnp.int32)
+    kidx = jnp.arange(K, dtype=jnp.int32)
+    for e in range(E):
+        cmd_e = cmdE[:, e]
+        wr = running[:, e] & (cmd_e >= 0)
+        ohk = wr[:, None] & (kidx[None, :] == cmd_key(cmd_e, K)[:, None])
         kv = jnp.where(ohk, cmd_e[:, None], kv)
-        advanced = advanced + running
     new_execute = execute + advanced
 
     # ---------------- P3 out: newly committed + frontier retransmit -----
-    low_new = jnp.argmin(jnp.where(newly, sidx[None, :], S), axis=1)
+    low_new = jnp.min(jnp.where(newly, A, BIG), axis=1)   # lowest abs slot
     any_new = jnp.any(newly, axis=1)
     # otherwise cycle retransmits through my in-window committed prefix
     # (laggards behind the window are healed by snapshot adoption)
     span = jnp.maximum(new_execute - base, 1)
     rr = ctx.t % span
-    p3_rel = jnp.where(any_new, low_new, rr).astype(jnp.int32)
-    p3_rel = jnp.clip(p3_rel, 0, S - 1)
+    p3_abs = jnp.where(any_new, low_new, base + rr)
+    p3_cell = jnp.remainder(p3_abs, S)
     p3_committed = jnp.take_along_axis(
-        log_commit, p3_rel[:, None], axis=1)[:, 0]
-    p3_cmd = jnp.take_along_axis(log_cmd, p3_rel[:, None], axis=1)[:, 0]
+        log_commit, p3_cell[:, None], axis=1)[:, 0]
+    p3_cmd = jnp.take_along_axis(log_cmd, p3_cell[:, None], axis=1)[:, 0]
     p3_do = is_leader & p3_committed
     out_p3 = {
         "valid": jnp.broadcast_to(p3_do[:, None], (R, R)),
         "bal": jnp.broadcast_to(ballot[:, None], (R, R)),
-        "slot": jnp.broadcast_to((base + p3_rel)[:, None], (R, R)),
+        "slot": jnp.broadcast_to(p3_abs[:, None], (R, R)),
         "cmd": jnp.broadcast_to(p3_cmd[:, None], (R, R)),
         "upto": jnp.broadcast_to(new_execute[:, None], (R, R)),
     }
@@ -371,8 +382,9 @@ def step(state, inbox, ctx: StepCtx):
     stalled = is_leader & (new_execute == execute) & (next_slot > new_execute)
     stuck = jnp.where(stalled, state["stuck"] + 1, 0)
     retry = stuck >= cfg.retry_timeout
-    rel_e = jnp.clip(new_execute - base, 0, S - 1)
-    ohr = retry[:, None] & (sidx[None, :] == rel_e[:, None])
+    # retry implies next_slot > new_execute, so the frontier is in-window
+    ohr = retry[:, None] & \
+        (sidx[None, :] == jnp.remainder(new_execute, S)[:, None])
     proposed = proposed & ~ohr
     stuck = jnp.where(retry, 0, stuck)
 
@@ -386,7 +398,7 @@ def step(state, inbox, ctx: StepCtx):
     fire = ~active & (timer <= 0)
     new_bal = (jnp.max(ballot) // STRIDE + 1) * STRIDE + ridx
     ballot = jnp.where(fire, new_bal, ballot)
-    p1_acks = jnp.where(fire[:, None], ridx[None, :] == ridx[:, None], p1_acks)
+    p1_acks = jnp.where(fire, bit, p1_acks)               # self-ack only
     timer = jnp.where(fire, cfg.election_timeout + jitter, timer)
     out_p1a = {
         "valid": jnp.broadcast_to(fire[:, None], (R, R)),
@@ -395,14 +407,15 @@ def step(state, inbox, ctx: StepCtx):
 
     # ---------------- slide the ring window (slot recycling) ------------
     # keep the last RETAIN executed slots resident for P3 retransmits;
-    # anything older is only reachable via snapshot adoption
+    # anything older is only reachable via snapshot adoption.  Fixed
+    # mapping: recycled cells are cleared in place, nothing moves.
     new_base = jnp.maximum(base, new_execute - RETAIN)
-    adv = new_base - base
-    log_bal = _shift(log_bal, adv, 0)
-    log_cmd = _shift(log_cmd, adv, NO_CMD)
-    log_commit = _shift(log_commit, adv, False)
-    proposed = _shift(proposed, adv, False)
-    log_acks = _shift(log_acks, adv, False)
+    drop = A < new_base[:, None]
+    log_bal = jnp.where(drop, 0, log_bal)
+    log_cmd = jnp.where(drop, NO_CMD, log_cmd)
+    log_commit = log_commit & ~drop
+    proposed = proposed & ~drop
+    log_acks = jnp.where(drop, 0, log_acks)
 
     new_state = dict(
         ballot=ballot, active=active, p1_acks=p1_acks, base=new_base,
@@ -428,7 +441,8 @@ def metrics(state, cfg: SimConfig):
 def invariants(old, new, cfg: SimConfig) -> jax.Array:
     """Per-step safety oracle (generalizes history.go's checker):
     1. Agreement: all committed commands for a slot are equal — checked
-       on the base-aligned common window across replicas.
+       on the common window across replicas (cells align under the
+       fixed mapping, so this is a masked elementwise compare).
     2. Stability: a committed (slot, cmd) never changes or un-commits
        while it remains in the window; slots recycled out must have
        been executed (execute >= base always).
@@ -436,32 +450,30 @@ def invariants(old, new, cfg: SimConfig) -> jax.Array:
     4. Executed prefix is committed (within the window)."""
     BIG = jnp.int32(2**30)
     S = cfg.n_slots
-    sidx = jnp.arange(S, dtype=jnp.int32)
     base, c, cmd = new["base"], new["log_commit"], new["log_cmd"]
+    A = _cell_abs(base, S)
 
-    # 1. agreement on the aligned window [max(base), max(base)+S)
-    align = jnp.max(base) - base
-    a_c = _shift(c, align, False)
-    a_cmd = _shift(cmd, align, NO_CMD)
-    mx = jnp.max(jnp.where(a_c, a_cmd, -BIG), axis=0)
-    mn = jnp.min(jnp.where(a_c, a_cmd, BIG), axis=0)
-    n_c = jnp.sum(a_c, axis=0)
+    # 1. agreement on the common window [max(base), max(base)+S): cell
+    # c refers to the same absolute slot at every replica whose window
+    # contains it (all in-window abs values are congruent mod S)
+    vis = c & (A >= jnp.max(base))
+    mx = jnp.max(jnp.where(vis, cmd, -BIG), axis=0)
+    mn = jnp.min(jnp.where(vis, cmd, BIG), axis=0)
+    n_c = jnp.sum(vis, axis=0)
     v_agree = jnp.sum((n_c >= 1) & (mx != mn))
 
-    # 2. stability: old commits still in-window must match; the window
-    # may only recycle executed slots (base <= execute)
-    adv = base - old["base"]
-    o_c = _shift(old["log_commit"], adv, False)
-    o_cmd = _shift(old["log_cmd"], adv, NO_CMD)
-    v_stable = jnp.sum(o_c & (~c | (cmd != o_cmd)))
+    # 2. stability: old commits still in-window live in the SAME cell
+    # (fixed mapping) and must match; the window may only recycle
+    # executed slots (base <= execute)
+    o_c = old["log_commit"] & (_cell_abs(old["base"], S) >= base[:, None])
+    v_stable = jnp.sum(o_c & (~c | (cmd != old["log_cmd"])))
     v_stable = v_stable + jnp.sum(new["execute"] < base)
 
     # 3. ballot monotonicity
     v_bal = jnp.sum(new["ballot"] < old["ballot"])
 
-    # 4. executed prefix committed (ring positions below the frontier)
-    abs_ = base[:, None] + sidx[None, :]
-    v_exec = jnp.sum((abs_ < new["execute"][:, None]) & ~c)
+    # 4. executed prefix committed (slots below the frontier)
+    v_exec = jnp.sum((A < new["execute"][:, None]) & ~c)
 
     return (v_agree + v_stable + v_bal + v_exec).astype(jnp.int32)
 

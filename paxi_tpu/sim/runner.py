@@ -74,7 +74,8 @@ def init_carry(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
 
 
 def _group_step(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
-                carry_g, t, sched_t=None, pin_on=None, record=False):
+                carry_g, t, sched_t=None, pin_on=None, record=False,
+                exchange: str = "dense"):
     """One lock-step round: deliver -> step -> refresh faults -> insert
     -> check invariants.  ONE implementation for both layouts — only the
     exchange module differs (lane-major vs per-group planes); the caller
@@ -92,7 +93,13 @@ def _group_step(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
       (lane-major) per-group violations, so capture can slice out the
       violating group's schedule.
     """
-    ops = lanes if proto.batched else mb
+    if exchange == "pallas" and proto.batched:
+        # the fused lane-major Pallas exchange (paxi_tpu/ops/exchange):
+        # same semantics, one kernel per message type instead of ~10
+        # XLA ops per field — interpret-mode on CPU, compiled on TPU
+        from paxi_tpu.ops import exchange as ops
+    else:
+        ops = lanes if proto.batched else mb
     state, wheel, fs, rng = carry_g
     rng, k_step, k_fault, k_ins = jr.split(rng, 4)
     inbox, wheel = ops.wheel_deliver(wheel)
@@ -174,12 +181,17 @@ def per_group_invariants(proto: SimProtocol, cfg: SimConfig, old, new):
     return jax.lax.map(one, jnp.arange(G))
 
 
-def make_scan_body(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig):
+def make_scan_body(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
+                   exchange: str = "dense"):
     """The per-step transition shared by make_run, the sharded runner
     (parallel/mesh.py) and the driver entry point.  Lane-major kernels
     (proto.batched) run the whole batch natively; per-group kernels are
-    vmapped over a leading group axis."""
-    step1 = functools.partial(_group_step, proto, cfg, fuzz)
+    vmapped over a leading group axis.  ``exchange`` selects the
+    message-exchange implementation for lane-major kernels: ``dense``
+    (sim/mailbox XLA ops) or ``pallas`` (the fused kernels in
+    paxi_tpu/ops/exchange — bench.py's ``--backend pallas``)."""
+    step1 = functools.partial(_group_step, proto, cfg, fuzz,
+                              exchange=exchange)
     if proto.batched:
         return step1
 
@@ -192,7 +204,7 @@ def make_scan_body(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig):
 
 
 def finish_run(proto: SimProtocol, cfg: SimConfig, carry, viols,
-               counts=None):
+               counts=None, group_mask=None):
     """Shared aggregation tail: per-group metrics summed over groups,
     plus the scan's per-step ``net_*`` counters summed over time and
     folded into the metrics dict.  One implementation for both the
@@ -201,21 +213,30 @@ def finish_run(proto: SimProtocol, cfg: SimConfig, carry, viols,
     cross-module contract (parallel/mesh.py calls it inside each
     shard).  Lane-major kernels aggregate internally; their final state
     is transposed back to the public group-major layout (one cheap
-    transpose per run, outside the hot loop)."""
+    transpose per run, outside the hot loop).
+
+    ``group_mask`` (per-group kernels only) excludes groups from the
+    metric sums — the sharded runner's inert-padding contract (a padded
+    batch reports only the real groups' totals)."""
     state = carry[0]
     net = ({k: jnp.sum(v) for k, v in counts.items()}
            if counts is not None else {})
     if proto.batched:
+        assert group_mask is None, "lane-major metrics aggregate in-kernel"
         metrics = {**proto.metrics(state, cfg), **net}
         state = jax.tree.map(lambda x: jnp.moveaxis(x, -1, 0), state)
         return state, metrics, jnp.sum(viols)
     per_group = jax.vmap(lambda s: proto.metrics(s, cfg))(state)
+    if group_mask is not None:
+        per_group = {k: jnp.where(group_mask, v, 0)
+                     for k, v in per_group.items()}
     metrics = {**{k: jnp.sum(v) for k, v in per_group.items()}, **net}
     return state, metrics, jnp.sum(viols)
 
 
 def make_run(proto: SimProtocol, cfg: SimConfig,
-             fuzz: FuzzConfig = FAULT_FREE, series: bool = False):
+             fuzz: FuzzConfig = FAULT_FREE, series: bool = False,
+             exchange: str = "dense"):
     """Build ``run(rng, n_groups, n_steps) -> SimResult`` (jitted).
 
     n_groups / n_steps are static; the whole simulation is one XLA
@@ -226,8 +247,11 @@ def make_run(proto: SimProtocol, cfg: SimConfig,
     BEFORE the time reduction, i.e. a counter time series at zero
     extra on-device cost (the reduction output is unchanged, so the
     default signature stays three-valued for every existing caller).
+
+    ``exchange="pallas"`` swaps the lane-major message exchange for
+    the fused Pallas kernels (see make_scan_body).
     """
-    body = make_scan_body(proto, cfg, fuzz)
+    body = make_scan_body(proto, cfg, fuzz, exchange=exchange)
 
     @functools.partial(jax.jit, static_argnums=(1, 2))
     def run(rng, n_groups: int, n_steps: int):
@@ -348,13 +372,19 @@ def continue_run(proto: SimProtocol, cfg: SimConfig, carry,
     reuses the compiled executable); resumed runs are bit-for-bit
     identical to uninterrupted ones.  Returns (SimResult, new_carry).
     Note the ``net_*`` counters are flow-per-segment (this call's
-    steps), unlike the state-derived protocol metrics."""
+    steps), unlike the state-derived protocol metrics.
+
+    The input carry's buffers are DONATED to the step — the multi-GB
+    100k-group state advances in place instead of being copied per
+    segment.  Don't reuse a carry after passing it here; resume from
+    the returned one (or a checkpoint)."""
     key = (id(proto), cfg, fuzz)
     run = _CONTINUE_CACHE.get(key)
     if run is None:
         body = make_scan_body(proto, cfg, fuzz)
 
-        @functools.partial(jax.jit, static_argnums=(2,))
+        @functools.partial(jax.jit, static_argnums=(2,),
+                           donate_argnums=(0,))
         def run(carry, t0, n_steps: int):
             carry, (viols, counts) = jax.lax.scan(body, carry,
                                                   t0 + jnp.arange(n_steps))

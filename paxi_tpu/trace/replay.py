@@ -80,28 +80,42 @@ def resolve_protocol(name: str) -> SimProtocol:
 _PIN_CACHE: dict = {}
 
 
-def _pinned_run(proto: SimProtocol, trace: Trace):
+def _pinned_run(proto: SimProtocol, trace: Trace, mesh=None):
     # id(proto) in the key (like runner._CONTINUE_CACHE): an explicitly
     # passed protocol object must never be shadowed by a same-named
     # cached compile — registry singletons still hit
+    # Mesh hashes by (devices, axis_names), so two make_mesh(8) calls
+    # share one compiled run — id(mesh) would recompile per Mesh object
     key = (id(proto), trace.sim_config(), trace.fuzz_config(),
-           trace.group)
+           trace.group, mesh)
     run = _PIN_CACHE.get(key)
     if run is None:
-        run = make_pinned_run(proto, trace.sim_config(),
-                              trace.fuzz_config(), trace.group)
+        if mesh is not None:
+            from paxi_tpu.parallel.mesh import make_sharded_pinned_run
+            run = make_sharded_pinned_run(proto, trace.sim_config(),
+                                          trace.fuzz_config(),
+                                          trace.group, mesh=mesh)
+        else:
+            run = make_pinned_run(proto, trace.sim_config(),
+                                  trace.fuzz_config(), trace.group)
         _PIN_CACHE[key] = run
     return run
 
 
 def replay(trace: Trace, proto: Optional[SimProtocol] = None,
-           sched=None) -> ReplayResult:
+           sched=None, mesh=None) -> ReplayResult:
     """Replay ``trace`` (or an edited ``sched`` override against the
-    trace's provenance) and report the traced group's violations."""
+    trace's provenance) and report the traced group's violations.
+
+    ``mesh`` shards the replay batch over a device mesh
+    (``parallel/mesh.make_sharded_pinned_run``) — per-group kernels
+    reproduce the same state hash and counters as the single-device
+    replay, so violations found at 100k-group scale round-trip without
+    leaving the mesh."""
     proto = proto or resolve_protocol(trace.protocol)
     sched = trace.sched if sched is None else sched
     sched = jax.tree.map(jnp.asarray, sched)
-    run = _pinned_run(proto, trace)
+    run = _pinned_run(proto, trace, mesh=mesh)
     state, metrics, total, viols = run(
         jr.PRNGKey(trace.seed), trace.n_groups, sched)
     jax.block_until_ready(total)

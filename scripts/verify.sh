@@ -16,13 +16,45 @@
 #                                # (paxos + abd + the fragile_counter
 #                                # positive control) that must end with
 #                                # zero UNCLASSIFIED outcomes
+#   scripts/verify.sh --bench    # prepend the bench smoke stage: a
+#                                # tiny-shape CPU-mesh bench.py run
+#                                # (seconds) whose artifact line must
+#                                # carry the full schema with
+#                                # committed > 0 and violations == 0
 # Stage flags stack: `verify.sh --lint --metrics --hunt` runs all.
 set -o pipefail
 cd "$(dirname "$0")/.."
 
 while [ "${1:-}" = "--lint" ] || [ "${1:-}" = "--metrics" ] \
-    || [ "${1:-}" = "--hunt" ]; do
-  if [ "$1" = "--hunt" ]; then
+    || [ "${1:-}" = "--hunt" ] || [ "${1:-}" = "--bench" ]; do
+  if [ "$1" = "--bench" ]; then
+    shift
+    echo "== bench smoke (tiny-shape mesh bench.py) =="
+    # the north-star bench's mesh path end-to-end at a toy shape:
+    # validates the artifact schema and the committed/violations
+    # contract without spending bench-scale minutes
+    # -u XLA_FLAGS: a caller-exported device-count flag would make the
+    # worker skip its own 8-device injection and fail the mesh assert
+    BENCH_LINE=$(timeout -k 10 240 env -u PALLAS_AXON_POOL_IPS \
+      -u XLA_FLAGS \
+      BENCH_FORCE_CPU=1 BENCH_MESH=8 BENCH_CPU_GROUPS=256 \
+      BENCH_CPU_SLOTS=8192 BENCH_SCALING=0 \
+      python bench.py) || exit $?
+    BENCH_LINE="$BENCH_LINE" python - <<'PYEOF' || exit $?
+import json, os
+r = json.loads(os.environ["BENCH_LINE"])
+required = ("metric", "value", "unit", "committed_slots", "wall_s",
+            "compile_s", "warmup_s", "invariant_violations", "groups",
+            "steps", "kernel", "mesh", "device")
+missing = [k for k in required if k not in r]
+assert not missing, f"bench artifact missing keys: {missing}"
+assert r["committed_slots"] > 0, r
+assert r["invariant_violations"] == 0, r
+assert r["mesh"] == 8, r
+print(f"bench smoke OK: {r['committed_slots']} slots in "
+      f"{r['wall_s']}s on mesh={r['mesh']}")
+PYEOF
+  elif [ "$1" = "--hunt" ]; then
     shift
     echo "== hunt micro-campaign (paxi_tpu/hunt/) =="
     # fresh campaign dir each time: the smoke checks the whole loop

@@ -4,7 +4,8 @@ import jax
 import jax.random as jr
 import pytest
 
-from paxi_tpu.parallel import make_mesh, make_sharded_run
+from paxi_tpu.parallel import (make_mesh, make_sharded_pinned_run,
+                               make_sharded_run)
 from paxi_tpu.protocols import sim_protocol
 from paxi_tpu.sim import FuzzConfig, SimConfig, simulate
 
@@ -52,9 +53,81 @@ def test_sharded_fuzzed_safety():
     assert int(metrics["committed_slots"]) > 0
 
 
-def test_indivisible_groups_raises():
+def test_pg_sharded_is_bit_identical_to_single_device():
+    """Per-group kernels init the full carry outside the shard_map with
+    the single-device PRNG layout, so a sharded fuzzed run must equal
+    the unsharded one EXACTLY — metrics, net_* counters, violations."""
+    proto = sim_protocol("paxos_pg")
+    cfg = SimConfig(n_replicas=5, n_slots=32)
+    fuzz = FuzzConfig(p_drop=0.1, max_delay=2)
+    run = make_sharded_run(proto, cfg, fuzz=fuzz, mesh=make_mesh(8))
+    _, m8, v8 = run(jr.PRNGKey(4), 8, 40)
+    res1 = simulate(proto, cfg, 8, 40, fuzz=fuzz, seed=4)
+    assert int(v8) == int(res1.violations)
+    for k in res1.metrics:
+        assert int(m8[k]) == int(res1.metrics[k]), k
+
+
+def test_lane_major_sharded_exact_metrics_fault_free():
+    """Lane-major kernels shard with per-shard key streams, but a
+    fault-free run is PRNG-independent after the step-0 election —
+    sharded totals must equal the single-device run exactly."""
     proto = sim_protocol("paxos")
-    cfg = SimConfig()
+    cfg = SimConfig(n_replicas=3, n_slots=32)
     run = make_sharded_run(proto, cfg, mesh=make_mesh(8))
-    with pytest.raises(ValueError, match="divisible"):
-        run(jr.PRNGKey(0), 12, 10)
+    _, m8, v8 = run(jr.PRNGKey(0), 8, 30)
+    res1 = simulate(proto, cfg, 8, 30, seed=0)
+    assert int(v8) == int(res1.violations) == 0
+    for k in res1.metrics:
+        assert int(m8[k]) == int(res1.metrics[k]), k
+
+
+def test_indivisible_groups_pad_and_subtract():
+    """12 groups shard over 8 devices via inert padding; the pad
+    groups' contribution is excluded from the psum'd metrics, and —
+    because the real groups' carry is initialized at the REAL count
+    with the pads keyed independently — a FUZZED padded run stays
+    bit-identical to the unsharded 12-group run (`jr.split(k, 16)[:12]
+    != jr.split(k, 12)`, so naive pad-then-split would not)."""
+    proto = sim_protocol("paxos_pg")
+    cfg = SimConfig(n_replicas=3, n_slots=64)
+    fuzz = FuzzConfig(p_drop=0.1, max_delay=2)
+    run = make_sharded_run(proto, cfg, fuzz=fuzz, mesh=make_mesh(8))
+    state, m8, v8 = run(jr.PRNGKey(1), 12, 30)
+    assert state["execute"].shape[0] == 12       # trimmed back
+    res1 = simulate(proto, cfg, 12, 30, fuzz=fuzz, seed=1)
+    assert int(v8) == int(res1.violations) == 0
+    for k in res1.metrics:
+        assert int(m8[k]) == int(res1.metrics[k]), k
+    # the pads commit too; their slots must NOT inflate the total
+    assert int(m8["committed_slots"]) == \
+        int(res1.metrics["committed_slots"])
+    assert int(m8["has_leader"]) == 12
+
+
+def test_sharded_pinned_replay_reproduces_capture():
+    """The carried-forward ROADMAP item: a captured trace replays
+    inside a sharded batch with the state-hash + counter check intact
+    (the prerequisite for trusting sharded bench numbers)."""
+    from paxi_tpu import trace as tr
+    from paxi_tpu.trace.capture import capture
+
+    proto = sim_protocol("paxos_pg")
+    cfg = SimConfig(n_replicas=3, n_slots=32)
+    fuzz = FuzzConfig(p_drop=0.15, max_delay=2)
+    t = capture(proto, cfg, fuzz, seed=9, n_groups=8, n_steps=30,
+                group=3)
+    single = tr.replay(t)
+    sharded = tr.replay(t, mesh=make_mesh(8))
+    assert sharded.state_hash == single.state_hash \
+        == t.meta["capture_state_hash"]
+    assert sharded.counters == single.counters \
+        == t.meta["capture_counters"]
+    assert sharded.violations == single.violations
+
+
+def test_sharded_pinned_replay_rejects_lane_major():
+    proto = sim_protocol("paxos")
+    with pytest.raises(NotImplementedError, match="lane-major"):
+        make_sharded_pinned_run(proto, SimConfig(), FuzzConfig(), 0,
+                                mesh=make_mesh(8))

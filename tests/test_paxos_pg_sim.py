@@ -44,7 +44,11 @@ def test_metric_parity_with_lane_major():
 
 @pytest.mark.parametrize("fuzz", [
     FuzzConfig(p_drop=0.2, max_delay=3),
-    FuzzConfig(p_partition=0.3, p_crash=0.2, max_delay=2, window=12),
+    pytest.param(
+        FuzzConfig(p_partition=0.3, p_crash=0.2, max_delay=2, window=12),
+        marks=pytest.mark.slow),   # tier-1 budget: one fuzzed-safety
+    # compile per kernel is enough there; the partition/crash variant
+    # (a second full jit) runs in the slow tier
 ])
 def test_fuzzed_safety(fuzz):
     res, _ = run(groups=8, steps=120, fuzz=fuzz, seed=11)
