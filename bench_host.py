@@ -41,7 +41,12 @@ CONFIGS = [
 async def bench_one(name: str, n: int, zones: int, lin: bool) -> dict:
     cfg = local_config(n, zones=zones)
     secs = int(os.environ.get("BENCH_HOST_T", "4"))
+    # warmup window excluded from the reported ops/s (PR 6's
+    # compile_s/warmup_s split, host flavor): dial-up + leader election
+    # don't dilute steady state
+    warm = float(os.environ.get("BENCH_HOST_WARMUP", "1.0"))
     cfg.benchmark = Bconfig(T=secs, K=8, W=0.5, concurrency=4,
+                            warmup=min(warm, secs / 2),
                             linearizability_check=lin)
     c = Cluster(name, cfg=cfg, http=True)
     await c.start()
@@ -52,12 +57,17 @@ async def bench_one(name: str, n: int, zones: int, lin: bool) -> dict:
         dt = time.perf_counter() - t0
         return {
             "metric": f"{name}_host_ops_per_sec",
-            "value": round(stats.ops / max(stats.duration, 1e-9), 1),
+            # steady-state: completions inside the warmup window are
+            # excluded from numerator AND denominator
+            "value": round(stats.ops / max(stats.duration - stats.warmup_s,
+                                           1e-9), 1),
             "unit": "ops/s",
             "protocol": name,
             "replicas": n,
             "zones": zones,
             "ops": stats.ops,
+            "warmup_s": stats.warmup_s,
+            "warmup_ops": stats.warmup_ops,
             "errors": stats.errors,
             "anomalies": (stats.anomalies if lin else None),
             "consistency": ("linearizable" if lin else "eventual"),

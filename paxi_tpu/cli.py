@@ -75,6 +75,254 @@ def cmd_client(args) -> int:
     return 0
 
 
+def cmd_bench_host(args) -> int:
+    """Host-serving benchmark (one protocol through the full stack).
+
+    Default: the closed-loop generator (bench_host.py semantics, one
+    protocol).  ``--open-loop``: Poisson arrivals over pipelined
+    connections ramped across ``-rates``, reporting the saturation
+    curve (offered vs achieved vs latency) + a linearizability verdict
+    over the whole run; ``-out`` writes the artifact
+    (BENCH_HOST_SATURATION.json).  ``--cluster-proc`` runs the cluster
+    in a subprocess so the load generator and the replicas don't share
+    one interpreter/GIL — the honest single-node measurement on a
+    multi-core box.
+    """
+    import os
+    import socket as pysocket
+    import subprocess
+    import tempfile
+    import time as _time
+
+    from paxi_tpu.core.config import local_config
+    from paxi_tpu.host.transport import parse_addr
+
+    cfg = _load_config(args)
+    if not args.config:
+        cfg = local_config(args.n, zones=args.zones,
+                           base_port=args.base_port)
+    cfg.batch_size = args.batch_size
+    cfg.batch_wait = args.batch_wait
+    cfg.leader_reads = args.leader_reads
+    rates = [float(r) for r in args.rates.split(",") if r]
+
+    async def run_open_loop(target_cfg, worker_rates=None):
+        from paxi_tpu.host.benchmark import OpenLoopBenchmark
+        bench = OpenLoopBenchmark(
+            target_cfg, rates=worker_rates or rates, step_s=args.step_s,
+            seed=args.seed, conns=args.conns, W=args.W, K=args.K,
+            key_base=args.key_base, client_tag=args.client_tag,
+            ops_per_req=args.ops_per_req,
+            max_inflight=args.max_inflight,
+            linearizability_check=not args.no_lin)
+        return await bench.run()
+
+    if args.attach:
+        # generator-worker mode: drive an ALREADY-RUNNING cluster over
+        # the config's http addrs and print the raw report (the parent
+        # merges workers' counts, histograms and verdicts)
+        out = asyncio.run(run_open_loop(cfg))
+        print(json.dumps(out))
+        return 0 if (out.get("anomalies") or 0) == 0 \
+            and out["total_completed"] > 0 else 1
+
+    async def scrape_metrics(target_cfg):
+        """Leader metrics snapshot over the same REST surface
+        (GET /metrics?format=json) — batch/socket counters for the
+        artifact without reaching into another process."""
+        from paxi_tpu.host.client import _Conn
+        conn = _Conn(target_cfg.http_addrs[target_cfg.ids[0]])
+        try:
+            status, _, payload = await conn.request(
+                "GET", "/metrics?format=json", {}, b"")
+            return json.loads(payload.decode()) if status == 200 else {}
+        except (IOError, OSError):
+            return {}
+        finally:
+            conn.close()
+
+    def wait_http(url, timeout_s=20.0):
+        _, host, port = parse_addr(url)
+        t0 = _time.time()
+        while _time.time() - t0 < timeout_s:
+            try:
+                pysocket.create_connection((host, port), 0.5).close()
+                return True
+            except OSError:
+                _time.sleep(0.1)
+        return False
+
+    report = {"protocol": args.algorithm, "replicas": cfg.n,
+              "zones": len(cfg.zones()),
+              "batch_size": cfg.batch_size,
+              "batch_wait": cfg.batch_wait,
+              "leader_reads": cfg.leader_reads,
+              "ops_per_req": args.ops_per_req,
+              "cluster_proc": bool(args.cluster_proc
+                                   or args.gen_procs > 1)}
+
+    if args.cluster_proc or args.gen_procs > 1:
+        # the cluster lives in its own interpreter: chan peers inside
+        # that process, real TCP HTTP towards this one
+        cfg.addrs = {i: f"chan://benchhost/{i}" for i in cfg.addrs}
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".json", delete=False) as f:
+            cfg_path = f.name
+        cfg.to_json(cfg_path)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "paxi_tpu", "server", "-simulation",
+             "-algorithm", args.algorithm, "-config", cfg_path],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        try:
+            if not wait_http(cfg.http_addrs[cfg.ids[0]]):
+                print("bench-host: cluster subprocess never came up",
+                      file=sys.stderr)
+                return 2
+            if args.open_loop and args.gen_procs > 1:
+                out = _parallel_workers(args, cfg_path, rates)
+                out["cluster_metrics"] = asyncio.run(scrape_metrics(cfg))
+            elif args.open_loop:
+                out = asyncio.run(run_open_loop(cfg))
+                out["cluster_metrics"] = asyncio.run(scrape_metrics(cfg))
+            else:
+                out = asyncio.run(_closed_loop(args, cfg))
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()     # wedged (e.g. mid-compile): escalate
+                proc.wait(timeout=10)
+            try:
+                os.unlink(cfg_path)
+            except OSError:
+                pass
+        report.update(out)
+    else:
+        async def inproc():
+            from paxi_tpu.host.simulation import Cluster
+            cfg.addrs = {i: f"chan://benchhost/{i}" for i in cfg.addrs}
+            c = Cluster(args.algorithm, cfg=cfg, http=True)
+            await c.start()
+            try:
+                if args.open_loop:
+                    out = await run_open_loop(cfg)
+                else:
+                    out = await _closed_loop(args, cfg)
+                from paxi_tpu.metrics import merge_snapshots
+                out["cluster_metrics"] = merge_snapshots(
+                    r.metrics.snapshot() for r in c.replicas.values())
+                return out
+            finally:
+                await c.stop()
+        report.update(asyncio.run(inproc()))
+
+    print(json.dumps({k: v for k, v in report.items()
+                      if k != "cluster_metrics"}))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    anomalies = report.get("anomalies")
+    completed = report.get("total_completed", report.get("ops", 0))
+    return 1 if (anomalies or 0) > 0 or completed == 0 else 0
+
+
+def _parallel_workers(args, cfg_path: str, rates) -> dict:
+    """Fan the offered load over ``-gen_procs`` generator subprocesses
+    (each rate split evenly; disjoint key ranges + client tags) and
+    merge their reports: counts add, per-rate latency histograms
+    bucket-merge exactly, per-key-slice linearizability verdicts add."""
+    import os
+    import subprocess
+
+    from paxi_tpu.metrics import Histogram
+
+    n = args.gen_procs
+    worker_rates = [r / n for r in rates]
+    procs = []
+    for w in range(n):
+        cmd = [sys.executable, "-m", "paxi_tpu", "bench-host",
+               "--open-loop", "--attach", "-config", cfg_path,
+               "-rates", ",".join(str(r) for r in worker_rates),
+               "-step_s", str(args.step_s), "-conns", str(args.conns),
+               "-W", str(args.W), "-K", str(args.K),
+               "-seed", str(args.seed + 1000 * w),
+               "-key_base", str(w * args.K),
+               "-ops_per_req", str(args.ops_per_req),
+               "-max_inflight", str(args.max_inflight),
+               "-client_tag", f"w{w}c"]
+        if args.no_lin:
+            cmd.append("--no-lin")
+        procs.append(subprocess.Popen(
+            cmd, stdout=subprocess.PIPE,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"}))
+    reports = []
+    for w, p in enumerate(procs):
+        stdout, _ = p.communicate(timeout=600)
+        lines = stdout.decode().splitlines()
+        if p.returncode != 0 or not lines:
+            for q in procs:          # don't leave siblings running
+                if q.poll() is None:
+                    q.kill()
+            raise RuntimeError(
+                f"bench-host generator worker {w} failed "
+                f"(rc={p.returncode}, {len(lines)} output lines) — "
+                f"its stderr was inherited, see above")
+        reports.append(json.loads(lines[-1]))
+
+    steps = []
+    for i, rate in enumerate(rates):
+        merged = {"offered_ops_s": rate, "duration_s": args.step_s}
+        for k in ("submitted", "completed", "errors", "shed",
+                  "unfinished"):
+            merged[k] = sum(r["steps"][i][k] for r in reports)
+        merged["achieved_ops_s"] = round(
+            sum(r["steps"][i]["achieved_ops_s"] for r in reports), 1)
+        h = Histogram()
+        for r in reports:
+            for hs in r["metrics"]["histograms"]:
+                if hs["labels"].get("rate") == str(worker_rates[i]):
+                    h.merge(Histogram.from_snapshot(hs))
+        merged["latency_ms"] = {
+            "mean": round(h.mean() * 1e3, 3),
+            "p50": round(h.percentile(50) * 1e3, 3),
+            "p95": round(h.percentile(95) * 1e3, 3),
+            "p99": round(h.percentile(99) * 1e3, 3),
+            "max": round(h.max * 1e3, 3),
+        }
+        steps.append(merged)
+    achieved = [s["achieved_ops_s"] for s in steps]
+    peak = max(range(len(steps)), key=lambda i: achieved[i])
+    anomalies = None if args.no_lin else sum(
+        r["anomalies"] or 0 for r in reports)
+    return {
+        "mode": "open-loop",
+        "gen_procs": n,
+        "conns_per_gen": args.conns,
+        "W": args.W, "K": args.K,
+        "steps": steps,
+        "peak_ops_s": achieved[peak],
+        "peak_offered_ops_s": steps[peak]["offered_ops_s"],
+        "total_completed": sum(s["completed"] for s in steps),
+        "total_errors": sum(s["errors"] for s in steps),
+        "total_shed": sum(s["shed"] for s in steps),
+        "anomalies": anomalies,
+        "history_ops": sum(r["history_ops"] for r in reports),
+    }
+
+
+async def _closed_loop(args, cfg) -> dict:
+    from paxi_tpu.core.config import Bconfig
+    from paxi_tpu.host.benchmark import Benchmark
+    cfg.benchmark = Bconfig(T=args.T, K=args.K, W=args.W,
+                            concurrency=args.concurrency,
+                            warmup=args.warmup,
+                            linearizability_check=not args.no_lin)
+    bench = Benchmark(cfg, cfg.benchmark, seed=args.seed)
+    stats = await bench.run()
+    return dict(stats.summary(), mode="closed-loop")
+
+
 def cmd_repl(args) -> int:
     """Interactive admin REPL (bin/cmd): get/put/crash/drop/slow/flaky."""
     cfg = _load_config(args)
@@ -446,6 +694,81 @@ def main(argv=None) -> int:
     c.add_argument("-seed", type=int, default=0)
     c.add_argument("-history_file", "--history-file", default="")
     c.set_defaults(fn=cmd_client)
+
+    bh = sub.add_parser(
+        "bench-host",
+        help="host-serving benchmark: closed-loop or --open-loop "
+             "saturation ramp (BENCH_HOST_SATURATION.json)")
+    common(bh)
+    bh.add_argument("-algorithm", "--algorithm", default="paxos")
+    bh.add_argument("-open_loop", "--open-loop", dest="open_loop",
+                    action="store_true",
+                    help="Poisson arrivals over pipelined connections, "
+                         "ramped across -rates")
+    bh.add_argument("-cluster_proc", "--cluster-proc",
+                    dest="cluster_proc", action="store_true",
+                    help="run the cluster in a subprocess (load "
+                         "generator and replicas stop sharing a GIL)")
+    bh.add_argument("-rates", "--rates",
+                    default="1000,2000,5000,10000,20000,40000,60000",
+                    help="comma-separated offered-load ramp (ops/s)")
+    bh.add_argument("-step_s", "--step-s", dest="step_s", type=float,
+                    default=3.0, help="seconds per rate step")
+    bh.add_argument("-conns", "--conns", type=int, default=4,
+                    help="pipelined connections (open loop)")
+    bh.add_argument("-max_inflight", "--max-inflight",
+                    dest="max_inflight", type=int, default=4096,
+                    help="open-loop in-flight command cap (beyond it "
+                         "arrivals shed, counted)")
+    bh.add_argument("-ops_per_req", "--ops-per-req", dest="ops_per_req",
+                    type=int, default=1,
+                    help="client-side command batching: KV commands "
+                         "per HTTP request over the Transaction "
+                         "surface (1 = plain per-op REST)")
+    bh.add_argument("-T", type=int, default=4,
+                    help="closed-loop run seconds")
+    bh.add_argument("-concurrency", type=int, default=4)
+    bh.add_argument("-warmup", "--warmup", type=float, default=1.0,
+                    help="closed-loop warmup window (excluded from "
+                         "steady-state ops/s)")
+    bh.add_argument("-W", type=float, default=0.5,
+                    help="write fraction")
+    bh.add_argument("-K", type=int, default=1024,
+                    help="key-space size")
+    bh.add_argument("-seed", type=int, default=0)
+    bh.add_argument("-no_lin", "--no-lin", dest="no_lin",
+                    action="store_true",
+                    help="skip the linearizability history/check")
+    bh.add_argument("-batch_size", "--batch-size", dest="batch_size",
+                    type=int, default=64,
+                    help="commit-path batch ceiling (cfg.batch_size)")
+    bh.add_argument("-batch_wait", "--batch-wait", dest="batch_wait",
+                    type=float, default=0.0,
+                    help="batch flush-timer ceiling in seconds "
+                         "(0 = next event-loop tick)")
+    bh.add_argument("-leader_reads", "--leader-reads",
+                    dest="leader_reads", action="store_true",
+                    help="serve reads at the leader's execute barrier "
+                         "instead of log slots (read-index mode; the "
+                         "linearizability checker still gates the run)")
+    bh.add_argument("-base_port", "--base-port", dest="base_port",
+                    type=int, default=1735)
+    bh.add_argument("-out", "--out", default="",
+                    help="write the full artifact (with cluster "
+                         "metrics) to this JSON file")
+    bh.add_argument("-gen_procs", "--gen-procs", dest="gen_procs",
+                    type=int, default=1,
+                    help="parallel generator subprocesses (load and "
+                         "key space split evenly; implies "
+                         "--cluster-proc)")
+    bh.add_argument("-attach", "--attach", action="store_true",
+                    help="generator-worker mode: drive an already-"
+                         "running cluster (used by -gen-procs)")
+    bh.add_argument("-key_base", "--key-base", dest="key_base",
+                    type=int, default=0, help="key-range offset")
+    bh.add_argument("-client_tag", "--client-tag", dest="client_tag",
+                    default="ol", help="client-id prefix")
+    bh.set_defaults(fn=cmd_bench_host)
 
     r = sub.add_parser("cmd", help="admin REPL")
     common(r)

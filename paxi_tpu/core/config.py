@@ -40,6 +40,11 @@ class Bconfig:
     zipfian_v: float = 1.0      # zipf value shift
     throttle: int = 0           # ops/sec limit (0 = unlimited)
     linearizability_check: bool = True
+    # completions inside the first ``warmup`` seconds are reported
+    # separately (dial-up, leader election, batch ramp) so
+    # throughput_ops_s is steady-state — the host analog of bench.py's
+    # compile_s/warmup_s split (0 = no split, every op counts)
+    warmup: float = 0.0
 
     @staticmethod
     def from_dict(d: dict) -> "Bconfig":
@@ -73,6 +78,17 @@ class Config:
     buffer_size: int = 1024       # socket buffer (BufferSize)
     chan_buffer_size: int = 1024  # in-process chan buffer (ChanBufferSize)
     multi_version: bool = False   # per-key value history in Database
+    # commit-path batching (host/batch.py): commands per slot ceiling,
+    # and the flush-timer ceiling in seconds (0 = flush on the next
+    # event-loop tick — near-zero added latency, bursts still batch)
+    batch_size: int = 64
+    batch_wait: float = 0.0
+    # leader-local reads (read-index style): reads order at the
+    # leader's execute barrier instead of occupying log slots — halves
+    # replication work at mixed workloads.  Sound under a single
+    # stable leader (the lease assumption); off by default, and the
+    # benchmark's linearizability checker gates every run that uses it.
+    leader_reads: bool = False
     benchmark: Bconfig = field(default_factory=Bconfig)
 
     # ---- derived topology helpers -------------------------------------
@@ -114,6 +130,9 @@ class Config:
         cfg.buffer_size = lower.get("buffersize", lower.get("buffer_size", cfg.buffer_size))
         cfg.chan_buffer_size = lower.get("chanbuffersize", lower.get("chan_buffer_size", cfg.chan_buffer_size))
         cfg.multi_version = lower.get("multiversion", lower.get("multi_version", cfg.multi_version))
+        cfg.batch_size = lower.get("batchsize", lower.get("batch_size", cfg.batch_size))
+        cfg.batch_wait = lower.get("batchwait", lower.get("batch_wait", cfg.batch_wait))
+        cfg.leader_reads = lower.get("leaderreads", lower.get("leader_reads", cfg.leader_reads))
         if "benchmark" in lower:
             cfg.benchmark = Bconfig.from_dict(lower["benchmark"])
         return cfg

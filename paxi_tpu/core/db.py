@@ -12,7 +12,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
-from paxi_tpu.core.command import Command, Key, Value
+from paxi_tpu.core.command import (TXN_MAGIC, Command, Key, Value,
+                                   pack_values, unpack_transaction)
 
 
 class Database:
@@ -33,7 +34,6 @@ class Database:
         pack_transaction) applies the whole batch atomically and returns
         the packed previous values — this is how transactions replicate:
         as one ordered command through whatever protocol runs."""
-        from paxi_tpu.core.command import pack_values, unpack_transaction
         with self._lock:
             batch = unpack_transaction(cmd.value) if cmd.value else None
             if batch is not None:
@@ -46,12 +46,68 @@ class Database:
                     self._history.setdefault(cmd.key, []).append(cmd.value)
             return prev
 
+    def apply_batch(self, cmds: List[Command],
+                    ctab: Dict[str, tuple]) -> None:
+        """Tight-loop state-machine application of a committed batch
+        with per-client at-most-once filtering — the execute path for
+        replicas holding no client connections (one lock acquisition,
+        no Reply objects).  ``ctab`` is the caller's session table
+        (client_id -> (highest executed command_id, its value)),
+        updated exactly as the execute() path would.  Transaction-
+        packed and multi-version commands fall back to execute()
+        (the RLock makes that re-entrant)."""
+        with self._lock:
+            data = self._data
+            for cmd in cmds:
+                if cmd.key < 0:
+                    continue   # NOOP filler
+                cid = cmd.client_id
+                if cid:
+                    last = ctab.get(cid)
+                    if last is not None and cmd.command_id <= last[0]:
+                        continue   # duplicate: already executed
+                v = cmd.value
+                if self._multi_version:
+                    out = self.execute(cmd)
+                elif v.startswith(TXN_MAGIC):
+                    batch = unpack_transaction(v)
+                    # same outcome as execute(): packed previous values
+                    # (ctab must agree across replicas for duplicate
+                    # replies after leader changes), one unpack + one
+                    # inline loop instead of nested executes
+                    out = (pack_values(self.execute_transaction(batch))
+                           if batch is not None
+                           else self.execute(cmd))
+                else:
+                    out = data.get(cmd.key, b"")
+                    if v:
+                        data[cmd.key] = v
+                        self._version += 1
+                if cid:
+                    ctab[cid] = (cmd.command_id, out)
+
     def execute_transaction(self, commands: List[Command]) -> List[Value]:
         """Apply a command batch atomically (msg.go Transaction surface):
         all commands run under one lock acquisition, returning each
-        command's previous value in order."""
+        command's previous value in order.  Plain sub-commands apply
+        inline (no nested execute/lock per sub-command — with batched
+        clients this loop IS the state-machine hot path); nested
+        transaction-packed or multi-version sub-commands keep
+        execute()'s exact semantics via the re-entrant fallback."""
         with self._lock:
-            return [self.execute(c) for c in commands]
+            data = self._data
+            out = []
+            for c in commands:
+                v = c.value
+                if self._multi_version or v.startswith(TXN_MAGIC):
+                    out.append(self.execute(c))
+                    continue
+                prev = data.get(c.key, b"")
+                if v:
+                    data[c.key] = v
+                    self._version += 1
+                out.append(prev)
+            return out
 
     def get(self, key: Key) -> Optional[Value]:
         with self._lock:

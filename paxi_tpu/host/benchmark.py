@@ -1,4 +1,4 @@
-"""Closed-loop benchmark generator.
+"""Load generators: closed-loop benchmark + open-loop saturation probe.
 
 Reference: paxi benchmark.go — ``Benchmark`` drives ``Bconfig.concurrency``
 closed-loop client streams for ``T`` seconds (or ``N`` ops), choosing
@@ -6,6 +6,17 @@ keys per ``distribution`` (uniform / conflict / normal / zipfian
 [driver]), mixing ``W`` writes, optional ``throttle`` ops/s; collects
 per-op latency; prints throughput + mean/median/p95/p99; optionally
 feeds ``History`` and runs the linearizability check at the end [high].
+
+``OpenLoopBenchmark`` is the half the reference lacks: a closed loop
+measures latency at self-limited load (each stream waits for its reply,
+so an overloaded server just slows the clients down and the reported
+throughput flatters it), while an open loop offers Poisson arrivals at
+a TARGET rate whatever the server does, over pipelined connections —
+queueing delay shows up in the latency numbers instead of vanishing
+into generator back-off (coordinated omission: latency is measured
+from the scheduled arrival, not from the eventual submit).  A rate
+ramp yields the saturation curve (offered vs achieved vs tail
+latency) committed as BENCH_HOST_SATURATION.json.
 """
 
 from __future__ import annotations
@@ -19,7 +30,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from paxi_tpu.core.config import Bconfig, Config
-from paxi_tpu.host.client import Client
+from paxi_tpu.host.client import Client, _Conn
 from paxi_tpu.host.history import History
 from paxi_tpu.metrics import Histogram, Registry
 from paxi_tpu.utils import log
@@ -80,6 +91,11 @@ class Stats:
     duration: float
     hist: Histogram = field(repr=False, default_factory=Histogram)
     anomalies: Optional[int] = None
+    # ops completed inside the warmup window (Bconfig.warmup): counted
+    # separately so throughput/latency are steady-state — the host
+    # analog of bench.py's compile_s/warmup_s split
+    warmup_s: float = 0.0
+    warmup_ops: int = 0
 
     @staticmethod
     def _pct(sorted_lat: List[float], p: float) -> float:
@@ -95,12 +111,18 @@ class Stats:
 
     def summary(self) -> Dict[str, float]:
         h = self.hist
+        steady = max(self.duration - self.warmup_s, 1e-9)
         return {
             "ops": self.ops,
             "errors": self.errors,
             "duration_s": round(self.duration, 3),
-            "throughput_ops_s": round(self.ops / self.duration, 1)
+            # steady-state: warmup-window completions excluded from both
+            # numerator and denominator
+            "throughput_ops_s": round(self.ops / steady, 1)
             if self.duration > 0 else 0.0,
+            **({"warmup_s": self.warmup_s, "warmup_ops": self.warmup_ops,
+                "total_ops": self.ops + self.warmup_ops}
+               if self.warmup_s > 0 else {}),
             "latency_mean_ms": round(h.mean() * 1e3, 3),
             "latency_p50_ms": round(h.percentile(50) * 1e3, 3),
             "latency_p95_ms": round(h.percentile(95) * 1e3, 3),
@@ -127,10 +149,12 @@ class Benchmark:
 
     async def run(self) -> Stats:
         b = self.b
-        stats = Stats(ops=0, errors=0, duration=0.0)
+        stats = Stats(ops=0, errors=0, duration=0.0,
+                      warmup_s=max(b.warmup, 0.0))
         stop_at = time.time() + b.T if b.T > 0 else None
         left = b.N if b.T <= 0 else None
         t0 = time.time()
+        warm_until = t0 + stats.warmup_s
 
         async def stream(si: int):
             nonlocal left
@@ -167,8 +191,13 @@ class Benchmark:
                         else:
                             out = await client.get(key)
                         e = time.time()
-                        hist.observe(e - s)
-                        stats.ops += 1
+                        if e < warm_until:
+                            # warmup window: dial-up + election +
+                            # batch ramp — kept out of steady stats
+                            stats.warmup_ops += 1
+                        else:
+                            hist.observe(e - s)
+                            stats.ops += 1
                         if b.linearizability_check:
                             self.history.add(
                                 key, value if write else None,
@@ -196,3 +225,306 @@ class Benchmark:
         if b.linearizability_check:
             stats.anomalies = self.history.linearizable()
         return stats
+
+
+class OpenLoopBenchmark:
+    """Open-loop saturation probe: Poisson arrivals at a ramp of target
+    rates over pipelined HTTP connections (module docstring).
+
+    Every op is submitted when its arrival fires, whether or not
+    earlier ops completed (in-flight is capped only to bound memory at
+    deep over-saturation; ops shed at the cap are counted, never
+    silently skipped).  Latency is measured from the SCHEDULED arrival,
+    so rate-mismatch queueing is visible.  The whole run feeds one
+    History; one linearizability verdict covers every rate step.
+    """
+
+    # submissions buffered per connection before a flush is forced (a
+    # flush also fires whenever the generator sleeps)
+    FLUSH_EVERY = 32
+
+    def __init__(self, cfg: Config, rates: List[float],
+                 step_s: float = 3.0, seed: int = 0, conns: int = 4,
+                 W: float = 0.5, K: int = 1024,
+                 max_inflight: int = 4096,
+                 target: Optional[object] = None,
+                 drain_s: float = 5.0,
+                 linearizability_check: bool = True,
+                 key_base: int = 0, client_tag: str = "ol",
+                 ops_per_req: int = 1):
+        self.cfg = cfg
+        self.rates = list(rates)
+        self.step_s = step_s
+        self.seed = seed
+        self.n_conns = max(int(conns), 1)
+        self.W = W
+        self.K = max(int(K), 1)
+        # parallel generator workers get disjoint key ranges + client
+        # tags: per-key register linearizability composes across
+        # workers, so each checks its own slice and the verdicts sum
+        self.key_base = int(key_base)
+        self.client_tag = client_tag
+        self.max_inflight = max_inflight
+        self.drain_s = drain_s
+        self.lin = linearizability_check
+        # client-side command batching (HT-Paxos's other half): each
+        # HTTP request carries this many independent KV commands over
+        # the Transaction surface — one log slot, one reply, the whole
+        # serving stack amortized.  1 = plain per-op REST.
+        self.ops_per_req = max(int(ops_per_req), 1)
+        # all connections target ONE node (it becomes the stable
+        # leader, so no per-request forwarding hop muddies the curve)
+        ids = cfg.ids
+        self.target = ids[0] if target is None else target
+        self.history = History()
+        self.metrics = Registry(source="bench_open_loop")
+
+    async def run(self) -> Dict:
+        url = self.cfg.http_addrs[self.target]
+        conns = [_Conn(url) for _ in range(self.n_conns)]
+        for c in conns:
+            await c.ensure()
+        rng = random.Random(self.seed)
+        inflight = [0]
+        cmd_ids = [0] * self.n_conns
+        steps: List[Dict] = []
+        try:
+            for rate in self.rates:
+                steps.append(await self._one_rate(
+                    rate, conns, rng, inflight, cmd_ids))
+        finally:
+            for c in conns:
+                c.close()
+        anomalies = self.history.linearizable() if self.lin else None
+        achieved = [s["achieved_ops_s"] for s in steps]
+        peak = max(range(len(steps)), key=lambda i: achieved[i]) \
+            if steps else None
+        return {
+            "mode": "open-loop",
+            "target": str(self.target),
+            "conns": self.n_conns,
+            "W": self.W,
+            "K": self.K,
+            "steps": steps,
+            "peak_ops_s": achieved[peak] if steps else 0.0,
+            "peak_offered_ops_s": steps[peak]["offered_ops_s"]
+            if steps else 0.0,
+            "total_completed": sum(s["completed"] for s in steps),
+            "total_errors": sum(s["errors"] for s in steps),
+            "total_shed": sum(s["shed"] for s in steps),
+            "anomalies": anomalies,
+            "history_ops": len(self.history),
+            # per-rate latency histograms (mergeable across parallel
+            # generator workers — shared bucket layout)
+            "metrics": self.metrics.snapshot(),
+        }
+
+    @staticmethod
+    async def _safe_flush(conn: _Conn) -> None:
+        """Flush; a broken connection reconnects for the NEXT ops (the
+        in-flight ones fail over the dead reader task and count as
+        errors — open loop sheds work, it never stalls)."""
+        try:
+            await conn.flush()
+        except (ConnectionError, OSError):
+            try:
+                await conn.ensure()
+            except OSError:
+                pass
+
+    async def _one_rate(self, rate: float, conns, rng, inflight,
+                        cmd_ids) -> Dict:
+        hist = self.metrics.histogram("paxi_op_seconds", rate=str(rate))
+        stat = {"offered_ops_s": rate, "duration_s": self.step_s,
+                "submitted": 0, "completed": 0, "errors": 0, "shed": 0,
+                "unfinished": 0}
+        step_open = [0]     # this step's in-flight ops
+        closed = [False]    # set when the step's books close: later
+        # completions still balance the in-flight counters and feed the
+        # history (the checker needs every write that really happened),
+        # but no longer move this step's throughput/latency stats
+        # locals bound once: issue() and done() run per op
+        n_conns = self.n_conns
+        K, W, lin = self.K, self.W, self.lin
+        key_base = self.key_base
+        history_add = self.history.add
+        observe = hist.observe
+        randrange, random_, expovariate = (rng.randrange, rng.random,
+                                           rng.expovariate)
+        wall = time.time
+        # request bytes from templates: one % plus one append per op
+        cid = self.client_tag.encode()
+        put_tmpl = (b"PUT /%d HTTP/1.1\r\nContent-Length: %d\r\n"
+                    b"Client-Id: " + cid + b"%d\r\n"
+                    b"Command-Id: %d\r\n\r\n%s")
+        get_tmpl = (b"GET /%d HTTP/1.1\r\nContent-Length: 0\r\n"
+                    b"Client-Id: " + cid + b"%d\r\n"
+                    b"Command-Id: %d\r\n\r\n")
+
+        B = self.ops_per_req
+        txn_tmpl = (b"POST /transaction HTTP/1.1\r\n"
+                    b"Content-Length: %d\r\n"
+                    b"Client-Id: " + cid + b"%d\r\n"
+                    b"Command-Id: %d\r\n\r\n%s")
+        json_loads = __import__("json").loads
+
+        def issue_batched(sched_t: float) -> None:
+            """One arrival = one request of B independent commands on
+            the Transaction surface (client-side batching)."""
+            stat["submitted"] += B
+            if inflight[0] >= self.max_inflight:
+                stat["shed"] += B
+                return
+            ci = (stat["submitted"] // B) % n_conns
+            conn = conns[ci]
+            cmd_ids[ci] += 1
+            wid = cmd_ids[ci]
+            parts = []
+            ops_meta = []
+            for j in range(B):
+                key = key_base + randrange(K)
+                if random_() < W:
+                    v = "%d:%d:%d" % (ci, wid, j)
+                    parts.append('{"key":%d,"value":"%s"}' % (key, v))
+                    ops_meta.append((key, v.encode()))
+                else:
+                    parts.append('{"key":%d}' % key)
+                    ops_meta.append((key, None))
+            body = ("[" + ",".join(parts) + "]").encode()
+            inflight[0] += B
+            step_open[0] += 1
+            submit_wall = wall()
+
+            def done(status, _hdr, payload, exc, _ops=ops_meta,
+                     _sched=sched_t, _sw=submit_wall):
+                inflight[0] -= B
+                step_open[0] -= 1
+                now = wall()
+                if exc is not None or status != 200:
+                    if not closed[0]:
+                        stat["errors"] += B
+                    if lin:
+                        for k, v in _ops:
+                            if v is not None:
+                                history_add(k, v, None, _sw, math.inf)
+                    return
+                if not closed[0]:
+                    stat["completed"] += B
+                    observe(now - _sched)   # request latency, B cmds
+                if lin:
+                    vals = json_loads(payload)["values"]
+                    for j, (k, v) in enumerate(_ops):
+                        if v is None:
+                            history_add(k, None,
+                                        vals[j].encode("latin1"),
+                                        _sw, now)
+                        else:
+                            history_add(k, v, None, _sw, now)
+
+            conn.submit_raw(txn_tmpl % (len(body), ci, wid, body), done)
+
+        def issue(sched_t: float) -> None:
+            stat["submitted"] += 1
+            if inflight[0] >= self.max_inflight:
+                stat["shed"] += 1
+                return
+            ci = stat["submitted"] % n_conns
+            conn = conns[ci]
+            cmd_ids[ci] += 1
+            cmd_id = cmd_ids[ci]
+            key = key_base + randrange(K)
+            write = random_() < W
+            # unique value per write: read-from edges in the checker
+            # are unambiguous, and the per-conn (client, command_id)
+            # stream is monotonic for the server's at-most-once table
+            if write:
+                value = b"%d:%d" % (ci, cmd_id)
+                frame = put_tmpl % (key, len(value), ci, cmd_id, value)
+            else:
+                value = b""
+                frame = get_tmpl % (key, ci, cmd_id)
+            inflight[0] += 1
+            step_open[0] += 1
+            submit_wall = wall()
+
+            def done(status, _hdr, payload, exc, _k=key,
+                     _v=value if write else None, _sched=sched_t,
+                     _sw=submit_wall):
+                inflight[0] -= 1
+                step_open[0] -= 1
+                now = wall()
+                if exc is not None or status != 200:
+                    if not closed[0]:
+                        stat["errors"] += 1
+                    if lin and _v is not None:
+                        # a failed/timed-out write may still commit:
+                        # open end time (host/history.py convention)
+                        history_add(_k, _v, None, _sw, math.inf)
+                    return
+                if not closed[0]:
+                    stat["completed"] += 1
+                    observe(now - _sched)   # includes queueing delay
+                if lin:
+                    history_add(_k, _v, payload if _v is None else None,
+                                _sw, now)
+
+            conn.submit_raw(frame, done)
+
+        async def flush_full(force: bool) -> None:
+            for c in conns:
+                if c.pending_out >= (1 if force else self.FLUSH_EVERY):
+                    await self._safe_flush(c)
+
+        if B > 1:
+            issue = issue_batched
+            rate = rate / B      # arrivals are REQUESTS of B commands
+        start = time.monotonic()
+        wall0 = time.time()
+        end = start + self.step_s
+        next_t = start + expovariate(rate)
+        while True:
+            now = time.monotonic()
+            if now >= end:
+                break
+            burst = 0
+            while next_t <= now and next_t < end:
+                issue(wall0 + (next_t - start))
+                next_t += expovariate(rate)
+                burst += 1
+                if burst % self.FLUSH_EVERY == 0:
+                    await flush_full(False)
+            await flush_full(True)
+            await asyncio.sleep(min(max(next_t - time.monotonic(), 0.0005),
+                                    0.005))
+        # catch-up: arrivals scheduled before the step boundary that the
+        # loop didn't reach (congested event loop) are still offered
+        # load — submit them late rather than under-reporting `offered`
+        while next_t < end:
+            issue(wall0 + (next_t - start))
+            next_t += expovariate(rate)
+            if stat["submitted"] % self.FLUSH_EVERY == 0:
+                await flush_full(False)
+        await flush_full(True)
+        # grace window for stragglers of THIS step; anything past the
+        # drain window is reported, not silently forgotten (its late
+        # completion still decrements in-flight and feeds the history).
+        # Completions during the drain COUNT, so the drain time joins
+        # the denominator — a saturated backlog cannot inflate the
+        # reported rate by completing "for free" after the boundary.
+        drain_t0 = time.monotonic()
+        deadline = drain_t0 + self.drain_s
+        while step_open[0] > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        stat["unfinished"] = step_open[0]
+        closed[0] = True
+        dur = self.step_s + (time.monotonic() - drain_t0)
+        stat["duration_s"] = round(dur, 3)
+        stat["achieved_ops_s"] = round(stat["completed"] / dur, 1)
+        stat["latency_ms"] = {
+            "mean": round(hist.mean() * 1e3, 3),
+            "p50": round(hist.percentile(50) * 1e3, 3),
+            "p95": round(hist.percentile(95) * 1e3, 3),
+            "p99": round(hist.percentile(99) * 1e3, 3),
+            "max": round(hist.max * 1e3, 3),
+        }
+        return stat

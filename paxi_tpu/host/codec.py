@@ -14,6 +14,13 @@ codec id][type-tag][payload]``.  Two payload codecs:
   a restricted unpickler that only resolves registered message classes
   and their field types — a frame from the network can never trigger
   arbitrary-object construction.
+
+Coalescing: a third frame kind, ``BATCH``, packs several already-encoded
+frames into ONE wire frame (``encode_batch``), so a transport draining a
+backed-up outbound queue pays one length header + one write syscall for
+the whole burst instead of one per message.  ``decode_all`` is the
+receive-side inverse: it yields every message in a body whichever kind
+it is, so listeners handle plain and coalesced frames uniformly.
 """
 
 from __future__ import annotations
@@ -95,7 +102,7 @@ class _RestrictedUnpickler(pickle.Unpickler):
 class Codec:
     """Encode/decode registered messages to/from framed bytes."""
 
-    JSON, PICKLE = 0, 1
+    JSON, PICKLE, BATCH = 0, 1, 2
 
     def __init__(self, kind: str = "json"):
         self.kind = {"json": self.JSON, "pickle": self.PICKLE}[kind]
@@ -114,6 +121,33 @@ class Codec:
                                  separators=(",", ":")).encode()
         body = bytes([self.kind, len(tag)]) + tag + payload
         return _LEN.pack(len(body)) + body
+
+    def encode_batch(self, msgs) -> bytes:
+        """One frame holding many messages: ``[len][BATCH][sub-frame]*``
+        where each sub-frame is a full ``encode()`` output (its own
+        4-byte length included), so decode walks them with the same
+        framing rules the stream layer uses."""
+        body = bytes([self.BATCH]) + b"".join(
+            self.encode(m) for m in msgs)
+        return _LEN.pack(len(body)) + body
+
+    def decode_all(self, body: bytes) -> list:
+        """Every message in ``body`` — a 1-list for plain frames, the
+        unpacked sub-frames for a BATCH frame (nested batches are not
+        produced by encode_batch and not accepted here)."""
+        if body[0] != self.BATCH:
+            return [self.decode_body(body)]
+        out, rest = [], body[1:]
+        while rest:
+            if len(rest) < 4:
+                raise ValueError("truncated batch frame")
+            n = _LEN.unpack(rest[:4])[0]
+            sub = rest[4:4 + n]
+            if len(sub) < n or sub[0] == self.BATCH:
+                raise ValueError("malformed batch sub-frame")
+            out.append(self.decode_body(sub))
+            rest = rest[4 + n:]
+        return out
 
     def decode_body(self, body: bytes) -> Any:
         kind, tlen = body[0], body[1]

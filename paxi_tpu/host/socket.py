@@ -153,7 +153,9 @@ class Socket:
                             reason="unknown_peer").inc()
                 return
             t = new_transport(self.cfg.addrs[to], self.codec,
-                              self.cfg.buffer_size)
+                              self.cfg.buffer_size,
+                              on_drop=self._count_queue_drop,
+                              on_coalesce=self._count_coalesce)
             self._peers[to] = t
             asyncio.ensure_future(self._dial_then(to, t))
         delay, until = self._slow.get(to, (0.0, 0.0))
@@ -163,6 +165,19 @@ class Socket:
             asyncio.get_event_loop().call_later(delay, t.send, msg)
         else:
             t.send(msg)
+
+    def _count_queue_drop(self, msg: Any, reason: str) -> None:
+        """Transport backpressure callback: an outbound queue shed a
+        message.  Counted under the same drop counter as the fault
+        surface so one scrape shows every loss cause."""
+        self.metrics.counter("paxi_msgs_dropped_total",
+                             type=type(msg).__name__, reason=reason).inc()
+
+    def _count_coalesce(self, n: int) -> None:
+        """Transport coalescing callback: ``n`` messages left in one
+        wire frame (one length header + one write syscall)."""
+        self.metrics.counter("paxi_msgs_coalesced_total").inc(n)
+        self.metrics.counter("paxi_frames_coalesced_total").inc()
 
     async def _dial_then(self, to: ID, t: Transport) -> None:
         try:

@@ -8,23 +8,50 @@ requests to the ballot leader [driver: HandleP1a/P1b/P2a/P2b, Quorum.ACK].
 
 This is the same protocol the TPU sim kernel (sim.py) runs as masked
 array updates; here it is the event-driven form for real deployments.
+
+Batched commit path (HT-Paxos, PAPERS.md): the leader accumulates
+client commands in a ``BatchBuffer`` (host/batch.py — size bound
+``cfg.batch_size``, time bound ``cfg.batch_wait``; the default flushes
+on the next event-loop tick) and ONE phase-2 round decides the whole
+batch: a slot holds a *list* of commands, P2a/P3 carry the list, and
+execution applies it in order with per-command at-most-once filtering
+and per-command reply fan-out.  Batch atomicity rides on slot
+atomicity — a P2a either reaches an acceptor with the entire batch or
+not at all, so no fault schedule can commit a partial batch.  An empty
+command list is the NOOP filler for recovered holes.
 """
 
 from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from paxi_tpu.core.ballot import ballot_id, next_ballot
 from paxi_tpu.core.command import Command, Reply, Request
 from paxi_tpu.core.config import Config
 from paxi_tpu.core.ident import ID
 from paxi_tpu.core.quorum import Quorum
+from paxi_tpu.host.batch import BatchBuffer
 from paxi_tpu.host.codec import register_message
 from paxi_tpu.host.node import Node
 
-NOOP = Command(key=-1, value=b"\x00noop")
+
+def _wire_cmds(cmds: List[Command]) -> List[list]:
+    """Commands as wire-friendly lists (codec round-trips lists of
+    [key, value, client_id, command_id] under both json and pickle)."""
+    return [[c.key, c.value, c.client_id, c.command_id] for c in cmds]
+
+
+def _cmds_from_wire(wire) -> List[Command]:
+    return [Command(int(k), v, cid, int(cmid)) for k, v, cid, cmid in wire]
+
+
+def _idents(cmds: List[Command]) -> List[Tuple[str, int]]:
+    """A batch's identity: the (client_id, command_id) sequence — what
+    decides whether a recovered/committed slot still carries the same
+    client commands our pending replies are waiting on."""
+    return [(c.client_id, c.command_id) for c in cmds]
 
 
 @register_message
@@ -42,7 +69,7 @@ class P1a:
 class P1b:
     ballot: int
     id: str
-    # slot -> [ballot, key, value, client_id, command_id, committed]
+    # slot -> [ballot, [[key, value, client_id, command_id], ...], committed]
     log: Dict[int, list] = field(default_factory=dict)
     # state transfer: the log payload omits slots below the sender's
     # execute frontier (log-compaction analog), so the frontier plus a
@@ -60,12 +87,12 @@ class P1b:
 @register_message
 @dataclass
 class P2a:
+    """One phase-2 round for one slot — which now carries a whole
+    command batch ([] = NOOP filler)."""
+
     ballot: int
     slot: int
-    key: int
-    value: bytes
-    client_id: str = ""
-    command_id: int = 0
+    cmds: list = field(default_factory=list)
 
 
 @register_message
@@ -81,23 +108,25 @@ class P2b:
 class P3:
     ballot: int
     slot: int
-    key: int
-    value: bytes
-    client_id: str = ""
-    command_id: int = 0
+    cmds: list = field(default_factory=list)
 
 
 @dataclass
 class Entry:
     """Reference: paxos.go entry{ballot, command, commit, request,
-    quorum, timestamp}."""
+    quorum, timestamp} — generalized to a command batch with a parallel
+    request list (requests[i] answers cmds[i]; None for commands whose
+    client connection lives elsewhere)."""
 
     ballot: int
-    command: Command
+    cmds: List[Command] = field(default_factory=list)
     commit: bool = False
-    request: Optional[Request] = None
+    requests: List[Optional[Request]] = field(default_factory=list)
     quorum: Optional[Quorum] = None
     timestamp: float = 0.0
+
+    def live_requests(self) -> List[Request]:
+        return [r for r in self.requests if r is not None]
 
 
 class PaxosReplica(Node):
@@ -105,6 +134,8 @@ class PaxosReplica(Node):
         super().__init__(id, cfg)
         self.ballot = 0
         self.active = False
+        self._leader_ballot = 0          # leader-property memo (ballot)
+        self._leader_cache: Optional[ID] = None
         self.log: Dict[int, Entry] = {}
         self.slot = -1          # highest slot used (next proposal = slot+1)
         self.execute = 0        # next slot to execute
@@ -112,6 +143,9 @@ class PaxosReplica(Node):
         self.p1b_logs: Dict[ID, Dict[int, list]] = {}
         self.p1b_meta: Dict[ID, tuple] = {}   # id -> (execute, snap, ctab)
         self.pending: list = []  # requests queued while electing
+        # leader-reads barrier: proposal-frontier slot -> reads waiting
+        # for every slot <= it to execute (cfg.leader_reads only)
+        self._read_barrier: Dict[int, List[Request]] = {}
         # at-most-once filter (ADVICE r2 medium): client_id -> (highest
         # executed command_id, its value).  Clients issue command_ids
         # monotonically (host/client.py), so a re-proposal of an
@@ -121,6 +155,15 @@ class PaxosReplica(Node):
         # leader — is recognized and skipped deterministically at every
         # replica instead of mutating the DB twice.
         self.ctab: Dict[str, Tuple[int, bytes]] = {}
+        # the batched commit path: leader-side request accumulation.
+        # Wall timers never fire under the virtual-clock fabric, so a
+        # fabric-driven replica is forced onto tick flushes to keep
+        # trace replays deterministic.
+        self.batch = BatchBuffer(
+            self._flush_batch, max_size=cfg.batch_size,
+            max_wait=0.0 if self.socket.fabric is not None
+            else cfg.batch_wait,
+            metrics=self.metrics)
         self.register(Request, self.handle_request)
         self.register(P1a, self.handle_p1a)
         self.register(P1b, self.handle_p1b)
@@ -131,7 +174,15 @@ class PaxosReplica(Node):
     # ---- leadership ----------------------------------------------------
     @property
     def leader(self) -> Optional[ID]:
-        return ballot_id(self.ballot) if self.ballot else None
+        # memoized per ballot: ID construction parses/validates the
+        # "zone.node" string, and this property is on the per-request
+        # hot path (is_leader per client command)
+        if not self.ballot:
+            return None
+        if self._leader_ballot != self.ballot:
+            self._leader_ballot = self.ballot
+            self._leader_cache = ballot_id(self.ballot)
+        return self._leader_cache
 
     def is_leader(self) -> bool:
         return self.active and self.leader == self.id
@@ -147,14 +198,15 @@ class PaxosReplica(Node):
         self.socket.broadcast(P1a(self.ballot, self.execute))
 
     def _log_payload(self) -> Dict[int, list]:
-        return {s: [e.ballot, e.command.key, e.command.value,
-                    e.command.client_id, e.command.command_id, e.commit]
+        return {s: [e.ballot, _wire_cmds(e.cmds), e.commit]
                 for s, e in self.log.items() if s >= self.execute}
 
     # ---- client requests ----------------------------------------------
     def handle_request(self, req: Request) -> None:
         if self.is_leader():
-            self.propose(req)
+            # the batched path: one phase-2 round will cover every
+            # request that lands in this buffer before the flush bound
+            self.batch.add(req)
         elif self.leader is not None and self.leader != self.id:
             self.forward(self.leader, req)
         else:
@@ -164,11 +216,46 @@ class PaxosReplica(Node):
             if self.leader != self.id:
                 self.run_phase1()
 
-    def propose(self, req: Optional[Request],
-                command: Optional[Command] = None,
+    def _flush_batch(self, reqs: List[Request]) -> None:
+        """BatchBuffer flush: propose ONE slot for the whole batch —
+        or, if leadership was lost between add and flush, route the
+        requests like any other non-leader arrival.
+
+        With ``cfg.leader_reads`` the batch's reads never enter the
+        log: they wait at the current proposal frontier and execute
+        against the leader's applied state once every earlier slot has
+        executed (read-index semantics; module docstring caveat)."""
+        if not self.is_leader():
+            self.pending.extend(reqs)
+            self._drain_pending()
+            return
+        if not self.cfg.leader_reads:
+            self.propose(reqs)
+            return
+        writes = [r for r in reqs if r.command.value]
+        reads = [r for r in reqs if not r.command.value]
+        if writes:
+            self.propose(writes)
+        if reads:
+            barrier = self.slot
+            if self.execute > barrier:
+                db_get = self.db.get
+                for r in reads:
+                    r.reply(Reply(r.command,
+                                  value=db_get(r.command.key) or b""))
+            else:
+                self._read_barrier.setdefault(barrier, []).extend(reads)
+
+    def propose(self, reqs: Optional[List[Request]],
+                cmds: Optional[List[Command]] = None,
                 at_slot: Optional[int] = None) -> None:
-        """paxos.go P2a(): assign a slot, self-ack, broadcast P2a."""
-        cmd = command if command is not None else req.command
+        """paxos.go P2a(): assign a slot to the batch, self-ack,
+        broadcast one P2a carrying every command."""
+        reqs = list(reqs) if reqs else []
+        if cmds is None:
+            cmds = [r.command for r in reqs]
+        if len(reqs) < len(cmds):
+            reqs = reqs + [None] * (len(cmds) - len(reqs))
         if at_slot is None:
             self.slot += 1
             slot = self.slot
@@ -177,10 +264,9 @@ class PaxosReplica(Node):
             self.slot = max(self.slot, slot)
         q = Quorum(self.cfg.ids)
         q.ack(self.id)
-        self.log[slot] = Entry(self.ballot, cmd, request=req, quorum=q,
+        self.log[slot] = Entry(self.ballot, cmds, requests=reqs, quorum=q,
                                timestamp=time.time())
-        self.socket.broadcast(P2a(self.ballot, slot, cmd.key, cmd.value,
-                                  cmd.client_id, cmd.command_id))
+        self.socket.broadcast(P2a(self.ballot, slot, _wire_cmds(cmds)))
         if q.majority():  # single-replica cluster
             self._commit(slot)
 
@@ -199,12 +285,18 @@ class PaxosReplica(Node):
                              self.execute, snap, ctab))
 
     def _repend_inflight(self) -> None:
-        """Losing leadership: uncommitted proposals carrying client
-        requests go back to pending for forwarding to the new leader."""
+        """Losing leadership: unflushed batch, barrier reads and
+        uncommitted proposals carrying client requests go back to
+        pending for forwarding to the new leader."""
+        self.batch.drain()   # flush sees not-leader: routes to pending
+        if self._read_barrier:
+            for reads in self._read_barrier.values():
+                self.pending.extend(reads)
+            self._read_barrier = {}
         for e in self.log.values():
-            if not e.commit and e.request is not None:
-                self.pending.append(e.request)
-                e.request = None
+            if not e.commit and e.requests:
+                self.pending.extend(e.live_requests())
+                e.requests = []
         self._drain_pending()
 
     def handle_p1b(self, m: P1b) -> None:
@@ -220,9 +312,9 @@ class PaxosReplica(Node):
             self._become_leader()
 
     def _become_leader(self) -> None:
-        """Merge P1b logs: per slot adopt the highest-ballot command, keep
-        committed values, fill holes with NOOP; re-propose everything in
-        the window (paxos.go HandleP1b recovery path)."""
+        """Merge P1b logs: per slot adopt the highest-ballot batch, keep
+        committed values, fill holes with NOOP (empty batch); re-propose
+        everything in the window (paxos.go HandleP1b recovery path)."""
         self.active = True
         # state transfer first: an acker ahead of our execute frontier
         # has executed (hence committed) everything below it; adopt its
@@ -243,45 +335,48 @@ class PaxosReplica(Node):
             snap_n = {int(k): v for k, v in snap.items()}
             for s in range(self.execute, front):
                 e = self.log.get(s)
-                if e is None or e.request is None:
+                if e is None or not e.requests:
                     continue
                 if e.commit:
-                    v = (snap_n.get(e.command.key, b"")
-                         if e.command.is_read() else b"")
-                    e.request.reply(Reply(e.command, value=v))
+                    for cmd, req in zip(e.cmds, e.requests):
+                        if req is None:
+                            continue
+                        v = (snap_n.get(cmd.key, b"")
+                             if cmd.is_read() else b"")
+                        req.reply(Reply(cmd, value=v))
                 else:
-                    self.pending.append(e.request)
-                e.request = None
+                    self.pending.extend(e.live_requests())
+                e.requests = []
             self.db.restore(snap)
             self.execute = front
             self.slot = max(self.slot, front - 1)
-        merged: Dict[int, Tuple[int, Command, bool]] = {}
+        merged: Dict[int, Tuple[int, list, bool]] = {}
         top = self.slot
         for log in self.p1b_logs.values():
-            for s_raw, (bal, key, value, cid, cmid, committed) in log.items():
+            for s_raw, (bal, wire, committed) in log.items():
                 s = int(s_raw)
                 top = max(top, s)
-                cmd = Command(int(key), value, cid, int(cmid))
                 cur = merged.get(s)
                 if committed:
-                    merged[s] = (bal, cmd, True)
+                    merged[s] = (bal, wire, True)
                 elif cur is None or (not cur[2] and bal > cur[0]):
-                    merged[s] = (bal, cmd, False)
+                    merged[s] = (bal, wire, False)
         for s in range(self.execute, top + 1):
-            bal, cmd, committed = merged.get(s, (0, NOOP, False))
+            bal, wire, committed = merged.get(s, (0, [], False))
+            cmds = _cmds_from_wire(wire)
             prev = self.log.get(s)
-            req = prev.request if prev else None
+            reqs = prev.requests if prev else []
             if prev is not None and prev.commit:
                 continue
-            if req is not None and (
-                    (prev.command.client_id, prev.command.command_id)
-                    != (cmd.client_id, cmd.command_id)):
-                self.pending.append(req)   # retry: slot taken by another cmd
-                prev.request = req = None
+            if prev is not None and prev.live_requests() and \
+                    _idents(prev.cmds) != _idents(cmds):
+                # retry: the slot was taken by a different batch
+                self.pending.extend(prev.live_requests())
+                prev.requests = reqs = []
             if committed:
-                self.log[s] = Entry(bal, cmd, commit=True, request=req)
+                self.log[s] = Entry(bal, cmds, commit=True, requests=reqs)
             else:
-                self.propose(req, command=cmd, at_slot=s)
+                self.propose(reqs, cmds=cmds, at_slot=s)
         self.slot = max(self.slot, top)
         self._exec()
         self._drain_pending()
@@ -300,10 +395,9 @@ class PaxosReplica(Node):
                 self._repend_inflight()
             e = self.log.get(m.slot)
             if e is None or (not e.commit and m.ballot >= e.ballot):
-                req = e.request if e else None
-                self.log[m.slot] = Entry(
-                    m.ballot, Command(m.key, m.value, m.client_id,
-                                      m.command_id), request=req)
+                reqs = e.requests if e else []
+                self.log[m.slot] = Entry(m.ballot, _cmds_from_wire(m.cmds),
+                                         requests=reqs)
             self.slot = max(self.slot, m.slot)
         self.socket.send(ballot_id(m.ballot),
                          P2b(self.ballot, m.slot, str(self.id)))
@@ -324,54 +418,74 @@ class PaxosReplica(Node):
     def _commit(self, slot: int) -> None:
         e = self.log[slot]
         e.commit = True
-        c = e.command
-        self.socket.broadcast(P3(self.ballot, slot, c.key, c.value,
-                                 c.client_id, c.command_id))
+        self.socket.broadcast(P3(self.ballot, slot, _wire_cmds(e.cmds)))
         self._exec()
 
     # ---- commit + execution -------------------------------------------
     def handle_p3(self, m: P3) -> None:
-        cmd = Command(m.key, m.value, m.client_id, m.command_id)
+        cmds = _cmds_from_wire(m.cmds)
         e = self.log.get(m.slot)
-        req = e.request if e else None
-        if req is not None and (
-                (e.command.client_id, e.command.command_id)
-                != (cmd.client_id, cmd.command_id)):
-            # a different command committed in our slot: retry the
-            # client's request elsewhere (reference HandleP3 retry path)
-            req = None
-            self.pending.append(e.request)
-            e.request = None
-        self.log[m.slot] = Entry(m.ballot, cmd, commit=True, request=req)
+        reqs = e.requests if e else []
+        if e is not None and e.live_requests() and \
+                _idents(e.cmds) != _idents(cmds):
+            # a different batch committed in our slot: retry the
+            # clients' requests elsewhere (reference HandleP3 retry path)
+            self.pending.extend(e.live_requests())
+            e.requests = reqs = []
+        self.log[m.slot] = Entry(m.ballot, cmds, commit=True, requests=reqs)
         self.slot = max(self.slot, m.slot)
         self._exec()
         self._drain_pending()
 
     def _exec(self) -> None:
-        """paxos.go exec(): apply the committed prefix in slot order,
-        with per-client at-most-once filtering (see self.ctab)."""
+        """paxos.go exec(): apply the committed prefix in slot order —
+        now batch-at-a-time: every command of a committed slot applies
+        in batch order with per-client at-most-once filtering (see
+        self.ctab) and its reply fans out to the waiting client."""
         while True:
             e = self.log.get(self.execute)
             if e is None or not e.commit:
                 break
-            if e.command.key >= 0:  # skip NOOP
-                cmd = e.command
-                last = self.ctab.get(cmd.client_id) if cmd.client_id else None
-                if last is not None and cmd.command_id <= last[0]:
-                    # duplicate of an already-executed command: reply
-                    # with the recorded outcome, never re-apply
-                    value = last[1] if cmd.command_id == last[0] else b""
-                else:
-                    value = self.db.execute(cmd)
-                    if cmd.client_id:
-                        self.ctab[cmd.client_id] = (cmd.command_id, value)
-                if e.request is not None:
-                    e.request.reply(Reply(e.command, value=value))
-                    e.request = None
-            elif e.request is not None:
-                e.request.reply(Reply(e.command, err="noop"))
-                e.request = None
+            reqs = e.requests
+            if not reqs:
+                # no client connections waiting on this batch (the
+                # common case at followers): one-lock tight loop
+                if e.cmds:
+                    self.db.apply_batch(e.cmds, self.ctab)
+                self.execute += 1
+                continue
+            for i, cmd in enumerate(e.cmds):
+                req = reqs[i] if i < len(reqs) else None
+                if cmd.key >= 0:
+                    last = (self.ctab.get(cmd.client_id)
+                            if cmd.client_id else None)
+                    if last is not None and cmd.command_id <= last[0]:
+                        # duplicate of an already-executed command:
+                        # reply with the recorded outcome, never re-apply
+                        value = last[1] if cmd.command_id == last[0] else b""
+                    else:
+                        value = self.db.execute(cmd)
+                        if cmd.client_id:
+                            self.ctab[cmd.client_id] = (cmd.command_id,
+                                                        value)
+                    if req is not None:
+                        req.reply(Reply(cmd, value=value))
+                elif req is not None:
+                    req.reply(Reply(cmd, err="noop"))
+            e.requests = []
             self.execute += 1
+        if self._read_barrier:
+            self._answer_barrier_reads()
+
+    def _answer_barrier_reads(self) -> None:
+        """Leader reads whose barrier slot has fully executed read the
+        applied state now (every write they must observe is in)."""
+        done = [s for s in self._read_barrier if s < self.execute]
+        db_get = self.db.get
+        for s in done:
+            for r in self._read_barrier.pop(s):
+                r.reply(Reply(r.command,
+                              value=db_get(r.command.key) or b""))
 
 
 def new_replica(id: ID, cfg: Config) -> PaxosReplica:
@@ -384,6 +498,9 @@ def new_replica(id: ID, cfg: Config) -> PaxosReplica:
 # the host runtime's five message classes, so a minimized sim witness
 # ("the run where THIS P2a vanished") projects onto deterministic
 # Socket.drop_next directives with no schedule homomorphism caveats.
+# (The host P2a now carries a batch; with the fabric's tick flushes a
+# trace-driven workload issues one command per round, so batch fill is
+# 1 and the per-slot correspondence holds during replays.)
 TRACE_MSG_MAP = {
     "p1a": "P1a", "p1b": "P1b", "p2a": "P2a", "p2b": "P2b", "p3": "P3",
 }
@@ -395,7 +512,7 @@ TRACE_MSG_MAP = {
 SIM_STATE_MAP = {
     "p1_acks":    "p1_quorum",  # phase-1 ack bitmask <-> Quorum
     "log_bal":    "log",        # accepted-ballot plane <-> Entry.ballot
-    "log_cmd":    "log",        # command plane <-> Entry.command
+    "log_cmd":    "log",        # command plane <-> Entry.cmds
     "log_commit": "log",        # commit plane <-> Entry.commit
     "log_acks":   "log",        # per-slot P2b bitmask <-> Entry.quorum
     "next_slot":  "slot",

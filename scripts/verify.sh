@@ -21,13 +21,56 @@
 #                                # (seconds) whose artifact line must
 #                                # carry the full schema with
 #                                # committed > 0 and violations == 0
+#   scripts/verify.sh --host-bench
+#                                # prepend the host-serving smoke: a
+#                                # tiny open-loop ramp through the
+#                                # batched commit pipeline (paxos,
+#                                # in-process) asserting the artifact
+#                                # schema, committed ops > 0, a clean
+#                                # linearizability verdict and a
+#                                # nonzero batch-flush counter
 # Stage flags stack: `verify.sh --lint --metrics --hunt` runs all.
 set -o pipefail
 cd "$(dirname "$0")/.."
 
 while [ "${1:-}" = "--lint" ] || [ "${1:-}" = "--metrics" ] \
-    || [ "${1:-}" = "--hunt" ] || [ "${1:-}" = "--bench" ]; do
-  if [ "$1" = "--bench" ]; then
+    || [ "${1:-}" = "--hunt" ] || [ "${1:-}" = "--bench" ] \
+    || [ "${1:-}" = "--host-bench" ]; do
+  if [ "$1" = "--host-bench" ]; then
+    shift
+    echo "== host-bench smoke (open-loop batched commit path) =="
+    # the serving stack end-to-end at a toy rate: pipelined HTTP ->
+    # batch buffer -> one Paxos round per batch -> per-command fan-out,
+    # with the linearizability checker and the batch counters as the
+    # pass/fail contract
+    HB_OUT=$(mktemp /tmp/paxi_hostbench.XXXXXX.json)
+    timeout -k 10 180 env JAX_PLATFORMS=cpu python -m paxi_tpu \
+      bench-host --open-loop -rates 300,800 -step_s 1.5 -conns 2 \
+      -base_port 18080 -out "$HB_OUT" >/dev/null || exit $?
+    HB_OUT="$HB_OUT" python - <<'PYEOF' || exit $?
+import json, os
+with open(os.environ["HB_OUT"]) as f:
+    r = json.load(f)
+required = ("protocol", "replicas", "batch_size", "mode", "steps",
+            "peak_ops_s", "total_completed", "anomalies")
+missing = [k for k in required if k not in r]
+assert not missing, f"host-bench artifact missing keys: {missing}"
+assert r["mode"] == "open-loop", r["mode"]
+assert r["total_completed"] > 0, "no ops completed"
+assert (r["anomalies"] or 0) == 0, f"linearizability: {r['anomalies']}"
+for s in r["steps"]:
+    for k in ("offered_ops_s", "achieved_ops_s", "latency_ms"):
+        assert k in s, (k, s)
+flushes = sum(
+    c["value"] for c in r["cluster_metrics"]["counters"]
+    if c["name"] == "paxi_batch_flushes_total")
+assert flushes > 0, "batch buffer never flushed"
+print(f"host-bench smoke OK: peak {r['peak_ops_s']} ops/s, "
+      f"{r['total_completed']} ops, {flushes} batch flushes, "
+      f"anomalies={r['anomalies']}")
+PYEOF
+    rm -f "$HB_OUT"
+  elif [ "$1" = "--bench" ]; then
     shift
     echo "== bench smoke (tiny-shape mesh bench.py) =="
     # the north-star bench's mesh path end-to-end at a toy shape:

@@ -63,6 +63,49 @@ class SharedThing:
         return self.count + len(self.items)
 
 
+class BatchLike:
+    """The host batch-buffer shape (host/batch.py BatchBuffer): a
+    lock-owning accumulator whose flush callback is scheduled onto the
+    event loop.  Pins the lockset analysis on exactly the patterns the
+    real class uses — swap-under-lock, call-outside-lock — plus the
+    two ways to get that shape wrong."""
+
+    def __init__(self, flush_fn):
+        self._lock = threading.Lock()
+        self._flush_fn = flush_fn
+        self._items = []
+        self._handle = None
+
+    def add_ok(self, item, loop):
+        with self._lock:
+            self._items.append(item)
+            if self._handle is None:
+                self._handle = loop.call_soon(self.flush_ok)
+
+    def flush_ok(self):
+        with self._lock:
+            items, self._items = self._items, []
+            handle, self._handle = self._handle, None
+        if handle is not None:
+            handle.cancel()
+        if items:
+            self._flush_fn(items)     # callback runs OUTSIDE the lock
+
+    def add_racy(self, item, loop):
+        self._items.append(item)      # PXC402: unlocked mutating call
+        with self._lock:
+            if self._handle is None:
+                # PXC451: the scheduled lambda runs later, lock-free
+                self._handle = loop.call_soon(
+                    lambda: self._items.clear())
+
+    def flush_racy(self):
+        items = self._items           # alias taken...
+        with self._lock:
+            self._handle = None
+        items.clear()                 # PXC452: ...cleared outside it
+
+
 class Unlocked:
     """Negative control: no lock attribute — never checked."""
 

@@ -1,0 +1,359 @@
+"""Batched commit pipeline tests: the BatchBuffer's flush bounds, wire
+coalescing, backpressure accounting, batch atomicity under fault
+injection (fabric-replayed), and the open-loop generator end-to-end
+with the linearizability oracle."""
+
+import asyncio
+
+import pytest
+
+from paxi_tpu.core.command import Command, Reply, Request
+from paxi_tpu.core.config import local_config
+from paxi_tpu.host.batch import BatchBuffer
+from paxi_tpu.host.simulation import Cluster
+
+pytestmark = pytest.mark.host
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---- BatchBuffer flush bounds ------------------------------------------
+def test_batch_buffer_size_bound_flushes_inline():
+    async def main():
+        out = []
+        b = BatchBuffer(out.append, max_size=3)
+        b.add(1)
+        b.add(2)
+        assert out == [] and len(b) == 2
+        b.add(3)                   # size bound: flushed synchronously
+        assert out == [[1, 2, 3]] and len(b) == 0
+    run(main())
+
+
+def test_batch_buffer_tick_flush_collects_burst():
+    async def main():
+        out = []
+        b = BatchBuffer(out.append, max_size=64)
+        b.add("a")
+        b.add("b")
+        assert out == []           # nothing until the next loop tick
+        await asyncio.sleep(0)
+        await asyncio.sleep(0)
+        assert out == [["a", "b"]]
+        # a later add starts a fresh batch (handle was consumed)
+        b.add("c")
+        await asyncio.sleep(0)
+        await asyncio.sleep(0)
+        assert out == [["a", "b"], ["c"]]
+    run(main())
+
+
+def test_batch_buffer_timer_flush():
+    async def main():
+        out = []
+        b = BatchBuffer(out.append, max_size=64, max_wait=0.01)
+        b.add(7)
+        await asyncio.sleep(0.002)
+        assert out == []           # timer hasn't fired yet
+        await asyncio.sleep(0.02)
+        assert out == [[7]]
+    run(main())
+
+
+def test_batch_buffer_drain_and_no_loop_fallback():
+    async def main():
+        out = []
+        b = BatchBuffer(out.append, max_size=64)
+        b.add(1)
+        b.drain()
+        assert out == [[1]]
+        b.drain()                  # empty drain: no callback
+        assert out == [[1]]
+    run(main())
+    # outside any event loop: degrade to per-item flush, never buffer
+    out = []
+    b = BatchBuffer(out.append, max_size=64)
+    b.add("x")
+    assert out == [["x"]]
+
+
+def test_batch_buffer_metrics_counters():
+    from paxi_tpu.metrics import Registry
+
+    async def main():
+        reg = Registry(node="t")
+        b = BatchBuffer(lambda items: None, max_size=2, metrics=reg)
+        b.add(1)
+        b.add(2)                   # size flush
+        b.add(3)
+        await asyncio.sleep(0)
+        await asyncio.sleep(0)     # tick flush
+        snap = reg.snapshot()
+        flushes = {c["labels"]["cause"]: c["value"]
+                   for c in snap["counters"]
+                   if c["name"] == "paxi_batch_flushes_total"}
+        assert flushes.get("size") == 1 and flushes.get("tick") == 1
+        cmds = [c["value"] for c in snap["counters"]
+                if c["name"] == "paxi_batch_cmds_total"]
+        assert cmds == [3]
+        fills = [h for h in snap["histograms"]
+                 if h["name"] == "paxi_batch_fill"]
+        assert fills and fills[0]["count"] == 2
+    run(main())
+
+
+# ---- wire-level coalescing (codec + tcp transport) ----------------------
+def test_codec_batch_frame_roundtrip():
+    from paxi_tpu.host.codec import Codec
+    from paxi_tpu.protocols.paxos.host import P2a, P2b
+
+    for kind in ("json", "pickle"):
+        codec = Codec(kind)
+        msgs = [P2a(5, 0, [[1, b"v", "c", 1]]), P2b(5, 0, "1.2"),
+                P2a(6, 1, [])]
+        frame = codec.encode_batch(msgs)
+        body = frame[4:4 + Codec.frame_size(frame[:4])]
+        assert codec.decode_all(body) == msgs
+        # plain frames decode through the same entry point
+        plain = codec.encode(msgs[1])
+        assert codec.decode_all(plain[4:]) == [msgs[1]]
+    with pytest.raises(ValueError):
+        Codec("pickle").decode_all(bytes([Codec.BATCH]) + b"\x00\x00")
+
+
+def test_tcp_transport_coalesces_and_counts_queue_full():
+    """A send burst crosses the wire as one BATCH frame (counted), and
+    overflowing the outbound queue drops observably (queue_full)."""
+    from paxi_tpu.host.codec import Codec
+    from paxi_tpu.host.transport import TCPTransport, listen
+    from paxi_tpu.protocols.paxos.host import P2b
+
+    async def main():
+        codec = Codec("pickle")
+        got, coalesced, dropped = [], [], []
+        server = await listen("tcp://127.0.0.1:18841", got.append, codec)
+        t = TCPTransport("tcp://127.0.0.1:18841", codec, buffer_size=8,
+                         on_drop=lambda m, r: dropped.append(r),
+                         on_coalesce=coalesced.append)
+        # enqueue a burst BEFORE dialing: the drain task wakes once and
+        # must ship the backlog as one coalesced frame
+        for i in range(8):
+            t.send(P2b(1, i, "1.1"))
+        t.send(P2b(1, 99, "1.1"))          # queue full: dropped
+        assert dropped == ["queue_full"]
+        await t.dial()
+        for _ in range(200):
+            if len(got) == 8:
+                break
+            await asyncio.sleep(0.01)
+        assert [m.slot for m in got] == list(range(8))  # FIFO kept
+        assert coalesced and sum(coalesced) == 8
+        await t.close()
+        server.close()
+    run(main())
+
+
+# ---- batched commits through the cluster --------------------------------
+async def _submit(replica, key, value, cid, cmd_id):
+    fut = asyncio.get_running_loop().create_future()
+    replica.handle_client_request(Request(
+        command=Command(key, value, cid, cmd_id), reply_to=fut))
+    return fut
+
+
+def test_same_tick_commands_share_one_slot():
+    """Commands arriving in one event-loop tick ride one batch: one
+    slot, one P2a round, per-command replies."""
+    async def main():
+        c = Cluster("paxos", n=3, http=False)
+        await c.start()
+        try:
+            r0 = c["1.1"]
+            # elect first so the batch path (leader) is what we test
+            f0 = await _submit(r0, 0, b"seed", "c", 1)
+            await asyncio.wait_for(f0, 5)
+            slots_before = r0.slot
+            futs = [await _submit(r0, 10 + i, b"v%d" % i, "c", 2 + i)
+                    for i in range(8)]
+            for f in futs:
+                rep: Reply = await asyncio.wait_for(f, 5)
+                assert rep.err is None
+            assert r0.slot == slots_before + 1   # ONE slot for all 8
+            e = r0.log[r0.slot]
+            assert len(e.cmds) == 8 and e.commit
+            await asyncio.sleep(0.05)
+            for i in c.ids:
+                for j in range(8):
+                    assert c[i].db.get(10 + j) == b"v%d" % j, (i, j)
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_drop_mid_batch_never_commits_partial_batch():
+    """Fabric-replayed batch-boundary fault test: dropping a batch's
+    P2a towards one follower must not affect the batch (quorum via the
+    other); dropping it towards BOTH followers must leave the batch
+    entirely uncommitted — no replica may ever execute a strict subset
+    of a batch."""
+    from paxi_tpu.host.fabric import VirtualClockFabric
+    from paxi_tpu.trace.host import SeqFault, SeqSchedule
+
+    async def main():
+        # occurrence indexing on edge 1.1->1.2 / 1.1->1.3, class P2a:
+        #   occ 0: the election-seeding batch (proposed during the
+        #          step-2 settle, when the P1b quorum lands) — untouched
+        #   occ 1: batch A (injected step 3)              — drop to 1.2
+        #   occ 2: batch B (injected step 5)              — drop to BOTH
+        sched = SeqSchedule(n_steps=10, faults=[
+            SeqFault("1.1", "1.2", "P2a", occurrence=1, action="drop"),
+            SeqFault("1.1", "1.2", "P2a", occurrence=2, action="drop"),
+            SeqFault("1.1", "1.3", "P2a", occurrence=2, action="drop"),
+        ])
+        fabric = VirtualClockFabric(sched)
+        c = Cluster("paxos", n=3, http=False, fabric=fabric)
+        await c.start()
+        r0 = c["1.1"]
+        replies = {"A": [], "B": []}
+
+        def driver(t: int) -> None:
+            if t == 0:
+                r0.handle_client_request(Request(
+                    command=Command(0, b"seed", "c", 1),
+                    reply_to=lambda rep: None))
+            elif t == 3:
+                for i in range(4):
+                    r0.handle_client_request(Request(
+                        command=Command(10 + i, b"a%d" % i, "c", 2 + i),
+                        reply_to=replies["A"].append))
+            elif t == 5:
+                for i in range(4):
+                    r0.handle_client_request(Request(
+                        command=Command(20 + i, b"b%d" % i, "c", 6 + i),
+                        reply_to=replies["B"].append))
+
+        fabric.on_step(driver)
+        try:
+            await fabric.run(10, drain=True)
+            # batch A: quorum survived the single-edge drop — all four
+            # commands committed, executed everywhere, all replies in
+            assert len(replies["A"]) == 4
+            assert all(rep.err is None for rep in replies["A"])
+            for i in c.ids:
+                for j in range(4):
+                    assert c[i].db.get(10 + j) == b"a%d" % j, (i, j)
+            # batch B: P2a never reached a quorum — NOT committed, and
+            # crucially NOT PARTIALLY executed anywhere (atomicity:
+            # all-or-nothing at every replica)
+            assert replies["B"] == []
+            for i in c.ids:
+                got = [j for j in range(4)
+                       if c[i].db.get(20 + j) is not None]
+                assert got == [], (i, got)
+            e = r0.log[r0.slot]
+            assert not e.commit and len(e.cmds) == 4
+        finally:
+            await c.stop()
+    run(main())
+
+
+# ---- open-loop generator + linearizability oracle -----------------------
+def test_open_loop_benchmark_linearizable():
+    """A small open-loop ramp through the real HTTP stack: offered
+    load is met, per-command history checks linearizable, and the
+    cluster's batch counters prove the batched path carried it."""
+    from paxi_tpu.host.benchmark import OpenLoopBenchmark
+
+    async def main():
+        cfg = local_config(3, base_port=18860)
+        cfg.addrs = {i: f"chan://olbench/{i}" for i in cfg.addrs}
+        c = Cluster("paxos", cfg=cfg, http=True)
+        await c.start()
+        try:
+            bench = OpenLoopBenchmark(cfg, rates=[400], step_s=1.5,
+                                      conns=2, seed=3, K=64)
+            rep = await bench.run()
+            s = rep["steps"][0]
+            assert s["errors"] == 0 and s["shed"] == 0, s
+            assert s["completed"] == s["submitted"] > 0
+            assert rep["anomalies"] == 0
+            assert rep["history_ops"] == s["completed"]
+            flushes = sum(
+                cc["value"]
+                for cc in c["1.1"].metrics.snapshot()["counters"]
+                if cc["name"] == "paxi_batch_flushes_total")
+            assert flushes > 0
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_open_loop_client_batched_transactions():
+    """ops_per_req > 1: commands ride the Transaction surface, one
+    slot per request batch, per-command history still linearizable."""
+    from paxi_tpu.host.benchmark import OpenLoopBenchmark
+
+    async def main():
+        cfg = local_config(3, base_port=18880)
+        cfg.addrs = {i: f"chan://olbatch/{i}" for i in cfg.addrs}
+        c = Cluster("paxos", cfg=cfg, http=True)
+        await c.start()
+        try:
+            bench = OpenLoopBenchmark(cfg, rates=[600], step_s=1.5,
+                                      conns=2, seed=4, K=64,
+                                      ops_per_req=8)
+            rep = await bench.run()
+            s = rep["steps"][0]
+            assert s["errors"] == 0, s
+            assert s["completed"] > 0 and s["completed"] % 8 == 0
+            assert rep["anomalies"] == 0
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_leader_reads_linearizable_and_off_replication_path():
+    """cfg.leader_reads: reads answer at the execute barrier — fresh
+    values, zero anomalies, and no read ever occupies a log slot."""
+    async def main():
+        cfg = local_config(3, base_port=18890)
+        cfg.addrs = {i: f"chan://olreads/{i}" for i in cfg.addrs}
+        cfg.leader_reads = True
+        c = Cluster("paxos", cfg=cfg, http=False)
+        await c.start()
+        try:
+            r0 = c["1.1"]
+            w = await _submit(r0, 5, b"v1", "c", 1)
+            await asyncio.wait_for(w, 5)
+            slots_after_write = r0.slot
+            g = await _submit(r0, 5, b"", "c", 2)
+            rep: Reply = await asyncio.wait_for(g, 5)
+            assert rep.err is None and rep.value == b"v1"
+            assert r0.slot == slots_after_write   # read took no slot
+            # read-your-write across a same-tick write+read batch
+            w2 = await _submit(r0, 5, b"v2", "c", 3)
+            g2 = await _submit(r0, 5, b"", "c", 4)
+            await asyncio.wait_for(w2, 5)
+            rep2: Reply = await asyncio.wait_for(g2, 5)
+            assert rep2.value == b"v2"
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_closed_loop_warmup_split():
+    """Bconfig.warmup: completions inside the window are reported
+    separately and steady-state ops/s uses the post-warmup window."""
+    from paxi_tpu.host.benchmark import Stats
+
+    s = Stats(ops=100, errors=0, duration=4.0, warmup_s=1.0,
+              warmup_ops=40)
+    out = s.summary()
+    assert out["throughput_ops_s"] == pytest.approx(100 / 3.0, abs=0.05)
+    assert out["warmup_ops"] == 40 and out["total_ops"] == 140
+    # warmup disabled: no split keys, full-window rate (old behavior)
+    out2 = Stats(ops=100, errors=0, duration=4.0).summary()
+    assert out2["throughput_ops_s"] == 25.0
+    assert "warmup_ops" not in out2

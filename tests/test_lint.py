@@ -140,18 +140,24 @@ def test_concurrency_fixture():
         ("PXC401", "self.count"),       # inline_escaped (raw: engine
                                         # suppression is tested below)
         ("PXC401", "self.items"),       # bad_item_write (post-with)
+        ("PXC402", "self._items.append(...)"),  # BatchLike.add_racy
         ("PXC402", "self.items.append(...)"),   # bad_mutate
         # stage-2 deepening: deferred callbacks + alias mutations
+        ("PXC451", "self._items.clear(...)"),   # BatchLike.add_racy's
+                                                # scheduled lambda
         ("PXC451", "self.count"),               # deferred.cb (returned)
         ("PXC451", "self.items.clear(...)"),    # register's lambda
         ("PXC451", "self.items.pop(...)"),      # returned lambda
         ("PXC452", "d.append(...)"),            # alias_race
+        ("PXC452", "items.clear(...)"),         # BatchLike.flush_racy
     ]
     msgs = " | ".join(v.message for v in vs)
     # negative controls: a callback that takes the lock itself and a
-    # synchronous lambda stay clean
+    # synchronous lambda stay clean — and the real batch-buffer shape
+    # (swap under lock, flush callback outside) is clean too
     assert "locked_callback_is_fine" not in msgs
     assert "sync_lambda_is_fine" not in msgs
+    assert "add_ok" not in msgs and "flush_ok" not in msgs
 
 
 def test_concurrency_repo_tree_is_clean():
@@ -285,7 +291,7 @@ def test_inline_disable_comment_suppresses():
                         if "disable=PXC401" in l)
     assert (escaped_line, "inline") in dropped
     assert escaped_line not in kept
-    assert len(kept) == 7      # everything seeded except the escape
+    assert len(kept) == 10     # everything seeded except the escape
 
 
 def test_baseline_parse_and_match(tmp_path):
