@@ -43,6 +43,27 @@ Checks:
 - **PXQ503** a sim-kernel quorum threshold pair (``cfg.majority`` /
   ``cfg.fast_size`` aliases, zone-grid thresholds) can fail to
   intersect
+- **PXQ504** a rectangular-grid (rowcol) read x write pair can fail
+  to intersect — the BPaxos quorum system, and the first non-majority
+  system this rule proves.  The grid is also the *thrifty* variant
+  (messages go to exactly the quorum), so this check subsumes the
+  thrifty-quorum obligation PR 5 left open: a thrifty write is safe
+  iff the minimal sets themselves intersect, which is precisely what
+  is enumerated here.  Two forms:
+
+  - sim kernels: write sites compare a ``*_row_quorums`` tally, read
+    sites a ``*_col_quorums`` tally; the per-line *fullness* threshold
+    is DERIVED from the tally helper's own body (``per >= GC``) and
+    must demand complete lines — a full row and a full column of one
+    grid always share exactly one cell, but a row short one cell can
+    dodge a column, which is the counterexample the message carries;
+  - host replicas: ``Quorum.grid_row(cols)`` x ``Quorum.grid_col(cols)``
+    call pairs on one universe; the predicates are modeled as
+    complete-line tests (their bodies — core/quorum.py — are covered
+    by a runtime structural test), so the proof obligation is that
+    both sites derive the grid from the SAME ``cols`` expression for
+    every geometry; a mismatch re-shapes the grid between read and
+    write and loses the shared cell.
 """
 
 from __future__ import annotations
@@ -91,6 +112,10 @@ class Predicates:
     count: Dict[str, Callable[[int], Optional[int]]]
     # zone-structured predicates (modeled, not derived): name -> phase
     grid: Dict[str, FrozenSet[str]]
+    # rectangular-grid predicates (modeled as complete-line tests;
+    # core/quorum.py's bodies are covered by a runtime structural
+    # test): name -> phase ("write" = row, "read" = column)
+    rowcol: Dict[str, str]
     # module-level size helpers usable in thresholds:
     # name -> (params, return expr)
     funcs: Dict[str, Tuple[List[str], ast.expr]]
@@ -142,7 +167,8 @@ def load_predicates(root: Path) -> Predicates:
 
             count[item.name] = mk()
     grid = {"grid_q1": PHASE1, "grid_q2": PHASE2}
-    return Predicates(count=count, grid=grid, funcs=funcs)
+    rowcol = {"grid_row": "write", "grid_col": "read"}
+    return Predicates(count=count, grid=grid, rowcol=rowcol, funcs=funcs)
 
 
 def load_sim_props(root: Path) -> Dict[str, Callable[[int],
@@ -244,7 +270,7 @@ class Resolver:
 
 @dataclass
 class Site:
-    kind: str                 # "count" | "grid"
+    kind: str                 # "count" | "grid" | "rowcol"
     line: int
     col: int
     text: str
@@ -253,7 +279,13 @@ class Site:
     # count: universe size n -> min quorum size
     size_fn: Optional[Callable[[int], Optional[int]]] = None
     # grid: (zones, grid_q2 knob) -> zone-majorities required
+    # rowcol/sim: (rows, cols) -> complete lines required
+    # rowcol/host: (rows, cols) -> the site's resolved ``cols`` arg
     zones_fn: Optional[Callable[[int, int], Optional[int]]] = None
+    # rowcol/sim only: (rows, cols) -> cells per counted line, derived
+    # from the ``*_row_quorums``/``*_col_quorums`` helper body — the
+    # fullness the intersection proof hinges on
+    fill_fn: Optional[Callable[[int, int], Optional[int]]] = None
     resolved: bool = True
     why_unresolved: str = ""
 
@@ -365,6 +397,12 @@ def _grid_env(z: int, q2: int) -> Dict[str, Fraction]:
             "self.cfg.grid_q2": Fraction(q2)}
 
 
+def _rowcol_env(rows: int, cols: int) -> Dict[str, Fraction]:
+    fr, fc = Fraction(rows), Fraction(cols)
+    return {"cfg.grid_rows": fr, "self.cfg.grid_rows": fr,
+            "cfg.grid_cols": fc, "self.cfg.grid_cols": fc}
+
+
 def host_sites(tree: ast.Module, preds: Predicates,
                resolver: Resolver) -> List[Site]:
     universes = _universes(tree)
@@ -396,6 +434,16 @@ def host_sites(tree: ast.Module, preds: Predicates,
                 else None
         return zones
 
+    def rowcol_fn(expr: ast.expr) -> Callable[[int, int], Optional[int]]:
+        def cols(rows: int, cols_: int) -> Optional[int]:
+            ev = flow.SymEval(dict(_rowcol_env(rows, cols_),
+                                   **_count_env(rows * cols_)),
+                              resolve=resolver, funcs=preds.funcs)
+            v = ev.eval(expr)
+            return int(v) if v is not None and v.denominator == 1 \
+                else None
+        return cols
+
     for node in ast.walk(tree):
         # predicate calls: X.majority(), e.quorum.grid_q2(self.q2), ...
         if isinstance(node, ast.Call) and \
@@ -405,6 +453,27 @@ def host_sites(tree: ast.Module, preds: Predicates,
             tail = recv.attr if isinstance(recv, ast.Attribute) else (
                 recv.id if isinstance(recv, ast.Name) else "")
             fn_name = owner.get(id(node), "")
+            if pred in preds.rowcol:
+                site = Site(kind="rowcol", line=node.lineno,
+                            col=node.col_offset,
+                            text=ast.unparse(node),
+                            universe=" | ".join(sorted(
+                                universes.get(tail, {"cfg.ids"}))),
+                            phases=frozenset({preds.rowcol[pred]}))
+                if node.args:
+                    site.zones_fn = rowcol_fn(node.args[0])
+                    if site.zones_fn(2, 3) is None:
+                        site.resolved = False
+                        site.why_unresolved = (
+                            f"grid `cols` argument "
+                            f"`{ast.unparse(node.args[0])}` does not "
+                            "evaluate symbolically")
+                else:
+                    site.resolved = False
+                    site.why_unresolved = "grid predicate without a " \
+                                          "cols argument"
+                sites.append(site)
+                continue
             if pred in preds.grid:
                 site = Site(kind="grid", line=node.lineno,
                             col=node.col_offset,
@@ -515,6 +584,49 @@ def _check_grid_pair(a: Site, b: Site) -> Optional[Tuple[int, int, int]]:
     return None
 
 
+def _check_rowcol_pair(a: Site, b: Site) -> Optional[Tuple[int, int, str]]:
+    """Grid read x write intersection over every rows x cols geometry.
+
+    A set of COMPLETE rows and a set of COMPLETE columns of one grid
+    always share a cell (row i x column j meet at (i, j)), so the
+    obligations are: at least one line on each side, derived fullness
+    (sim tallies must count only full lines), and — host form — both
+    predicates shaping the grid with the same ``cols``.  Returns
+    (rows, cols, why) for the first geometry that breaks one."""
+    w, r = (a, b) if "write" in a.phases else (b, a)
+    for gr in range(1, MAX_Z + 1):
+        for gc in range(1, MAX_Z + 1):
+            if w.fill_fn is not None and r.fill_fn is not None:
+                tw, fw = w.zones_fn(gr, gc), w.fill_fn(gr, gc)
+                tr, fr = r.zones_fn(gr, gc), r.fill_fn(gr, gc)
+                if None in (tw, fw, tr, fr):
+                    continue
+                if tw < 1 or tr < 1:
+                    return (gr, gc, "a quorum satisfiable with ZERO "
+                            f"complete lines ({tw} rows / {tr} columns "
+                            "required)")
+                if tw > gr or tr > gc:
+                    continue   # unsatisfiable: nothing ever commits
+                if fw < gc:
+                    return (gr, gc, f"write rows count as complete at "
+                            f"{fw}/{gc} cells — a short row dodges "
+                            "column " f"{fw}")
+                if fr < gr:
+                    return (gr, gc, f"read columns count as complete "
+                            f"at {fr}/{gr} cells — a short column "
+                            f"dodges row {fr}")
+            else:
+                cw, cr = w.zones_fn(gr, gc), r.zones_fn(gr, gc)
+                if cw is None or cr is None:
+                    continue
+                if cw != cr:
+                    return (gr, gc, "grid geometry mismatch: "
+                            f"grid_row(cols={cw}) vs "
+                            f"grid_col(cols={cr}) re-shape the grid "
+                            "between write and read")
+    return None
+
+
 def _pair_violations(sites: List[Site], relpath: str,
                      code: str, scope: str) -> List[Violation]:
     out: List[Violation] = []
@@ -528,6 +640,26 @@ def _pair_violations(sites: List[Site], relpath: str,
             for b in group[i + 1:]:
                 if a.kind != b.kind or not _owes_intersection(a, b):
                     continue
+                key = (a.line, b.line)
+                if key in seen:
+                    continue
+                if a.kind == "rowcol":
+                    bad_rc = _check_rowcol_pair(a, b)
+                    if bad_rc is None:
+                        continue
+                    seen.add(key)
+                    gr, gc, why = bad_rc
+                    out.append(Violation(
+                        rule=RULE, code="PXQ504", path=relpath,
+                        line=a.line, col=a.col,
+                        message=(
+                            f"{scope} grid quorums `{a.text}` (line "
+                            f"{a.line}, {'/'.join(sorted(a.phases))}) "
+                            f"and `{b.text}` (line {b.line}, "
+                            f"{'/'.join(sorted(b.phases))}) on "
+                            f"universe `{univ}` can fail to intersect "
+                            f"at a {gr}x{gc} grid: {why}")))
+                    continue
                 if a.kind == "count":
                     bad = _check_count_pair(a, b)
                     unit = "sizes"
@@ -535,9 +667,6 @@ def _pair_violations(sites: List[Site], relpath: str,
                     bad = _check_grid_pair(a, b)
                     unit = "zone-quorums"
                 if bad is None:
-                    continue
-                key = (a.line, b.line)
-                if key in seen:
                     continue
                 seen.add(key)
                 n, sa, sb = bad
@@ -560,14 +689,47 @@ def _pair_violations(sites: List[Site], relpath: str,
 # ---------------------------------------------------------------------------
 
 
+def _line_fullness(tree: ast.Module, helper: str, resolver: Resolver
+                   ) -> Optional[Callable[[int, int], Optional[int]]]:
+    """Derive a ``*_row_quorums``/``*_col_quorums`` helper's per-line
+    fullness threshold from its own body: the single ``per >= K``
+    comparison deciding when a line counts as complete.  Returns None
+    when the body has no unique derivable comparison — the site is
+    then reported (PXQ502), not silently trusted."""
+    fn = next((n for n in tree.body
+               if isinstance(n, astutil.FuncNode) and n.name == helper),
+              None)
+    if fn is None:
+        return None
+    cmps = [n for n in ast.walk(fn)
+            if isinstance(n, ast.Compare) and len(n.ops) == 1
+            and isinstance(n.ops[0], (ast.GtE, ast.Gt))]
+    if len(cmps) != 1:
+        return None
+    thr = cmps[0].comparators[0]
+    strict = isinstance(cmps[0].ops[0], ast.Gt)
+
+    def fill(rows: int, cols: int) -> Optional[int]:
+        ev = flow.SymEval(_rowcol_env(rows, cols), resolve=resolver)
+        v = ev.eval(thr)
+        if v is None or v.denominator != 1:
+            return None
+        return int(v) + (1 if strict else 0)
+
+    return fill
+
+
 def sim_sites(tree: ast.Module,
               props: Dict[str, Callable[[int], Optional[int]]],
               resolver: Resolver) -> List[Site]:
     """Quorum thresholds a sim kernel consumes: aliases of the
-    SimConfig-derived sizes (``MAJ = cfg.majority``) and zone-grid
-    thresholds compared against ``*_zone_quorums(...)`` tallies."""
+    SimConfig-derived sizes (``MAJ = cfg.majority``), zone-grid
+    thresholds compared against ``*_zone_quorums(...)`` tallies, and
+    rectangular-grid thresholds compared against ``*_row_quorums``/
+    ``*_col_quorums`` tallies (the BPaxos quorum system)."""
     sites: List[Site] = []
     zone_locals: Set[str] = set()
+    rowcol_locals: Dict[str, Tuple[str, str]] = {}  # name -> (phase, helper)
     for node in ast.walk(tree):
         if not isinstance(node, ast.Assign):
             continue
@@ -589,10 +751,57 @@ def sim_sites(tree: ast.Module,
                     kind="count", line=node.lineno, col=node.col_offset,
                     text=f"{t.id} = {dn}", universe="replicas",
                     phases=ANY_PHASE, size_fn=props[prop]))
-            if isinstance(v, ast.Call) and (
-                    astutil.dotted_name(v.func) or ""
-                    ).split(".")[-1].endswith("zone_quorums"):
-                zone_locals.add(t.id)
+            if isinstance(v, ast.Call):
+                callee = (astutil.dotted_name(v.func) or ""
+                          ).split(".")[-1]
+                if callee.endswith("zone_quorums"):
+                    zone_locals.add(t.id)
+                elif callee.endswith("row_quorums"):
+                    rowcol_locals[t.id] = ("write", callee)
+                elif callee.endswith("col_quorums"):
+                    rowcol_locals[t.id] = ("read", callee)
+    # compares of rowcol tallies against line-count thresholds: the
+    # lines-needed side comes from the compare, the per-line fullness
+    # from the tally helper's own body
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Compare) and len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.GtE, ast.Gt))):
+            continue
+        lhs_names = {n.id for n in ast.walk(node.left)
+                     if isinstance(n, ast.Name)}
+        hit = sorted(lhs_names & set(rowcol_locals))
+        if not hit:
+            continue
+        phase, helper = rowcol_locals[hit[0]]
+        thr = node.comparators[0]
+        strict = isinstance(node.ops[0], ast.Gt)
+
+        def lines_fn(e=thr, s=strict):
+            def lines(rows: int, cols: int) -> Optional[int]:
+                ev = flow.SymEval(_rowcol_env(rows, cols),
+                                  resolve=resolver)
+                v = ev.eval(e)
+                if v is None or v.denominator != 1:
+                    return None
+                return int(v) + (1 if s else 0)
+            return lines
+
+        site = Site(kind="rowcol", line=node.lineno,
+                    col=node.col_offset, text=ast.unparse(node),
+                    universe="grid", phases=frozenset({phase}),
+                    zones_fn=lines_fn(),
+                    fill_fn=_line_fullness(tree, helper, resolver))
+        if site.fill_fn is None:
+            site.resolved = False
+            site.why_unresolved = (
+                f"tally helper `{helper}` has no unique derivable "
+                "per-line completeness comparison")
+        elif site.zones_fn(2, 3) is None:
+            site.resolved = False
+            site.why_unresolved = (f"line-count threshold "
+                                   f"`{ast.unparse(thr)}` does not "
+                                   "evaluate symbolically")
+        sites.append(site)
     # compares of zone tallies against grid thresholds
     for node in ast.walk(tree):
         if not (isinstance(node, ast.Compare) and len(node.ops) == 1
@@ -665,8 +874,17 @@ def check_file(path: Path, root: Path, preds: Predicates,
             [s for s in sites if s.resolved], relpath, "PXQ501", "host"))
     else:
         sites = sim_sites(tree, props, resolver)
-        out.extend(_pair_violations(sites, relpath, "PXQ503",
-                                    "sim kernel"))
+        for s in sites:
+            if not s.resolved:
+                out.append(Violation(
+                    rule=RULE, code="PXQ502", path=relpath,
+                    line=s.line, col=s.col,
+                    message=f"unresolvable quorum site `{s.text}`: "
+                            f"{s.why_unresolved} — intersection cannot "
+                            "be proven, resolve or baseline it"))
+        out.extend(_pair_violations(
+            [s for s in sites if s.resolved], relpath, "PXQ503",
+            "sim kernel"))
     return out
 
 

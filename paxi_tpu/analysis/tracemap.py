@@ -55,10 +55,32 @@ def _module_to_path(module: str, root: Path) -> Path:
     return root / (module.replace(".", "/") + ".py")
 
 
+def _twin_of(root: Path, module: str) -> Optional[str]:
+    """A host twin module's ``TWIN_OF = "pkg.base_host"`` marker: the
+    module subclasses its base replica to seed a bug (e.g.
+    protocols/bpaxos/noread.py) and declares that its message classes,
+    maps and state vocabulary live in the base — so the map rules
+    analyze the base module instead of re-litigating the shim."""
+    path = _module_to_path(module, root)
+    if not path.exists():
+        return None
+    tree, _ = astutil.parse_file(path)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "TWIN_OF" \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            return node.value.value
+    return None
+
+
 def registry_pairs(root: Path) -> List[Tuple[str, str, str]]:
     """(protocol, sim module, host module) for every sim protocol whose
     trace projection resolves a host module — base protocols and
-    variants alike, deduplicated on (sim module, host module)."""
+    variants alike, deduplicated on (sim module, host module).
+    Host modules carrying a ``TWIN_OF`` marker resolve to their base
+    module first (seeded-bug twins dedup onto the base pair)."""
     tree, _ = astutil.parse_file(root / REGISTRY)
     sims = astutil.parse_module_dict(tree, "_SIM_MODULES")
     hosts = astutil.parse_module_dict(tree, "_HOST_MODULES")
@@ -82,6 +104,7 @@ def registry_pairs(root: Path) -> List[Tuple[str, str, str]]:
         host_mod = host_map.get(base)
         if host_mod is None:
             continue   # sim-only protocol (e.g. fragile_counter)
+        host_mod = _twin_of(root, host_mod) or host_mod
         key = (sim_mod, host_mod)
         if key not in seen:
             seen.add(key)

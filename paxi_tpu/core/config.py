@@ -89,6 +89,19 @@ class Config:
     # stable leader (the lease assumption); off by default, and the
     # benchmark's linearizability checker gates every run that uses it.
     leader_reads: bool = False
+    # the lease that makes ``leader_reads`` sound across elections
+    # (protocols/paxos/host.py): a leader serves barrier reads only
+    # within ``lease_s`` of its last quorum round's START, and a fresh
+    # leader fences its first proposals for ``lease_s`` so no write can
+    # commit while a deposed leader's lease may still be live.
+    # ``lease_s <= 0`` disables the lease (pre-PR-8 unfenced behavior).
+    lease_s: float = 0.2
+    # BPaxos compartmentalized tier (protocols/bpaxos): node-id role
+    # assignment over sorted(ids) — first ``n_proxies`` proxy leaders,
+    # next ``grid_rows * grid_cols`` the acceptor grid, rest replicas
+    n_proxies: int = 2
+    grid_rows: int = 2
+    grid_cols: int = 2
     benchmark: Bconfig = field(default_factory=Bconfig)
 
     # ---- derived topology helpers -------------------------------------
@@ -133,6 +146,10 @@ class Config:
         cfg.batch_size = lower.get("batchsize", lower.get("batch_size", cfg.batch_size))
         cfg.batch_wait = lower.get("batchwait", lower.get("batch_wait", cfg.batch_wait))
         cfg.leader_reads = lower.get("leaderreads", lower.get("leader_reads", cfg.leader_reads))
+        cfg.lease_s = lower.get("leases", lower.get("lease_s", cfg.lease_s))
+        cfg.n_proxies = lower.get("nproxies", lower.get("n_proxies", cfg.n_proxies))
+        cfg.grid_rows = lower.get("gridrows", lower.get("grid_rows", cfg.grid_rows))
+        cfg.grid_cols = lower.get("gridcols", lower.get("grid_cols", cfg.grid_cols))
         if "benchmark" in lower:
             cfg.benchmark = Bconfig.from_dict(lower["benchmark"])
         return cfg

@@ -76,6 +76,26 @@ class Quorum:
         zones, with q1 + q2 > #zones guaranteeing intersection."""
         return self.grid_q1(q2)
 
+    # ---- BPaxos rectangular grid (protocols/bpaxos) -------------------
+    # The id list (sorted acceptor ids) is read as a row-major
+    # rows x cols grid: id index i sits at (i // cols, i % cols).  The
+    # write quorum is ONE FULL ROW, the read quorum ONE FULL COLUMN —
+    # any row and any column of the same grid share exactly one cell,
+    # so every read/write pair intersects structurally (paxi-lint's
+    # PXQ rowcol proof checks both sites derive the grid from the same
+    # ``cols``, and that the predicates demand complete lines).  This
+    # is also the *thrifty* grid: a proposer messages exactly the
+    # quorum, never the whole acceptor set.
+    def grid_row(self, cols: int) -> bool:
+        """BPaxos write/accept quorum: every member of >= 1 grid row."""
+        rows = [self.ids[i:i + cols] for i in range(0, self.n, cols)]
+        return any(all(m in self.acks for m in row) for row in rows)
+
+    def grid_col(self, cols: int) -> bool:
+        """BPaxos read/recovery quorum: every member of >= 1 column."""
+        return any(all(m in self.acks for m in self.ids[c::cols])
+                   for c in range(cols))
+
 
 def majority_size(n: int) -> int:
     return n // 2 + 1

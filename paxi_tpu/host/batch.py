@@ -40,7 +40,11 @@ class BatchBuffer:
 
     def __init__(self, flush_fn: Callable[[List[Any]], None],
                  max_size: int = 64, max_wait: float = 0.0,
-                 metrics: Optional[Registry] = None):
+                 metrics: Optional[Registry] = None, **labels: str):
+        """``labels`` become extra metric dimensions — the commit path
+        uses none (its metric identity predates them), the forwarding
+        path tags ``path="forward"`` so the two pipelines stay
+        separable in /metrics."""
         self._lock = threading.Lock()
         self._flush_fn = flush_fn
         self._items: List[Any] = []
@@ -49,10 +53,11 @@ class BatchBuffer:
         self.max_size = max(int(max_size), 1)
         self.max_wait = float(max_wait)
         reg = metrics if metrics is not None else Registry()
-        self._fill_hist = reg.histogram("paxi_batch_fill")
-        self._cmds_total = reg.counter("paxi_batch_cmds_total")
+        self._fill_hist = reg.histogram("paxi_batch_fill", **labels)
+        self._cmds_total = reg.counter("paxi_batch_cmds_total", **labels)
         self._flush_counters = {
-            cause: reg.counter("paxi_batch_flushes_total", cause=cause)
+            cause: reg.counter("paxi_batch_flushes_total", cause=cause,
+                               **labels)
             for cause in ("size", "tick", "timer", "drain")}
 
     def __len__(self) -> int:

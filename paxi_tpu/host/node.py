@@ -27,6 +27,7 @@ from paxi_tpu.core.command import Command, Reply, Request
 from paxi_tpu.core.config import Config
 from paxi_tpu.core.db import Database
 from paxi_tpu.core.ident import ID
+from paxi_tpu.host.batch import BatchBuffer
 from paxi_tpu.host.codec import Codec, register_message
 from paxi_tpu.host.http import HTTPServer
 from paxi_tpu.host.socket import Socket
@@ -63,6 +64,21 @@ class WireReply:
     seq: int = 0
 
 
+@register_message
+@dataclass
+class WireRequestBatch:
+    """A burst of forwarded requests coalesced into ONE frame
+    (HT-Paxos's lever applied to the follower->leader path): the
+    per-destination forward buffer drains every ``WireRequest`` that
+    arrived in the current event-loop burst into a single send, so a
+    follower under client load costs the leader one frame per tick
+    instead of one per command.  A lone forward still travels as a
+    bare ``WireRequest`` (no frame overhead, and recorded-trace drop
+    directives keep their per-message aim)."""
+
+    items: list = field(default_factory=list)   # List[WireRequest]
+
+
 class Node:
     def __init__(self, id: ID, cfg: Config, codec: Optional[Codec] = None,
                  fabric=None):
@@ -87,8 +103,12 @@ class Node:
             "paxi_client_requests_total")
         self._fwd_seq = 0
         self._fwd_pending: Dict[int, Request] = {}
+        # per-destination forward coalescing (host/batch.py): tick-mode
+        # only — a forward must never wait on a wall timer
+        self._fwd_buf: Dict[ID, BatchBuffer] = {}
         self._tasks: list = []
         self.register(WireRequest, self._handle_wire_request)
+        self.register(WireRequestBatch, self._handle_wire_request_batch)
         self.register(WireReply, self._handle_wire_reply)
 
     # ---- plugin boundary (node.go Register) ----------------------------
@@ -177,17 +197,36 @@ class Node:
 
     def forward(self, to: ID, req: Request) -> None:
         """Reference: node.go Forward — relay to ``to`` (e.g. the leader),
-        remember the pending reply slot."""
+        remember the pending reply slot.  Forwards coalesce through a
+        per-destination BatchBuffer: every request of one event-loop
+        burst rides a single ``WireRequestBatch`` frame."""
         self.metrics.counter("paxi_forwards_total").inc()
         self._fwd_seq += 1
         seq = self._fwd_seq
         self._fwd_pending[seq] = req
         c = req.command
-        self.socket.send(to, WireRequest(
+        wr = WireRequest(
             key=c.key, value=c.value, client_id=c.client_id,
             command_id=c.command_id, properties=dict(req.properties),
             timestamp=req.timestamp or time.time(),
-            node_id=str(self.id), seq=seq))
+            node_id=str(self.id), seq=seq)
+        buf = self._fwd_buf.get(to)
+        if buf is None:
+            buf = self._fwd_buf[to] = BatchBuffer(
+                lambda items, _to=to: self._flush_forwards(_to, items),
+                max_size=self.cfg.batch_size, max_wait=0.0,
+                metrics=self.metrics, path="forward")
+        buf.add(wr)
+
+    def _flush_forwards(self, to: ID, items: list) -> None:
+        if len(items) == 1:
+            self.socket.send(to, items[0])
+        else:
+            self.socket.send(to, WireRequestBatch(items))
+
+    def _handle_wire_request_batch(self, m: WireRequestBatch) -> None:
+        for item in m.items:
+            self._handle_wire_request(item)
 
     def _handle_wire_request(self, m: WireRequest) -> None:
         """A forwarded request arrives: synthesize a Request whose reply

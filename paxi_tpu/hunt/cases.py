@@ -68,6 +68,11 @@ CASES: List[Case] = [
     ("blockchain", SimConfig(n_replicas=5, n_slots=32,
                              steal_threshold=4),
      [DROP, DUP, PART], 64, 200, "committed_slots"),
+    # compartmentalized tier: 2 proxies + 2x2 acceptor grid + 1
+    # executor; KILL (node 0 = proxy 0) forces takeover recovery —
+    # the grid's column-read path — to keep the stripe progressing
+    ("bpaxos", SimConfig(n_replicas=7, n_slots=16),
+     [DROP, DUP, PART, KILL], 32, 140, "committed_slots"),
 ]
 
 # the seeded-bug demo case (fuzz_soak --seed-bug): EXPECTED to violate —
@@ -83,6 +88,11 @@ DEMO_CASES: List[Case] = [
     ("fragile_counter", SimConfig(n_replicas=3), [DROP], 8, 30,
      "delivered"),
     BUG_DEMO,
+    # bpaxos takeover-without-read twin: both runtimes share the bug
+    # (noread.py), so its witnesses must classify as REPRODUCED —
+    # the pipeline's end-to-end control for a full protocol
+    ("bpaxos_noread", SimConfig(n_replicas=7, n_slots=16),
+     [DROP], 16, 80, "committed_slots"),
 ]
 
 

@@ -174,7 +174,14 @@ def classify(sim_violations: int, coverage: dict,
 
 
 # ---- the impure half: virtual-clock host replay -------------------------
-async def replay_witness(trace: Trace, *, tail_steps: int = 10,
+# tail_steps: the fault-free logical tail after the replayed schedule.
+# 10 steps let in-flight request/reply rounds settle, which is all most
+# protocols need; a protocol whose *evidence-driven* repair path must
+# converge post-schedule (bpaxos's gap-strike takeover needs several
+# commits to strike, recover the hole, and surface the divergence the
+# schedule set up) declares a longer tail via ``HUNT_TAIL_STEPS`` so
+# every other protocol's replay doesn't pay for it.
+async def replay_witness(trace: Trace, *, tail_steps: Optional[int] = None,
                          op_every: int = 2, op_timeout: float = 5.0
                          ) -> HostOutcome:
     """Replay ``trace``'s schedule against the host runtime on the
@@ -184,7 +191,9 @@ async def replay_witness(trace: Trace, *, tail_steps: int = 10,
     - ``HUNT_DRIVER(cluster, fabric)``: install a protocol-specific
       per-step driver instead of the default KV workload;
     - ``HUNT_ORACLE(cluster) -> int``: a safety-violation counter read
-      after the replay (in addition to the history checker).
+      after the replay (in addition to the history checker);
+    - ``HUNT_TAIL_STEPS``: fault-free tail length after the schedule
+      (default 10; see the note above).
     """
     from paxi_tpu.host.fabric import VirtualClockFabric
     from paxi_tpu.host.history import History
@@ -203,6 +212,8 @@ async def replay_witness(trace: Trace, *, tail_steps: int = 10,
     cluster = Cluster(algorithm, cfg=cfg, http=False, fabric=fabric)
     await cluster.start()
     host_mod = importlib.import_module(_HOST_MODULES[algorithm])
+    if tail_steps is None:
+        tail_steps = getattr(host_mod, "HUNT_TAIL_STEPS", 10)
     out = HostOutcome(steps=sched.n_steps)
     history = None
     ops: list = []

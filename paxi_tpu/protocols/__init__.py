@@ -25,12 +25,17 @@ _SIM_MODULES = {
     "sdpaxos": "paxi_tpu.protocols.sdpaxos.sim",
     "wankeeper": "paxi_tpu.protocols.wankeeper.sim",
     "blockchain": "paxi_tpu.protocols.blockchain.sim",
-    # trace-subsystem plumbing (NOT correctness cases — both violate by
-    # design): the fragile demo kernel and the seeded WanKeeper bug
-    # twin that mirrors the host runtime's pre-fix dropped-Grant flaw.
+    "bpaxos": "paxi_tpu.protocols.bpaxos.sim",
+    # trace-subsystem plumbing (NOT correctness cases — all violate by
+    # design): the fragile demo kernel and the seeded bug twins.
     # ":ATTR" selects a non-default protocol symbol in the module.
     "fragile_counter": "paxi_tpu.trace.demo",
     "wankeeper_nofloor": "paxi_tpu.protocols.wankeeper.sim:PROTOCOL_NOFLOOR",
+    # seeded-bug twin WITH a matching host twin (noread.py): takeover
+    # recovery skips the grid's column read on BOTH runtimes, so its
+    # witnesses are the hunt pipeline's "reproduced" positive control
+    # for a real protocol (fragile_counter covers the demo kernel)
+    "bpaxos_noread": "paxi_tpu.protocols.bpaxos.sim:PROTOCOL_NOREAD",
 }
 
 _HOST_MODULES = {
@@ -47,6 +52,8 @@ _HOST_MODULES = {
     "sdpaxos": "paxi_tpu.protocols.sdpaxos.host",
     "wankeeper": "paxi_tpu.protocols.wankeeper.host",
     "blockchain": "paxi_tpu.protocols.blockchain.host",
+    "bpaxos": "paxi_tpu.protocols.bpaxos.host",
+    "bpaxos_noread": "paxi_tpu.protocols.bpaxos.noread",
 }
 
 

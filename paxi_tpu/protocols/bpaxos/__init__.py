@@ -1,0 +1,5 @@
+"""Bipartisan/compartmentalized Paxos: decoupled proxy-leader /
+acceptor-grid / replica roles with HT-Paxos batched accepts.  ``sim``
+is the lane-major TPU kernel, ``host`` the asyncio deployment runtime,
+``noread`` the seeded-bug hunt twin (recovery without the column
+read)."""

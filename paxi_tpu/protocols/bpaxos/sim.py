@@ -1,0 +1,638 @@
+"""Compartmentalized high-throughput Paxos (BPaxos) as a TPU kernel.
+
+Reference: "Bipartisan Paxos: A Modular State Machine Replication
+Protocol" + "HT-Paxos" (PAPERS.md) — decouple the monolithic replica
+into roles that scale out independently, and amortize one quorum round
+over a *batch* of client commands:
+
+- **proxy leaders** (nodes ``0..P-1``): own disjoint slot stripes
+  (slot ``s`` belongs to proxy ``s % P``), accept client command
+  batches and drive phase-2, one grid round per slot;
+- **acceptor grid** (the next ``GR x GC`` nodes, row-major): the first
+  protocol in this repo whose quorum system is NOT a simple majority —
+  the write quorum is ONE FULL ROW (``GC`` acceptors), the read
+  quorum ONE FULL COLUMN (``GR`` acceptors); any row and any column
+  share exactly one cell, so every read/write pair intersects
+  (``paxi-lint``'s PXQ rowcol proof derives this from the tallies
+  below);
+- **replica executors** (the rest): learn commits (P3), execute the
+  contiguous prefix, and answer clients.
+
+TPU re-design (not a translation):
+- lane-major batch layout (sim/lanes.py): state ``(R, G)`` /
+  ``(R, S, G)``, mailbox planes ``(src, dst, G)``; roles are static
+  index masks over one node axis, so every handler is a masked update
+  on the whole grid at once.
+- per-slot ballots (BPaxos instances are independent): acceptors keep
+  a promised-ballot ring ``abal`` next to the accepted value
+  ``(vbal, vcmd, vbsz)``; there is no global leader and no election —
+  steady state is phase-2 only.
+- **HT-Paxos batching**: a slot carries a command *batch* — ``vcmd``
+  is the batch id (encodes proposer ballot + slot, so the agreement
+  oracle catches divergent decisions), ``vbsz`` its size (drawn
+  ``1..batch_max`` per proposal); ``committed_cmds`` counts commands,
+  not slots, so the amortization is visible in the metrics.
+- **thrifty grid messaging**: a proposal P2a goes only to the target
+  row (``slot % GR``), a recovery read P1a only to one column —
+  exactly the quorum, never the whole acceptor set.
+- **takeover recovery** (the read quorum's reason to exist): when a
+  proxy's execute frontier stalls on a hole while commits exist above
+  it (evidence the hole's owner is stuck or dead), it runs classic
+  per-slot Paxos recovery at a fresh higher ballot: read ONE FULL
+  COLUMN (rotating per attempt, so a crashed acceptor's column is
+  eventually avoided), adopt the highest-ballot accepted value (else
+  NOOP), then write ONE FULL ROW (also rotating).  Takeover timers
+  stagger by stripe distance so the owner retries first.
+- ``Quorum.ACK`` is a bit-packed int32 ack mask over the node axis;
+  the grid predicates are ``_row_quorums`` / ``_col_quorums`` —
+  per-line popcounts that count COMPLETE rows/columns (the fullness
+  paxi-lint's PXQ rowcol rule verifies symbolically).
+
+The same protocol runs event-driven on the host runtime (host.py);
+``PROTOCOL_NOREAD`` is the seeded-bug hunt twin whose recovery skips
+the column read — the exact mistake the grid intersection prevents —
+and is expected to violate agreement under drops (hunt positive
+control, never a correctness case).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim.ring import require_packable
+from paxi_tpu.sim.ring import shift_window as _shift
+from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+
+NO_CMD = -1    # empty log entry
+NOOP = -2      # hole filled by takeover recovery
+
+# grid-quorum thresholds: ONE complete row commits a write, ONE
+# complete column completes a recovery read (paxi-lint PXQ rowcol
+# sites — see _row_quorums/_col_quorums)
+W_ROWS = 1
+R_COLS = 1
+
+
+def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
+    return {
+        "p1a": ("bal", "slot"),
+        "p1b": ("bal", "slot", "vbal", "vcmd", "vbsz"),
+        "p2a": ("bal", "slot", "cmd", "bsz"),
+        "p2b": ("bal", "slot"),
+        "p3": ("bal", "slot", "cmd", "bsz"),
+    }
+
+
+def encode_cmd(bal, slot):
+    """Unique-ish batch id per (ballot, slot) — divergent decisions are
+    visible to the agreement oracle.  Doubles as the KV write payload."""
+    return ((bal & 0x7FFF) << 16) | (slot & 0xFFFF)
+
+
+def _geometry(cfg: SimConfig):
+    """(proxies, rows, cols, acceptors, executors) — static role split
+    over the node axis."""
+    P, GR, GC = cfg.n_proxies, cfg.grid_rows, cfg.grid_cols
+    A = GR * GC
+    E = cfg.n_replicas - P - A
+    if P < 1 or GR < 1 or GC < 1 or E < 1:
+        raise ValueError(
+            f"bpaxos needs n_replicas >= n_proxies + grid_rows*grid_cols"
+            f" + 1 (got R={cfg.n_replicas}, P={P}, grid={GR}x{GC})")
+    return P, GR, GC, A, E
+
+
+def _row_quorums(acks, cfg: SimConfig):
+    """acks: (...) int32 bit-packed over nodes -> (...) count of grid
+    rows FULLY acked (the BPaxos write-quorum primitive).  Acceptor
+    (r, c) is node ``n_proxies + r*grid_cols + c``."""
+    P, GR, GC = cfg.n_proxies, cfg.grid_rows, cfg.grid_cols
+    cnt = jnp.zeros(acks.shape, jnp.int32)
+    for r in range(GR):
+        rmask = jnp.int32(((1 << GC) - 1) << (P + r * GC))
+        per = jax.lax.population_count(acks & rmask)
+        cnt = cnt + (per >= GC)
+    return cnt
+
+
+def _col_quorums(acks, cfg: SimConfig):
+    """acks -> count of grid columns FULLY acked (the BPaxos
+    read/recovery-quorum primitive)."""
+    P, GR, GC = cfg.n_proxies, cfg.grid_rows, cfg.grid_cols
+    cnt = jnp.zeros(acks.shape, jnp.int32)
+    for c in range(GC):
+        cmask = 0
+        for r in range(GR):
+            cmask |= 1 << (P + r * GC + c)
+        per = jax.lax.population_count(acks & jnp.int32(cmask))
+        cnt = cnt + (per >= GR)
+    return cnt
+
+
+def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
+    R, S, K, G = cfg.n_replicas, cfg.n_slots, cfg.n_keys, n_groups
+    P, GR, GC, A, E = _geometry(cfg)
+    del rng, GR, GC, A, E
+    require_packable(R)
+    i32 = jnp.int32
+    ridx = jnp.arange(R, dtype=i32)
+    return dict(
+        # acceptor rings (role-masked: meaningful at the grid nodes)
+        abal=jnp.zeros((R, S, G), i32),       # promised ballot per slot
+        vbal=jnp.zeros((R, S, G), i32),       # accepted ballot
+        vcmd=jnp.full((R, S, G), NO_CMD, i32),  # accepted batch id
+        vbsz=jnp.zeros((R, S, G), i32),       # accepted batch size
+        committed=jnp.zeros((R, S, G), bool),  # learner commit bit
+        # proxy bookkeeping (own stripe only)
+        proposed=jnp.zeros((R, S, G), bool),
+        p2_acks=jnp.zeros((R, S, G), i32),    # bit-packed over nodes
+        next_slot=jnp.broadcast_to(ridx[:, None], (R, G)).astype(i32),
+        # shared frontier: contiguous committed prefix, executed in
+        # order at every non-acceptor (executors are the reply role)
+        base=jnp.zeros((R, G), i32),
+        execute=jnp.zeros((R, G), i32),
+        kv=jnp.zeros((R, K, G), i32),
+        cum_cmds=jnp.zeros((R, G), i32),      # commands executed (batch sum)
+        stuck=jnp.zeros((R, G), i32),         # frontier-stall counter
+        # per-proxy takeover-recovery FSM (one slot in flight at a time)
+        rec_slot=jnp.full((R, G), -1, i32),
+        rec_bal=jnp.zeros((R, G), i32),
+        rec_phase=jnp.zeros((R, G), i32),     # 0 idle, 1 read, 2 write
+        rec_acks=jnp.zeros((R, G), i32),
+        rec_vbal=jnp.zeros((R, G), i32),
+        rec_vcmd=jnp.full((R, G), NO_CMD, i32),
+        rec_vbsz=jnp.zeros((R, G), i32),
+        rec_round=jnp.zeros((R, G), i32),     # attempts (ballot rounds)
+        rec_timer=jnp.zeros((R, G), i32),
+        recovered=jnp.zeros((R, G), i32),     # completed takeovers (metric)
+    )
+
+
+def _step(state, inbox, ctx: StepCtx, *, read_quorum: bool = True):
+    cfg = ctx.cfg
+    R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
+    P, GR, GC, A, E = _geometry(cfg)
+    STRIDE = cfg.ballot_stride
+    RETAIN = max(S // 2, 1)
+    i32 = jnp.int32
+    ridx = jnp.arange(R, dtype=i32)
+    sidx = jnp.arange(S, dtype=i32)
+    kidx = jnp.arange(K, dtype=i32)
+    G = state["execute"].shape[-1]
+
+    is_proxy = (ridx < P)[:, None]                       # (R, 1)
+    is_acc = ((ridx >= P) & (ridx < P + A))[:, None]
+    acc_row = jnp.where(ridx >= P, (ridx - P) // GC, -1)  # (R,)
+    acc_col = jnp.where(ridx >= P, (ridx - P) % GC, -1)
+    bal0 = (STRIDE + ridx)[:, None].astype(i32)          # proxy base ballot
+
+    st = dict(state)
+    abal, vbal = st["abal"], st["vbal"]
+    vcmd, vbsz = st["vcmd"], st["vbsz"]
+    committed = st["committed"]
+    base, execute = st["base"], st["execute"]
+
+    def at_slot(plane, oh):
+        """Value of an (R, S, G) ring plane at a per-(R, G) one-hot."""
+        return jnp.sum(jnp.where(oh, plane, 0), axis=1)
+
+    def slot_oh(slot):
+        rel = slot - base
+        inw = (rel >= 0) & (rel < S)
+        return sidx[None, :, None] == rel[:, None, :], inw
+
+    def out_planes(fields):
+        z = jnp.zeros((R, R, G), i32)
+        out = {"valid": jnp.zeros((R, R, G), bool)}
+        out.update({f: z for f in fields})
+        return out
+
+    def reply_to(out, dst, src_mask, **fields):
+        """Emit a reply from every node where ``src_mask`` (src, G)
+        holds to the single destination node ``dst``; field values are
+        per-sender ``(src, G)`` planes."""
+        dst_oh = (ridx == dst)[None, :, None]            # (1, R, 1)
+        m = src_mask[:, None, :] & dst_oh
+        out["valid"] = out["valid"] | m
+        for k, v in fields.items():
+            out[k] = jnp.where(m, v[:, None, :], out[k])
+        return out
+
+    # ------------- acceptors: P1a (column-read probes) ------------------
+    out_p1b = out_planes(("bal", "slot", "vbal", "vcmd", "vbsz"))
+    for s in range(P):
+        m = inbox["p1a"]
+        ok = m["valid"][s] & is_acc                      # (dst=R, G)
+        bal, slot = m["bal"][s], m["slot"][s]
+        oh, inw = slot_oh(slot)
+        cur = at_slot(abal, oh)
+        grant = ok & inw & (bal >= cur)
+        abal = jnp.where(grant[:, None, :] & oh,
+                         jnp.maximum(abal, bal[:, None, :]), abal)
+        out_p1b = reply_to(
+            out_p1b, s, grant, bal=bal, slot=slot,
+            vbal=at_slot(vbal, oh), vcmd=at_slot(vcmd, oh),
+            vbsz=at_slot(vbsz, oh))
+
+    # ------------- acceptors: P2a (row-write accepts) -------------------
+    out_p2b = out_planes(("bal", "slot"))
+    for s in range(P):
+        m = inbox["p2a"]
+        ok = m["valid"][s] & is_acc
+        bal, slot = m["bal"][s], m["slot"][s]
+        cmd, bsz = m["cmd"][s], m["bsz"][s]
+        oh, inw = slot_oh(slot)
+        cur = at_slot(abal, oh)
+        acc = ok & inw & (bal >= cur)
+        w = acc[:, None, :] & oh
+        abal = jnp.where(w, jnp.maximum(abal, bal[:, None, :]), abal)
+        vbal = jnp.where(w, bal[:, None, :], vbal)
+        vcmd = jnp.where(w, cmd[:, None, :], vcmd)
+        vbsz = jnp.where(w, bsz[:, None, :], vbsz)
+        out_p2b = reply_to(out_p2b, s, acc, bal=bal, slot=slot)
+
+    # ------------- proxies: P1b (recovery-read tally) -------------------
+    rec_slot, rec_bal = st["rec_slot"], st["rec_bal"]
+    rec_phase, rec_acks = st["rec_phase"], st["rec_acks"]
+    rec_vbal, rec_vcmd = st["rec_vbal"], st["rec_vcmd"]
+    rec_vbsz = st["rec_vbsz"]
+    for a in range(P, P + A):
+        m = inbox["p1b"]
+        ok = (m["valid"][a] & is_proxy & (rec_phase == 1)
+              & (m["bal"][a] == rec_bal) & (m["slot"][a] == rec_slot))
+        rec_acks = jnp.where(ok, rec_acks | i32(1 << a), rec_acks)
+        better = ok & (m["vbal"][a] > rec_vbal)
+        rec_vbal = jnp.where(better, m["vbal"][a], rec_vbal)
+        rec_vcmd = jnp.where(better, m["vcmd"][a], rec_vcmd)
+        rec_vbsz = jnp.where(better, m["vbsz"][a], rec_vbsz)
+
+    # read quorum: ONE FULL COLUMN seen -> write the value (or NOOP)
+    colq = _col_quorums(rec_acks, cfg)
+    read_done = is_proxy & (rec_phase == 1) & (colq >= R_COLS)
+    rec_vcmd = jnp.where(read_done & (rec_vbal <= 0), NOOP, rec_vcmd)
+    rec_vbsz = jnp.where(read_done & (rec_vbal <= 0), 0, rec_vbsz)
+    rec_phase = jnp.where(read_done, 2, rec_phase)
+    rec_acks = jnp.where(read_done, 0, rec_acks)
+
+    # ------------- proxies: P2b (normal + recovery tallies) -------------
+    p2_acks = st["p2_acks"]
+    for a in range(P, P + A):
+        m = inbox["p2b"]
+        ok = m["valid"][a] & is_proxy
+        bal, slot = m["bal"][a], m["slot"][a]
+        oh, inw = slot_oh(slot)
+        norm = ok & (bal == bal0) & inw
+        p2_acks = p2_acks | jnp.where(norm[:, None, :] & oh,
+                                      i32(1 << a), 0)
+        rec = (ok & (rec_phase == 2) & (bal == rec_bal)
+               & (slot == rec_slot))
+        rec_acks = jnp.where(rec, rec_acks | i32(1 << a), rec_acks)
+
+    # write quorum: ONE FULL ROW of acks commits the slot
+    rowq = _row_quorums(p2_acks, cfg)
+    newly = (is_proxy[:, None, :] & st["proposed"] & ~committed
+             & (rowq >= W_ROWS) & (vcmd != NO_CMD))
+    committed = committed | newly
+
+    rowq_rec = _row_quorums(rec_acks, cfg)
+    rec_done = is_proxy & (rec_phase == 2) & (rowq_rec >= W_ROWS)
+    oh_rec, rec_inw = slot_oh(rec_slot)
+    w = (rec_done & rec_inw)[:, None, :] & oh_rec
+    vcmd = jnp.where(w, rec_vcmd[:, None, :], vcmd)
+    vbsz = jnp.where(w, rec_vbsz[:, None, :], vbsz)
+    vbal = jnp.where(w, rec_bal[:, None, :], vbal)
+    committed = committed | w
+    recovered = st["recovered"] + rec_done
+    rec_phase = jnp.where(rec_done, 0, rec_phase)
+    rec_slot = jnp.where(rec_done, -1, rec_slot)
+
+    # ------------- everyone: P3 (commit learn + laggard healing) --------
+    kv, cum_cmds = st["kv"], st["cum_cmds"]
+    proposed = st["proposed"]
+    next_slot = st["next_slot"]
+    for s in range(P):
+        m = inbox["p3"]
+        ok = m["valid"][s]
+        bal, slot = m["bal"][s], m["slot"][s]
+        cmd, bsz = m["cmd"][s], m["bsz"][s]
+        # deep-laggard healing: my frontier fell below the sender's
+        # window -> re-base my ring to the sender's window, keep my
+        # entries (shifted, promises included) where the sender has no
+        # commit, and adopt the sender's executed state wholesale.
+        # Adoption is BY REFERENCE to the sender's live base/planes
+        # (the wpaxos/ballot_ring precedent): a message-carried window
+        # base goes stale between send and delivery as the sender's
+        # ring slides, and re-basing to a stale base misaligns every
+        # adopted slot.
+        low = base[s][None, :]
+        adopt = ok & (execute < low)
+        a2 = adopt[:, None, :]
+        adv_a = jnp.where(adopt, low - base, 0)
+        my_abal = _shift(abal, adv_a, 0)
+        my_vbal = _shift(vbal, adv_a, 0)
+        my_vcmd = _shift(vcmd, adv_a, NO_CMD)
+        my_vbsz = _shift(vbsz, adv_a, 0)
+        my_com = _shift(committed, adv_a, False)
+        s_com = committed[s][None]
+        abal = jnp.where(a2, jnp.maximum(abal[s][None], my_abal), abal)
+        vbal = jnp.where(a2, jnp.where(s_com, vbal[s][None], my_vbal),
+                         vbal)
+        vcmd = jnp.where(a2, jnp.where(s_com, vcmd[s][None], my_vcmd),
+                         vcmd)
+        vbsz = jnp.where(a2, jnp.where(s_com, vbsz[s][None], my_vbsz),
+                         vbsz)
+        committed = jnp.where(a2, s_com | my_com, committed)
+        proposed = jnp.where(a2, False, proposed)
+        p2_acks = jnp.where(a2, 0, p2_acks)
+        kv = jnp.where(adopt[:, None, :], kv[s][None], kv)
+        cum_cmds = jnp.where(adopt, cum_cmds[s][None], cum_cmds)
+        execute = jnp.where(adopt, execute[s][None, :], execute)
+        base = jnp.where(adopt, low, base)
+        # keep proxy stripes aligned after a frontier jump
+        nxt = execute + ((ridx[:, None] - execute) % P)
+        next_slot = jnp.where(adopt & is_proxy,
+                              jnp.maximum(next_slot, nxt), next_slot)
+        # the message's own slot: commit exactly what it says (the
+        # promise rises with it, so a learned commit never reads as an
+        # accept without a promise)
+        oh, inw = slot_oh(slot)
+        w = (ok & inw)[:, None, :] & oh
+        vcmd = jnp.where(w, cmd[:, None, :], vcmd)
+        vbsz = jnp.where(w, bsz[:, None, :], vbsz)
+        vbal = jnp.where(w, jnp.maximum(vbal, bal[:, None, :]), vbal)
+        abal = jnp.where(w, jnp.maximum(abal, bal[:, None, :]), abal)
+        committed = committed | w
+
+    # ------------- recovery abort: the slot got committed ---------------
+    oh_rec, rec_inw = slot_oh(rec_slot)
+    rec_com = jnp.any(oh_rec & committed, axis=1)
+    drop_rec = (rec_phase > 0) & (rec_com | (rec_slot < base))
+    rec_phase = jnp.where(drop_rec, 0, rec_phase)
+    rec_slot = jnp.where(drop_rec, -1, rec_slot)
+
+    # ------------- execute the contiguous committed prefix --------------
+    abs_ = base[:, None, :] + sidx[None, :, None]
+    advanced = jnp.zeros_like(execute)
+    running = jnp.ones_like(execute, dtype=bool)
+    for e in range(cfg.exec_window):
+        rel = execute + e - base
+        oh_e = sidx[None, :, None] == rel[:, None, :]
+        com = jnp.any(oh_e & committed, axis=1)
+        running = running & com
+        cmd_e = at_slot(vcmd, oh_e)
+        bsz_e = at_slot(vbsz, oh_e)
+        wr = running & (cmd_e >= 0)
+        key_e = fib_key(cmd_e, K)
+        ohk = wr[:, None, :] & (kidx[None, :, None] == key_e[:, None, :])
+        kv = jnp.where(ohk, cmd_e[:, None, :], kv)
+        cum_cmds = cum_cmds + jnp.where(wr, bsz_e, 0)
+        advanced = advanced + running
+    new_execute = execute + advanced
+
+    # ------------- proxies: propose (fresh batch or re-proposal) --------
+    stuck = jnp.where(is_proxy & (advanced == 0), st["stuck"] + 1, 0)
+    own = (abs_ % P) == ridx[:, None, None]
+    # go-back-N reopen: a dropped P2a/P2b leaves its slot unproposable;
+    # on a stall re-open every own in-flight slot (drains in O(N)
+    # steps).  The counter keeps growing while stalled — it also arms
+    # the takeover trigger below, so it must not reset on retry.
+    retry = (stuck > 0) & (stuck % cfg.retry_timeout == 0)
+    reopen = (retry[:, None, :] & own & proposed & ~committed
+              & (abs_ < next_slot[:, None, :]))
+    proposed = proposed & ~reopen
+
+    mask_re = (is_proxy[:, None, :] & own & ~proposed & ~committed
+               & (abs_ < next_slot[:, None, :]))
+    first_re = jnp.argmin(jnp.where(mask_re, sidx[None, :, None], S),
+                          axis=1).astype(i32)
+    has_re = jnp.any(mask_re, axis=1)
+    can_new = (next_slot - base) < S
+    rel_new = jnp.clip(next_slot - base, 0, S - 1)
+    prop_rel = jnp.where(has_re, first_re, rel_new)
+    prop_slot = base + prop_rel
+    oh_p = sidx[None, :, None] == prop_rel[:, None, :]
+    # skip own fresh slots someone else already recovered (NOOP-filled)
+    fresh_com = jnp.any(oh_p & committed, axis=1)
+    is_new = ~has_re & can_new
+    skip = is_proxy & is_new & fresh_com
+    next_slot = next_slot + jnp.where(skip, P, 0)
+    # the HT-Paxos batch: one grid round will commit bsz commands
+    draw = jr.randint(jr.fold_in(ctx.rng, 23), (R, G), 1,
+                      cfg.batch_max + 1)
+    new_cmd = encode_cmd(bal0, prop_slot)
+    prop_cmd = jnp.where(is_new, new_cmd, at_slot(vcmd, oh_p))
+    prop_cmd = jnp.where(prop_cmd == NO_CMD, NOOP, prop_cmd)
+    prop_bsz = jnp.where(is_new, draw, at_slot(vbsz, oh_p))
+    do = (is_proxy & (has_re | is_new) & ~skip & ~(rec_phase == 2)
+          & ~(is_new & fresh_com))
+    ohw = do[:, None, :] & oh_p & ~committed
+    vcmd = jnp.where(ohw, prop_cmd[:, None, :], vcmd)
+    vbsz = jnp.where(ohw, prop_bsz[:, None, :], vbsz)
+    vbal = jnp.where(ohw, bal0[:, None, :], vbal)
+    proposed = proposed | (do[:, None, :] & oh_p)
+    next_slot = next_slot + jnp.where(is_new & do, P, 0)
+
+    # ------------- outgoing P2a: thrifty row-targeted -------------------
+    do_recw = is_proxy & (rec_phase == 2)
+    p2a_bal = jnp.where(do_recw, rec_bal, bal0)
+    p2a_slot = jnp.where(do_recw, rec_slot, prop_slot)
+    p2a_cmd = jnp.where(do_recw, rec_vcmd, prop_cmd)
+    p2a_bsz = jnp.where(do_recw, rec_vbsz, prop_bsz)
+    row_t = jnp.where(do_recw, st["rec_round"] % GR, p2a_slot % GR)
+    p2a_do = do | do_recw
+    row_hit = (acc_row[None, :, None] == row_t[:, None, :]) \
+        & is_acc[None, :, :]
+    out_p2a = {
+        "valid": p2a_do[:, None, :] & row_hit,
+        "bal": jnp.broadcast_to(p2a_bal[:, None, :], (R, R, G)),
+        "slot": jnp.broadcast_to(p2a_slot[:, None, :], (R, R, G)),
+        "cmd": jnp.broadcast_to(p2a_cmd[:, None, :], (R, R, G)),
+        "bsz": jnp.broadcast_to(p2a_bsz[:, None, :], (R, R, G)),
+    }
+
+    # ------------- outgoing P1a: thrifty column-targeted ----------------
+    do_read = is_proxy & (rec_phase == 1)
+    col_t = st["rec_round"] % GC
+    col_hit = (acc_col[None, :, None] == col_t[:, None, :]) \
+        & is_acc[None, :, :]
+    out_p1a = {
+        "valid": do_read[:, None, :] & col_hit,
+        "bal": jnp.broadcast_to(rec_bal[:, None, :], (R, R, G)),
+        "slot": jnp.broadcast_to(rec_slot[:, None, :], (R, R, G)),
+    }
+
+    # ------------- outgoing P3: fresh commit else retransmit ------------
+    low_new = jnp.argmin(jnp.where(newly, sidx[None, :, None], S),
+                         axis=1).astype(i32)
+    any_new = jnp.any(newly, axis=1)
+    span = jnp.maximum(new_execute - base, 1)
+    p3_rel = jnp.where(any_new, low_new, ctx.t % span)
+    p3_rel = jnp.where(rec_done & rec_inw,
+                       jnp.clip(rec_slot - base, 0, S - 1), p3_rel)
+    p3_rel = jnp.clip(p3_rel, 0, S - 1).astype(i32)
+    oh_3 = sidx[None, :, None] == p3_rel[:, None, :]
+    p3_commit = jnp.any(oh_3 & committed, axis=1)
+    p3_do = is_proxy & p3_commit
+    out_p3 = {
+        "valid": jnp.broadcast_to(p3_do[:, None, :], (R, R, G)),
+        "bal": jnp.broadcast_to(at_slot(vbal, oh_3)[:, None, :],
+                                (R, R, G)),
+        "slot": jnp.broadcast_to((base + p3_rel)[:, None, :], (R, R, G)),
+        "cmd": jnp.broadcast_to(at_slot(vcmd, oh_3)[:, None, :],
+                                (R, R, G)),
+        "bsz": jnp.broadcast_to(at_slot(vbsz, oh_3)[:, None, :],
+                                (R, R, G)),
+    }
+
+    # ------------- takeover trigger + recovery restart ------------------
+    hole_oh = sidx[None, :, None] == (new_execute - base)[:, None, :]
+    hole_com = jnp.any(hole_oh & committed, axis=1)
+    evid = jnp.any(committed & (abs_ > new_execute[:, None, :]), axis=1)
+    owner = new_execute % P
+    stag = (ridx[:, None] - owner) % P
+    fire = (is_proxy & (rec_phase == 0) & evid & ~hole_com
+            & (stuck >= cfg.election_timeout + 3 * stag))
+    rec_round = st["rec_round"]
+    # in-flight recovery stalls (dropped probes, dead row/column
+    # members): bump the ballot round and rotate row + column
+    restart = (rec_phase > 0) & (st["rec_timer"] >= cfg.election_timeout)
+    rec_timer = jnp.where((rec_phase > 0) & ~restart,
+                          st["rec_timer"] + 1, 0)
+    go = fire | restart
+    rec_round = jnp.where(go, rec_round + 1, rec_round)
+    rec_slot = jnp.where(fire, new_execute, rec_slot)
+    rec_bal = jnp.where(go, STRIDE * (1 + rec_round) + ridx[:, None],
+                        rec_bal)
+    # the seeded-bug twin (read_quorum=False) jumps straight to the
+    # row write with NOOP — skipping exactly the column read whose
+    # intersection with every write row makes takeover safe
+    rec_phase = jnp.where(go, 1 if read_quorum else 2, rec_phase)
+    rec_acks = jnp.where(go, 0, rec_acks)
+    rec_vbal = jnp.where(go, 0, rec_vbal)
+    rec_vcmd = jnp.where(go, NO_CMD if read_quorum else NOOP, rec_vcmd)
+    rec_vbsz = jnp.where(go, 0, rec_vbsz)
+
+    # a committed value's ballot is done: the promise rises with every
+    # commit path (tally/recovery/p3), keeping accepted <= promised
+    abal = jnp.maximum(abal, jnp.where(committed, vbal, 0))
+
+    # ------------- slide the ring past the executed prefix --------------
+    new_base = jnp.maximum(base, new_execute - RETAIN)
+    adv = new_base - base
+    new_state = dict(
+        abal=_shift(abal, adv, 0), vbal=_shift(vbal, adv, 0),
+        vcmd=_shift(vcmd, adv, NO_CMD), vbsz=_shift(vbsz, adv, 0),
+        committed=_shift(committed, adv, False),
+        proposed=_shift(proposed, adv, False),
+        p2_acks=_shift(p2_acks, adv, 0),
+        next_slot=next_slot, base=new_base, execute=new_execute,
+        kv=kv, cum_cmds=cum_cmds, stuck=stuck,
+        rec_slot=rec_slot, rec_bal=rec_bal, rec_phase=rec_phase,
+        rec_acks=rec_acks, rec_vbal=rec_vbal, rec_vcmd=rec_vcmd,
+        rec_vbsz=rec_vbsz, rec_round=rec_round, rec_timer=rec_timer,
+        recovered=recovered,
+    )
+    outbox = {"p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
+              "p2b": out_p2b, "p3": out_p3}
+    return new_state, outbox
+
+
+def metrics(state, cfg: SimConfig):
+    """Committed slots = the most advanced frontier; committed_cmds
+    counts the commands inside those slots (the HT-Paxos amortization
+    is committed_cmds / committed_slots); summed over the group axis."""
+    return {
+        "committed_slots": jnp.sum(jnp.max(state["execute"], axis=0)),
+        "committed_cmds": jnp.sum(jnp.max(state["cum_cmds"], axis=0)),
+        "min_execute": jnp.sum(jnp.min(state["execute"], axis=0)),
+        "recoveries": jnp.sum(state["recovered"]),
+    }
+
+
+def invariants(old, new, cfg: SimConfig) -> jax.Array:
+    """Per-step safety oracle:
+    1. Agreement: all committed (batch id, batch size) for a slot are
+       equal across nodes (base-aligned common window).
+    2. Stability: a committed entry never changes value/size or
+       un-commits while in-window; recycled slots were executed.
+    3. Promise monotonicity: ``abal`` never decreases per slot, and
+       accepted ballots never exceed the promise.
+    4. Executed prefix is committed (within the window).
+    5. Batch sanity: committed batch sizes are in 0..batch_max."""
+    BIG = jnp.int32(2**30)
+    S = cfg.n_slots
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    base, c = new["base"], new["committed"]
+    cmd, bsz = new["vcmd"], new["vbsz"]
+
+    # 1. agreement on the aligned window
+    align = jnp.max(base, axis=0)[None, :] - base
+    a_c = _shift(c, align, False)
+    a_cmd = _shift(cmd, align, NO_CMD)
+    a_bsz = _shift(bsz, align, 0)
+    n_c = jnp.sum(a_c, axis=0)
+    mx = jnp.max(jnp.where(a_c, a_cmd, -BIG), axis=0)
+    mn = jnp.min(jnp.where(a_c, a_cmd, BIG), axis=0)
+    bx = jnp.max(jnp.where(a_c, a_bsz, -BIG), axis=0)
+    bn = jnp.min(jnp.where(a_c, a_bsz, BIG), axis=0)
+    v_agree = jnp.sum((n_c >= 1) & ((mx != mn) | (bx != bn)))
+
+    # 2. stability
+    adv = base - old["base"]
+    o_c = _shift(old["committed"], adv, False)
+    o_cmd = _shift(old["vcmd"], adv, NO_CMD)
+    o_bsz = _shift(old["vbsz"], adv, 0)
+    v_stable = jnp.sum(o_c & (~c | (cmd != o_cmd) | (bsz != o_bsz)))
+    v_stable = v_stable + jnp.sum(new["execute"] < base)
+
+    # 3. promise monotonicity + accepted <= promised
+    o_abal = _shift(old["abal"], adv, 0)
+    v_bal = jnp.sum(new["abal"] < o_abal)
+    P, GR, GC, A, E = _geometry(cfg)
+    ridx = jnp.arange(cfg.n_replicas, dtype=jnp.int32)
+    is_acc = ((ridx >= P) & (ridx < P + A))[:, None, None]
+    v_bal = v_bal + jnp.sum(is_acc & (new["vbal"] > new["abal"]))
+
+    # 4. executed prefix committed
+    abs_ = base[:, None, :] + sidx[None, :, None]
+    v_exec = jnp.sum((abs_ < new["execute"][:, None, :]) & ~c)
+
+    # 5. batch sizes sane
+    v_bsz = jnp.sum(c & ((bsz < 0) | (bsz > cfg.batch_max)))
+
+    return (v_agree + v_stable + v_bal + v_exec + v_bsz).astype(jnp.int32)
+
+
+def step(state, inbox, ctx: StepCtx):
+    return _step(state, inbox, ctx, read_quorum=True)
+
+
+PROTOCOL = SimProtocol(
+    name="bpaxos",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=step,
+    metrics=metrics,
+    invariants=invariants,
+    batched=True,
+)
+
+# the seeded-bug hunt twin: takeover recovery skips the column read and
+# blind-writes NOOP at a higher ballot — under drops it overwrites
+# already-chosen batches, violating agreement/stability BY DESIGN
+# (hunt positive control; never a correctness case)
+PROTOCOL_NOREAD = SimProtocol(
+    name="bpaxos_noread",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=functools.partial(_step, read_quorum=False),
+    metrics=metrics,
+    invariants=invariants,
+    batched=True,
+)

@@ -7,15 +7,25 @@ them; reads are served at the tail (which is why the scheme is
 linearizable: the tail's state is the committed prefix).  Requests
 arriving at the wrong end are forwarded (node.go Forward).
 
+Batched commit path (HT-Paxos, PAPERS.md — the same lever the paxos
+host gained in PR 7, reusing ``BatchBuffer``): the head accumulates
+write requests and ONE chain descent carries the whole batch — a
+``Propagate`` holds a command *list* under one sequence number, every
+link applies it atomically in order, and the tail's single ``Ack``
+fans replies out to every client in the batch.  Batch atomicity rides
+on message atomicity: a link either receives the entire batch or
+nothing, so no fault schedule can apply half a batch.
+
 The same protocol runs as a vmapped TPU kernel in ``sim.py``.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Dict, Optional
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
 
 from paxi_tpu.core.command import Command, Reply, Request
+from paxi_tpu.host.batch import BatchBuffer
 from paxi_tpu.core.config import Config
 from paxi_tpu.core.ident import ID
 from paxi_tpu.host.codec import register_message
@@ -25,13 +35,12 @@ from paxi_tpu.host.node import Node
 @register_message
 @dataclass
 class Propagate:
-    """A write travelling down the chain (chain/ Propagate msg)."""
+    """A write batch travelling down the chain (chain/ Propagate msg,
+    generalized to a command list under one sequence number)."""
 
     seq: int
-    key: int
-    value: bytes
-    client_id: str = ""
-    command_id: int = 0
+    # [[key, value, client_id, command_id], ...] — wire-friendly lists
+    cmds: list = field(default_factory=list)
 
 
 @register_message
@@ -53,8 +62,21 @@ class ChainReplica(Node):
         self.succ: Optional[ID] = (
             order[self.pos + 1] if self.pos + 1 < len(order) else None)
         self.seq = 0            # head: last assigned; others: last applied
-        self.pending: Dict[int, Request] = {}   # head: seq -> client request
+        # head: seq -> the batch's client requests
+        self.pending: Dict[int, List[Request]] = {}
         self.buffer: Dict[int, Propagate] = {}  # out-of-order propagates
+        # the batched commit path: head-side write accumulation; wall
+        # timers never fire under the virtual-clock fabric, so a
+        # fabric-driven replica is forced onto tick flushes.  The head
+        # is static (order[0], no elections), so only it carries the
+        # buffer — non-head replicas would just export dead
+        # paxi_batch_* series
+        if self.id == self.head:
+            self.batch = BatchBuffer(
+                self._flush_batch, max_size=cfg.batch_size,
+                max_wait=0.0 if self.socket.fabric is not None
+                else cfg.batch_wait,
+                metrics=self.metrics)
         self.register(Request, self.handle_request)
         self.register(Propagate, self.handle_propagate)
         self.register(Ack, self.handle_ack)
@@ -75,32 +97,39 @@ class ChainReplica(Node):
             else:
                 self.forward(self.tail, req)
             return
-        # writes at the head
+        # writes batch at the head: one descent per burst
         if not self.is_head():
             self.forward(self.head, req)
             return
+        self.batch.add(req)
+
+    def _flush_batch(self, reqs: List[Request]) -> None:
+        """BatchBuffer flush: ONE sequence number (hence one descent
+        and one tail Ack) carries every write of the burst."""
         self.seq += 1
-        self.pending[self.seq] = req
-        self.db.execute(req.command)
+        self.pending[self.seq] = list(reqs)
+        for r in reqs:
+            self.db.execute(r.command)
         if self.succ is None:       # single-node chain: head == tail
             self._ack(self.seq)
         else:
-            c = req.command
             self.socket.send(self.succ, Propagate(
-                self.seq, c.key, c.value, c.client_id, c.command_id))
+                self.seq,
+                [[r.command.key, r.command.value, r.command.client_id,
+                  r.command.command_id] for r in reqs]))
 
     # ---- down the chain ------------------------------------------------
     def handle_propagate(self, m: Propagate) -> None:
         if m.seq <= self.seq:
-            return              # duplicate of an already-applied write
+            return              # duplicate of an already-applied batch
         self.buffer[m.seq] = m
         # apply strictly in sequence order (TCP is FIFO per edge, but a
         # restarted link may reorder across reconnects — buffer defends)
         while self.seq + 1 in self.buffer:
             m = self.buffer.pop(self.seq + 1)
             self.seq += 1
-            self.db.execute(Command(m.key, m.value, m.client_id,
-                                    m.command_id))
+            for k, v, cid, cmid in m.cmds:
+                self.db.execute(Command(int(k), v, cid, int(cmid)))
             if self.is_tail():
                 self.socket.send(self.head, Ack(m.seq))
             else:
@@ -111,8 +140,7 @@ class ChainReplica(Node):
         self._ack(m.seq)
 
     def _ack(self, seq: int) -> None:
-        req = self.pending.pop(seq, None)
-        if req is not None:
+        for req in self.pending.pop(seq, []):
             req.reply(Reply(req.command, value=b""))
 
 

@@ -23,6 +23,7 @@ command list is the NOOP filler for recovered holes.
 
 from __future__ import annotations
 
+import asyncio
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -146,6 +147,21 @@ class PaxosReplica(Node):
         # leader-reads barrier: proposal-frontier slot -> reads waiting
         # for every slot <= it to execute (cfg.leader_reads only)
         self._read_barrier: Dict[int, List[Request]] = {}
+        # the leader lease that keeps those reads sound across
+        # elections (cfg.lease_s):
+        # - ``_lease_until``: serving side — barrier reads answer from
+        #   local state only within ``lease_s`` of the START of the
+        #   last quorum round (phase-1 win or phase-2 commit); past it
+        #   the reads fall back to the log (always-safe path).
+        # - ``_fence_until``: takeover side — a fresh leader defers its
+        #   first proposals for ``lease_s`` after winning phase-1, so
+        #   no write can commit while a deposed leader's lease (whose
+        #   last renewal round necessarily STARTED before our promises
+        #   arrived) may still be serving reads.
+        self._lease_until = 0.0
+        self._fence_until = 0.0
+        self._p1_start = 0.0
+        self._fenced: list = []   # proposals stashed behind the fence
         # at-most-once filter (ADVICE r2 medium): client_id -> (highest
         # executed command_id, its value).  Clients issue command_ids
         # monotonically (host/client.py), so a re-proposal of an
@@ -187,8 +203,27 @@ class PaxosReplica(Node):
     def is_leader(self) -> bool:
         return self.active and self.leader == self.id
 
+    # ---- leader lease (cfg.leader_reads soundness) --------------------
+    def _lease_enabled(self) -> bool:
+        return self.cfg.leader_reads and self.cfg.lease_s > 0
+
+    def _lease_ok(self) -> bool:
+        """May barrier reads answer from local state right now?"""
+        return not self._lease_enabled() \
+            or time.time() < self._lease_until
+
+    def _renew_lease(self, round_start: float) -> None:
+        """A quorum round that STARTED at ``round_start`` completed:
+        a majority was reachable then, so no rival can have finished
+        phase-1 before it — local state is authoritative until
+        ``round_start + lease_s``."""
+        if self._lease_enabled():
+            self._lease_until = max(self._lease_until,
+                                    round_start + self.cfg.lease_s)
+
     def run_phase1(self) -> None:
         """paxos.go P1a(): bump ballot, solicit promises."""
+        self._p1_start = time.time()
         self.ballot = next_ballot(self.ballot, self.id)
         self.active = False
         self.p1_quorum = Quorum(self.cfg.ids)
@@ -237,6 +272,12 @@ class PaxosReplica(Node):
         if writes:
             self.propose(writes)
         if reads:
+            if not self._lease_ok():
+                # lease expired: a newer leader may have committed
+                # writes this snapshot misses — order the reads
+                # through the log (the always-safe path)
+                self.propose(reads)
+                return
             barrier = self.slot
             if self.execute > barrier:
                 db_get = self.db.get
@@ -250,7 +291,20 @@ class PaxosReplica(Node):
                 cmds: Optional[List[Command]] = None,
                 at_slot: Optional[int] = None) -> None:
         """paxos.go P2a(): assign a slot to the batch, self-ack,
-        broadcast one P2a carrying every command."""
+        broadcast one P2a carrying every command.  Behind the takeover
+        fence (see ``_fence_until``) proposals stash and drain when a
+        deposed leader's lease can no longer be live."""
+        if self._lease_enabled() and time.time() < self._fence_until:
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                loop = None   # no loop (sync caller): fence unenforceable
+            if loop is not None:
+                self._fenced.append((reqs, cmds, at_slot))
+                if len(self._fenced) == 1:
+                    loop.call_later(self._fence_until - time.time(),
+                                    self._drain_fence)
+                return
         reqs = list(reqs) if reqs else []
         if cmds is None:
             cmds = [r.command for r in reqs]
@@ -270,6 +324,20 @@ class PaxosReplica(Node):
         if q.majority():  # single-replica cluster
             self._commit(slot)
 
+    def _drain_fence(self) -> None:
+        """The takeover fence elapsed: release the stashed proposals
+        (or, if leadership was lost meanwhile, route their requests
+        like any other non-leader arrival)."""
+        fenced, self._fenced = self._fenced, []
+        if not self.is_leader():
+            for reqs, _cmds, _slot in fenced:
+                self.pending.extend(r for r in (reqs or [])
+                                    if r is not None)
+            self._drain_pending()
+            return
+        for args in fenced:
+            self.propose(*args)
+
     # ---- phase 1 -------------------------------------------------------
     def handle_p1a(self, m: P1a) -> None:
         if m.ballot > self.ballot:
@@ -288,6 +356,14 @@ class PaxosReplica(Node):
         """Losing leadership: unflushed batch, barrier reads and
         uncommitted proposals carrying client requests go back to
         pending for forwarding to the new leader."""
+        self._lease_until = 0.0   # known-deposed: stop serving reads now
+        if self._fenced:
+            # stashed proposals carry the old reign's slot assignments;
+            # replaying them after a re-election would overwrite entries
+            # committed in between — requeue the requests, drop the slots
+            fenced, self._fenced = self._fenced, []
+            for reqs, _cmds, _slot in fenced:
+                self.pending.extend(r for r in (reqs or []) if r is not None)
         self.batch.drain()   # flush sees not-leader: routes to pending
         if self._read_barrier:
             for reads in self._read_barrier.values():
@@ -316,6 +392,11 @@ class PaxosReplica(Node):
         committed values, fill holes with NOOP (empty batch); re-propose
         everything in the window (paxos.go HandleP1b recovery path)."""
         self.active = True
+        self._renew_lease(self._p1_start)
+        if self._lease_enabled():
+            # any prior leader's lease renewal round started before our
+            # promises arrived, so it expires no later than this fence
+            self._fence_until = time.time() + self.cfg.lease_s
         # state transfer first: an acker ahead of our execute frontier
         # has executed (hence committed) everything below it; adopt its
         # snapshot + frontier so the merge never NOOPs an executed slot
@@ -418,6 +499,7 @@ class PaxosReplica(Node):
     def _commit(self, slot: int) -> None:
         e = self.log[slot]
         e.commit = True
+        self._renew_lease(e.timestamp)   # quorum round started then
         self.socket.broadcast(P3(self.ballot, slot, _wire_cmds(e.cmds)))
         self._exec()
 
@@ -479,11 +561,17 @@ class PaxosReplica(Node):
 
     def _answer_barrier_reads(self) -> None:
         """Leader reads whose barrier slot has fully executed read the
-        applied state now (every write they must observe is in)."""
+        applied state now (every write they must observe is in) — if
+        the lease still vouches for it; otherwise they go through the
+        log like writes."""
         done = [s for s in self._read_barrier if s < self.execute]
         db_get = self.db.get
         for s in done:
-            for r in self._read_barrier.pop(s):
+            reads = self._read_barrier.pop(s)
+            if not self._lease_ok():
+                self.propose(reads)
+                continue
+            for r in reads:
                 r.reply(Reply(r.command,
                               value=db_get(r.command.key) or b""))
 

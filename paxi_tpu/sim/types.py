@@ -50,6 +50,16 @@ class SimConfig:
     grid_q2: int = 1           # WPaxos: zones in a phase-2 grid quorum
     locality: float = 0.8      # WPaxos workload: P(demand home-zone object)
     fast_quorum: bool = True   # EPaxos fast path enabled
+    # BPaxos compartmentalized tier (protocols/bpaxos): node-index role
+    # split — the first ``n_proxies`` nodes are proxy leaders, the next
+    # ``grid_rows * grid_cols`` are the acceptor grid (write quorum =
+    # one full row, read quorum = one full column), the rest are
+    # replica executors; ``batch_max`` bounds the HT-Paxos batch a
+    # proxy amortizes over one grid round (commands per slot)
+    n_proxies: int = 2
+    grid_rows: int = 2
+    grid_cols: int = 2
+    batch_max: int = 4
 
     @property
     def majority(self) -> int:

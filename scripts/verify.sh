@@ -12,8 +12,8 @@
 #   scripts/verify.sh --metrics  # prepend the observability smoke stage
 #                                # (5 s chan bench + /metrics scrape)
 #   scripts/verify.sh --hunt     # prepend the divergence-hunt smoke
-#                                # stage: a ~40 s micro-campaign
-#                                # (paxos + abd + the fragile_counter
+#                                # stage: a micro-campaign (paxos +
+#                                # abd + bpaxos + the fragile_counter
 #                                # positive control) that must end with
 #                                # zero UNCLASSIFIED outcomes
 #   scripts/verify.sh --bench    # prepend the bench smoke stage: a
@@ -97,6 +97,22 @@ assert r["mesh"] == 8, r
 print(f"bench smoke OK: {r['committed_slots']} slots in "
       f"{r['wall_s']}s on mesh={r['mesh']}")
 PYEOF
+    echo "== bench smoke (bpaxos compartmentalized grid) =="
+    # the 11th protocol's bench_all config at a toy shape: grid-quorum
+    # commits must progress, the HT-Paxos batching must be visible
+    # (cmds > slots), and the oracle must stay clean
+    timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'PYEOF' || exit $?
+from paxi_tpu.protocols import sim_protocol
+from paxi_tpu.sim import SimConfig, simulate
+res = simulate(sim_protocol("bpaxos"),
+               SimConfig(n_replicas=7, n_slots=16), 16, 60)
+slots = int(res.metrics["committed_slots"])
+cmds = int(res.metrics["committed_cmds"])
+assert int(res.violations) == 0, int(res.violations)
+assert slots > 0 and cmds > slots, (slots, cmds)
+print(f"bpaxos bench smoke OK: {slots} slots / {cmds} cmds "
+      f"({cmds / slots:.2f}x amortization), violations=0")
+PYEOF
   elif [ "$1" = "--hunt" ]; then
     shift
     echo "== hunt micro-campaign (paxi_tpu/hunt/) =="
@@ -104,8 +120,8 @@ PYEOF
     # (fuzz -> capture -> shrink -> fabric replay -> classify), and
     # `hunt run` exits 2 on any unclassified witness
     HUNT_DIR=$(mktemp -d /tmp/paxi_hunt_smoke.XXXXXX)
-    timeout -k 10 300 env JAX_PLATFORMS=cpu python -m paxi_tpu hunt run \
-      --budget 2 --quick --protocols paxos,abd,fragile_counter \
+    timeout -k 10 420 env JAX_PLATFORMS=cpu python -m paxi_tpu hunt run \
+      --budget 2 --quick --protocols paxos,abd,bpaxos,fragile_counter \
       --dir "$HUNT_DIR" --traces-dir "$HUNT_DIR/noseed" || exit $?
     rm -rf "$HUNT_DIR"
   elif [ "$1" = "--lint" ]; then

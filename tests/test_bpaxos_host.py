@@ -1,0 +1,192 @@
+"""Host-runtime integration tests for compartmentalized BPaxos:
+grid-quorum commits, role split, role crashes, and the fabric-replayed
+mid-batch drop witness."""
+
+import asyncio
+
+import pytest
+
+from paxi_tpu.core.command import Command, Reply, Request
+from paxi_tpu.host.simulation import Cluster, chan_config
+from paxi_tpu.protocols.bpaxos.host import HUNT_ORACLE
+
+pytestmark = pytest.mark.host
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def submit(replica, key, value=b"", cid="c1", cmd_id=1):
+    fut = asyncio.get_running_loop().create_future()
+    replica.handle_client_request(Request(
+        command=Command(key, value, cid, cmd_id), reply_to=fut))
+    return fut
+
+
+async def do(replica, key, value=b"", cid="c1", cmd_id=1, timeout=5.0):
+    rep: Reply = await asyncio.wait_for(
+        submit(replica, key, value, cid, cmd_id), timeout)
+    assert rep.err is None, rep.err
+    return rep.value
+
+
+def test_grid_commit_and_role_split():
+    """A write commits through ONE FULL acceptor row; proxies and
+    replicas learn + execute, acceptors stay voting-only storage."""
+    async def main():
+        c = Cluster("bpaxos", n=7, http=False)
+        await c.start()
+        try:
+            await do(c["1.1"], 3, b"v3", cmd_id=1)
+            # entry points of every role forward correctly
+            await do(c["1.4"], 4, b"v4", cid="c2", cmd_id=1)   # acceptor
+            await do(c["1.7"], 5, b"v5", cid="c3", cmd_id=1)   # replica
+            assert await do(c["1.2"], 3, cid="c4", cmd_id=1) == b"v3"
+            await asyncio.sleep(0.05)
+            for i in ("1.1", "1.2", "1.7"):        # learner roles
+                assert c[i].db.get(3) == b"v3", i
+            for i in ("1.3", "1.4", "1.5", "1.6"):  # acceptor role
+                assert not c[i].log and c[i].db.get(3) is None, i
+                assert c[i].acc, i                  # but they did vote
+            assert HUNT_ORACLE(c) == 0
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_burst_batches_into_few_slots():
+    """HT-Paxos on the host: a burst of client commands rides a few
+    grid rounds, not one round per command."""
+    async def main():
+        c = Cluster("bpaxos", n=7, http=False)
+        await c.start()
+        try:
+            futs = [submit(c["1.1"], 10 + k, f"b{k}".encode(), "burst",
+                           k + 1) for k in range(16)]
+            await asyncio.gather(*[asyncio.wait_for(f, 5) for f in futs])
+            own = [s for s, e in c["1.1"].log.items()
+                   if s % 2 == 0 and e.cmds]
+            assert len(own) < 16, own   # coalesced
+            assert sum(len(c["1.1"].log[s].cmds) for s in own) == 16
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_replica_crash_tolerated():
+    """Role-crash variant: a dead replica executor is off every quorum
+    path — commits and replies continue untouched."""
+    async def main():
+        c = Cluster("bpaxos", n=7, http=False)
+        await c.start()
+        try:
+            c["1.7"].socket.crash(10.0)
+            await do(c["1.1"], 1, b"x", cmd_id=1)
+            assert await do(c["1.2"], 1, cid="c2", cmd_id=1) == b"x"
+            assert HUNT_ORACLE(c) == 0
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_proxy_crash_takeover_noop_fills():
+    """Role-crash variant: proxy 1.2 dies, its stripe's holes stall
+    execution until the survivor's gap strikes trigger takeover
+    recovery (column read -> NOOP row write), after which every
+    straddled reply drains."""
+    async def main():
+        c = Cluster("bpaxos", n=7, http=False)
+        await c.start()
+        try:
+            c["1.2"].socket.crash(30.0)
+            futs = []
+            for k in range(10):
+                futs.append(submit(c["1.1"], 2 * k, f"w{k}".encode(),
+                                   "cl", k + 1))
+                # separate tick flushes: each wave takes its own slot
+                # on 1.1's stripe, straddling a dead-stripe hole
+                await asyncio.sleep(0.02)
+            done = await asyncio.gather(
+                *[asyncio.wait_for(f, 20) for f in futs])
+            assert all(r.err is None for r in done)
+            assert c["1.1"].recovered > 0
+            assert HUNT_ORACLE(c) == 0
+        finally:
+            await c.stop()
+    run(main())
+
+
+def test_mid_batch_drop_witness_fabric_replay():
+    """The witness shape the hunt projects: ONE BP2a of a 2-command
+    batch vanishes on its way to a row member, replayed exactly on the
+    virtual-clock fabric.  Batch atomicity must hold (the surviving
+    row member stored the WHOLE batch), and takeover recovery's column
+    read must intersect the half-written row — resurrecting the full
+    batch, never a partial one."""
+    from paxi_tpu.host.fabric import VirtualClockFabric
+    from paxi_tpu.trace.host import SeqFault, SeqSchedule
+
+    async def main():
+        sched = SeqSchedule(n_steps=60, faults=[
+            SeqFault("1.1", "1.3", "BP2a", occurrence=0, action="drop"),
+        ])
+        fabric = VirtualClockFabric(sched)
+        cfg = chan_config(7, tag="bpmid")
+        cfg.http_addrs = {}
+        c = Cluster("bpaxos", cfg=cfg, fabric=fabric, http=False)
+        await c.start()
+        try:
+            futs = []
+
+            def issue(t):
+                if t == 0:
+                    # two commands -> one tick flush -> ONE BP2a batch
+                    futs.append(submit(c["1.1"], 1, b"a", "cl", 1))
+                    futs.append(submit(c["1.1"], 2, b"b", "cl", 2))
+                elif t % 3 == 0 and t < 40:
+                    # follow-on traffic: the commits that strike the gap
+                    futs.append(submit(c["1.1"], 10 + t, b"x", "cl",
+                                       10 + t))
+
+            fabric.on_step(issue)
+            await fabric.run(60, drain=True)
+            reps = await asyncio.gather(
+                *[asyncio.wait_for(f, 5) for f in futs])
+            assert all(r.err is None for r in reps)
+            assert fabric.stats["dropped_fault"] == 1
+            # atomicity: wherever slot 0 committed, it holds BOTH
+            # commands of the batch (recovery read the surviving row
+            # member's copy) — never one
+            for i in c.ids:
+                e = c[i].log.get(0) if c[i].log else None
+                if e is not None and e.commit and e.cmds:
+                    idents = [(x.client_id, x.command_id) for x in e.cmds]
+                    assert idents == [("cl", 1), ("cl", 2)], (i, idents)
+            assert c["1.1"].db.get(1) == b"a"
+            assert c["1.1"].db.get(2) == b"b"
+            assert c["1.1"].recovered > 0     # the read path ran
+            assert HUNT_ORACLE(c) == 0
+        finally:
+            await c.stop()
+    run(main())
+
+
+@pytest.mark.slow
+def test_hunt_classifies_noread_witness_reproduced():
+    """End-to-end acceptance: a captured bpaxos_noread witness runs
+    the whole pipeline (capture -> shrink -> fabric replay) and
+    classifies as REPRODUCED — both runtimes share the seeded bug."""
+    import tempfile
+
+    from paxi_tpu.hunt.engine import Campaign
+
+    with tempfile.TemporaryDirectory() as d:
+        camp = Campaign(d, protocols=["bpaxos_noread"], budget=1,
+                        quick=True, traces_dir=f"{d}/noseed",
+                        log=lambda m: None)
+        rep = camp.run()
+        t = rep["summary"]["totals"]
+        assert t["witnesses"] >= 1, rep
+        assert t["reproduced"] >= 1, rep
+        assert t["unclassified"] == 0, rep

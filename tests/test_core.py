@@ -96,3 +96,38 @@ def test_request_wire_strips_reply_channel():
     assert back.command.key == 5 and back.reply_to is None
     req.reply(Reply(req.command, b"ok"))
     assert got and got[0].value == b"ok"
+
+
+def test_quorum_rectangular_grid_row_col_intersect():
+    """The structural fact paxi-lint's PXQ rowcol model rests on
+    (analysis/quorum.py): grid_row demands EVERY member of some row,
+    grid_col EVERY member of some column, and for every grid shape any
+    satisfying pair shares at least one acceptor."""
+    for rows in range(1, 5):
+        for cols in range(1, 5):
+            ids = [new_id(1, i + 1) for i in range(rows * cols)]
+            for r in range(rows):
+                row = ids[r * cols:(r + 1) * cols]
+                wq = Quorum(ids)
+                for m in row:
+                    wq.ack(m)
+                assert wq.grid_row(cols)
+                # one cell short of a row is NOT a write quorum
+                if cols > 1:
+                    wq2 = Quorum(ids)
+                    for m in row[:-1]:
+                        wq2.ack(m)
+                    assert not wq2.grid_row(cols)
+                for c in range(cols):
+                    col = ids[c::cols]
+                    rq = Quorum(ids)
+                    for m in col:
+                        rq.ack(m)
+                    assert rq.grid_col(cols)
+                    if rows > 1:
+                        rq2 = Quorum(ids)
+                        for m in col[:-1]:
+                            rq2.ack(m)
+                        assert not rq2.grid_col(cols)
+                    # the shared cell: (row r, column c)
+                    assert set(row) & set(col) == {ids[r * cols + c]}

@@ -357,3 +357,107 @@ def test_closed_loop_warmup_split():
     out2 = Stats(ops=100, errors=0, duration=4.0).summary()
     assert out2["throughput_ops_s"] == 25.0
     assert "warmup_ops" not in out2
+
+
+# ---- forwarded-request coalescing (follower -> leader BATCH frames) ----
+def test_forward_path_batches_into_one_frame():
+    """A burst of client commands at a follower drains through the
+    per-destination forward buffer into WireRequestBatch frames: the
+    leader sees few frames, every command still commits and replies."""
+    async def main():
+        cfg = local_config(3, base_port=18860)
+        cfg.addrs = {i: f"chan://fwdb/{i}" for i in cfg.addrs}
+        c = Cluster("paxos", cfg=cfg, http=False)
+        await c.start()
+        try:
+            # elect a leader at 1.1 first
+            await asyncio.wait_for(await _submit(c["1.1"], 0, b"seed",
+                                                 "c", 1), 5)
+            follower = c["1.3"]
+            futs = [await _submit(follower, 10 + i, b"v%d" % i, "f",
+                                  i + 1) for i in range(20)]
+            reps = await asyncio.gather(
+                *[asyncio.wait_for(f, 5) for f in futs])
+            assert all(r.err is None for r in reps)
+            leader = c["1.1"]
+            frames = leader.metrics.counter("paxi_msgs_in_total",
+                                            type="WireRequestBatch")
+            singles = leader.metrics.counter("paxi_msgs_in_total",
+                                             type="WireRequest")
+            # the burst coalesced: far fewer frames than commands, and
+            # at least one real batch frame went over the wire
+            assert frames.value >= 1, frames.value
+            assert frames.value + singles.value < 20, (
+                frames.value, singles.value)
+            fwd_cmds = follower.metrics.counter(
+                "paxi_batch_cmds_total", path="forward")
+            assert fwd_cmds.value == 20, fwd_cmds.value
+        finally:
+            await c.stop()
+    run(main())
+
+
+# ---- chain host: batched descents --------------------------------------
+def test_chain_burst_batches_one_descent():
+    """The chain head reuses BatchBuffer: a write burst rides ONE
+    Propagate descent (one seq), with per-command replies."""
+    async def main():
+        cfg = local_config(3, base_port=18870)
+        cfg.addrs = {i: f"chan://chb/{i}" for i in cfg.addrs}
+        c = Cluster("chain", cfg=cfg, http=False)
+        await c.start()
+        try:
+            futs = [await _submit(c["1.1"], k, b"v%d" % k, "c", k + 1)
+                    for k in range(10)]
+            reps = await asyncio.gather(
+                *[asyncio.wait_for(f, 5) for f in futs])
+            assert all(r.err is None for r in reps)
+            head = c["1.1"]
+            assert head.seq < 10, head.seq       # coalesced descents
+            for i in c.ids:                       # batch applied in order
+                for k in range(10):
+                    assert c[i].db.get(k) == b"v%d" % k, (i, k)
+        finally:
+            await c.stop()
+    run(main())
+
+
+# ---- leader lease: read-index reads across elections -------------------
+def test_leader_lease_blocks_stale_reads_across_election():
+    """Election-interleaved lease regression: a partitioned old leader
+    whose lease has expired must NOT serve a barrier read from its
+    stale snapshot — the read falls back to the log (and times out
+    while partitioned) instead of returning the pre-election value."""
+    async def main():
+        cfg = local_config(3, base_port=18880)
+        cfg.addrs = {i: f"chan://lease/{i}" for i in cfg.addrs}
+        cfg.leader_reads = True
+        cfg.lease_s = 0.15
+        c = Cluster("paxos", cfg=cfg, http=False)
+        await c.start()
+        try:
+            old = c["1.1"]
+            w = await _submit(old, 5, b"old", "c", 1)
+            await asyncio.wait_for(w, 5)
+            assert old.is_leader()
+            # lease-valid leader read serves locally and fresh
+            g = await _submit(old, 5, b"", "c", 2)
+            assert (await asyncio.wait_for(g, 5)).value == b"old"
+            # partition the old leader, elect 1.2, commit a new value
+            old.socket.crash(10.0)
+            c["1.2"].run_phase1()
+            await asyncio.sleep(0.3)   # election + old lease expiry
+            w2 = await _submit(c["1.2"], 5, b"new", "c2", 1)
+            assert (await asyncio.wait_for(w2, 5)).value is not None
+            assert old.is_leader()     # partitioned: still thinks so
+            # the stale read: lease expired -> routed through the log
+            # -> cannot commit behind the partition -> no stale answer
+            g2 = await _submit(old, 5, b"", "c", 3)
+            with pytest.raises(asyncio.TimeoutError):
+                await asyncio.wait_for(g2, 0.5)
+            # the new leader serves the committed value
+            g3 = await _submit(c["1.2"], 5, b"", "c3", 1)
+            assert (await asyncio.wait_for(g3, 5)).value == b"new"
+        finally:
+            await c.stop()
+    run(main())

@@ -212,6 +212,90 @@ def test_quorum_repo_tree_is_clean():
     assert quorum.check(ROOT) == []
 
 
+def test_quorum_rowcol_sites_proved_not_baselined():
+    """The BPaxos grid is PROVED, not baselined: both runtimes expose
+    resolved rowcol sites (sim tallies with derived per-line fullness,
+    host grid_row/grid_col pairs on one universe) and the repo-clean
+    pin above covers them with zero baseline entries."""
+    import ast
+    preds = quorum.load_predicates(ROOT)
+    props = quorum.load_sim_props(ROOT)
+    sim_tree = ast.parse(
+        (ROOT / "paxi_tpu/protocols/bpaxos/sim.py").read_text())
+    sim = [s for s in quorum.sim_sites(sim_tree, props,
+                                       quorum.Resolver(sim_tree))
+           if s.kind == "rowcol"]
+    assert {frozenset(s.phases) for s in sim} == {
+        frozenset({"write"}), frozenset({"read"})}
+    assert all(s.resolved for s in sim)
+    # derived fullness: a counted line is a COMPLETE line at every shape
+    for s in sim:
+        for gr, gc in ((1, 1), (2, 3), (4, 2)):
+            full = gc if "write" in s.phases else gr
+            assert s.fill_fn(gr, gc) == full, (s.text, gr, gc)
+    host_tree = ast.parse(
+        (ROOT / "paxi_tpu/protocols/bpaxos/host.py").read_text())
+    host = [s for s in quorum.host_sites(host_tree, preds,
+                                         quorum.Resolver(host_tree))
+            if s.kind == "rowcol"]
+    assert len(host) >= 3 and all(s.resolved for s in host)
+    assert len({s.universe for s in host}) == 1   # one acceptor grid
+
+
+def test_quorum_rowcol_catches_short_row(tmp_path):
+    """A write tally counting GC-1 cells as a complete row must fail
+    the grid proof (PXQ504) — the exact weakening the bpaxos_noread
+    family of bugs rides on."""
+    (tmp_path / "sim.py").write_text(
+        "def mailbox_spec(cfg):\n"
+        "    return {'p2a': ('bal',)}\n"
+        "def _row_quorums(acks, cfg):\n"
+        "    GR, GC = cfg.grid_rows, cfg.grid_cols\n"
+        "    cnt = 0\n"
+        "    for r in range(GR):\n"
+        "        per = pop(acks)\n"
+        "        cnt = cnt + (per >= GC - 1)\n"
+        "    return cnt\n"
+        "def _col_quorums(acks, cfg):\n"
+        "    GR, GC = cfg.grid_rows, cfg.grid_cols\n"
+        "    cnt = 0\n"
+        "    for c in range(GC):\n"
+        "        per = pop(acks)\n"
+        "        cnt = cnt + (per >= GR)\n"
+        "    return cnt\n"
+        "def step(state, inbox, ctx):\n"
+        "    cfg = ctx.cfg\n"
+        "    rowq = _row_quorums(state['a'], cfg)\n"
+        "    colq = _col_quorums(state['r'], cfg)\n"
+        "    newly = rowq >= 1\n"
+        "    done = colq >= 1\n"
+        "    return state\n")
+    preds = quorum.load_predicates(ROOT)
+    props = quorum.load_sim_props(ROOT)
+    vs = quorum.check_file(tmp_path / "sim.py", tmp_path, preds, props)
+    assert "PXQ504" in [v.code for v in vs]
+    assert any("complete" in v.message for v in vs)
+
+
+def test_quorum_rowcol_catches_grid_mismatch(tmp_path):
+    """Host grid_row/grid_col pairs must shape the grid with the SAME
+    cols expression — a mismatched pair re-shapes the grid between
+    write and read and loses the shared cell (PXQ504)."""
+    (tmp_path / "host.py").write_text(
+        "from paxi_tpu.core.quorum import Quorum\n"
+        "class R:\n"
+        "    def _accept_done(self, e):\n"
+        "        q = Quorum(self.acceptors)\n"
+        "        if e.quorum.grid_row(self.cfg.grid_cols): pass\n"
+        "    def _read_done(self, e):\n"
+        "        if e.quorum.grid_col(self.cfg.grid_rows): pass\n")
+    preds = quorum.load_predicates(ROOT)
+    props = quorum.load_sim_props(ROOT)
+    vs = quorum.check_file(tmp_path / "host.py", tmp_path, preds, props)
+    assert "PXQ504" in [v.code for v in vs]
+    assert any("mismatch" in v.message for v in vs)
+
+
 # ---- ballot-guard domination (stage 2) -----------------------------------
 def test_ballot_fixture_catches_each_check():
     vs = ballots.check(ROOT, files=[FIX / "fixture_ballot.py"])
@@ -234,11 +318,12 @@ def test_ballot_fixture_catches_each_check():
 
 
 def test_ballot_repo_findings_are_baselined():
-    """The three real PXB603 findings (commit-path applications) are
+    """The four real PXB603 findings (commit-path applications) are
     suppressed with written reasons; nothing else fires (tier-1 pin)."""
     report = analysis.run_lint(rules=["ballot-guard"])
     assert report.ok, report.render()
     assert sorted(v.path for v, _ in report.suppressed) == [
+        "paxi_tpu/protocols/bpaxos/host.py",
         "paxi_tpu/protocols/epaxos/host.py",
         "paxi_tpu/protocols/paxos/host.py",
         "paxi_tpu/protocols/sdpaxos/host.py",
