@@ -16,6 +16,13 @@ int-expression evaluator, guard domination):
 - ``ballot-guard``         (ballots.py,     PXB6xx)
 - ``sim-host-parity``      (parity.py,      PXS7xx)
 
+Stage 3 — whole-program families on the ProjectIndex (project.py:
+import resolution, cross-module call graph with guard inheritance
+across file boundaries; ``lint --graph`` dumps it as DOT):
+
+- ``cross-module-flow``    (crossflow.py,   PXF8xx)
+- ``async-atomicity``      (asyncflow.py,   PXA9xx)
+
 Entry points: ``python -m paxi_tpu lint [--rule ...] [--json]`` (cli.py;
 ``--rule`` takes family names or code prefixes like ``PXQ,PXB``) and
 :func:`run_lint` for tests/tooling.  Intentional exceptions live in
@@ -30,8 +37,8 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence
 
-from paxi_tpu.analysis import astutil, ballots, concurrency, handlers, \
-    parity, purity, quorum, tracemap
+from paxi_tpu.analysis import astutil, asyncflow, ballots, concurrency, \
+    crossflow, handlers, parity, purity, quorum, tracemap
 from paxi_tpu.analysis.model import (LintReport, Suppression, Violation,
                                      apply_suppressions, inline_disables,
                                      load_baseline)
@@ -49,6 +56,8 @@ RULES = {
     quorum.RULE: quorum,
     ballots.RULE: ballots,
     parity.RULE: parity,
+    crossflow.RULE: crossflow,
+    asyncflow.RULE: asyncflow,
 }
 
 # violation-code prefix -> rule family, the CLI's short spelling
@@ -62,6 +71,8 @@ CODE_PREFIXES = {
     "PXQ": quorum.RULE,
     "PXB": ballots.RULE,
     "PXS": parity.RULE,
+    "PXF": crossflow.RULE,
+    "PXA": asyncflow.RULE,
 }
 
 # pair-driven rules (registry-derived sim/host pairs instead of globs)

@@ -638,6 +638,13 @@ def cmd_lint(args) -> int:
 
     from paxi_tpu import analysis
 
+    if args.graph:
+        # inspectable analysis coverage: the cross-module call graph
+        # the stage-3 rules walk, as DOT (pipe into `dot -Tsvg`)
+        from paxi_tpu.analysis.project import shared_index
+        print(shared_index(analysis.repo_root()).to_dot())
+        return 0
+
     baseline = None if args.no_baseline else (
         Path(args.baseline) if args.baseline else analysis.DEFAULT_BASELINE)
     try:
@@ -899,6 +906,10 @@ def main(argv=None) -> int:
                     help="exit 1 on stale (unused) baseline entries — "
                          "the verify.sh --lint gate's baseline-shrink "
                          "policy")
+    li.add_argument("-graph", "--graph", action="store_true",
+                    help="dump the ProjectIndex cross-module call "
+                         "graph as GraphViz DOT (nodes colored by "
+                         "package) instead of linting")
     li.set_defaults(fn=cmd_lint)
 
     me = sub.add_parser("metrics",

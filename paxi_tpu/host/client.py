@@ -43,21 +43,30 @@ class _Conn:
         self._loop = None   # cached: get_running_loop costs ~8 µs here
 
     async def ensure(self) -> None:
-        if self.writer is None or self.writer.is_closing():
-            self._loop = asyncio.get_running_loop()
-            _, host, port = parse_addr(self.url)
-            self.reader, self.writer = await asyncio.open_connection(
-                host, port)
-            if self._rt is not None:
-                self._rt.cancel()
-            # a reconnect abandons the old pipeline: every displaced
-            # waiter must FAIL (not hang) — callbacks fire so callers'
-            # in-flight accounting stays balanced
-            self._fail_waiters(IOError("connection replaced"))
-            self._waiters = collections.deque()
-            self._outbuf = []
-            self._rt = asyncio.create_task(
-                self._read_loop(self.reader, self._waiters))
+        if self.writer is not None and not self.writer.is_closing():
+            return
+        self._loop = asyncio.get_running_loop()
+        _, host, port = parse_addr(self.url)
+        reader, writer = await asyncio.open_connection(host, port)
+        if self.writer is not None and not self.writer.is_closing():
+            # lost a concurrent ensure(): while this dial was in
+            # flight another task installed a healthy connection —
+            # adopting ours would orphan that pipeline's waiters and
+            # leak its socket, so keep the winner (PXA901's
+            # check-then-act race, re-validated after the await)
+            writer.close()
+            return
+        if self._rt is not None:
+            self._rt.cancel()
+        # a reconnect abandons the old pipeline: every displaced
+        # waiter must FAIL (not hang) — callbacks fire so callers'
+        # in-flight accounting stays balanced
+        self._fail_waiters(IOError("connection replaced"))
+        self._waiters = collections.deque()
+        self._outbuf = []
+        self.reader, self.writer = reader, writer
+        self._rt = asyncio.create_task(
+            self._read_loop(reader, self._waiters))
 
     def _fail_waiters(self, err: Exception) -> None:
         while self._waiters:

@@ -165,10 +165,11 @@ class VirtualClockFabric:
         t).  ``drain`` then keeps stepping until no deliveries remain
         in flight, so late-delayed messages land before the oracle
         reads the cluster."""
-        t = self.step            # fresh fabric: 0; resumed: continues
-        end = t + n_steps        # drivers fire for steps [t, end)
-        while t < end or (drain and self._heap):
-            self.step = t
+        end = self.step + n_steps    # drivers fire for steps [.., end)
+        while self.step < end or (drain and self._heap):
+            t = self.step        # re-read per iteration: the clock
+            # register is the shared truth submit() stamps sends with,
+            # so this loop never writes a pre-await snapshot back
             # 1. deliver everything due this step (sent at t-1-delay)
             while self._heap and self._heap[0][0] <= t:
                 _, _, src, dst, msg = heapq.heappop(self._heap)
@@ -186,5 +187,4 @@ class VirtualClockFabric:
                     fn(t)
             # 3. drain the loop: handlers consume, their sends stamp t
             await self._settle()
-            t += 1
-        self.step = t
+            self.step += 1

@@ -1,0 +1,123 @@
+"""Fixture for the async-atomicity family (PXA9xx).
+
+Seeded interleaving races (lost update, check-then-act, loop
+wrap-around, deferred-callback snapshot write, sync lock held across
+an await) next to the clean shapes the rule must NOT flag (atomic
+read-modify-write in one statement, post-await re-validation, a
+deferred callback that re-reads).  Parsed only, never imported.
+"""
+
+import asyncio
+import threading
+
+
+class RacyServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.conn = None
+        self.backlog = []
+        self.probe = None
+        self.task_fn = None
+
+    # ---- seeded mutants -------------------------------------------------
+    async def lost_update(self):
+        v = self.count
+        await asyncio.sleep(0)
+        self.count = v + 1                 # PXA901: stale snapshot
+
+    async def check_then_act(self):
+        if self.conn is None:
+            self.conn = await self.dial()  # PXA901: stale guard
+
+    async def loop_wraparound(self):
+        v = self.count
+        while True:
+            await asyncio.sleep(0)
+            self.count = v + 1             # PXA901: stale across laps
+
+    async def awaited_arg(self):
+        self.count = await self.bump(self.count)  # PXA901: awaited-arg snapshot
+
+    async def left_of_await(self):
+        self.count = self.count + await self.bump(0)  # PXA901: pre-await load
+
+    async def aug_across_await(self):
+        self.count += await self.bump(0)   # PXA901: aug target pre-load
+
+    async def relabeled_snapshot(self):
+        v = self.count
+        await asyncio.sleep(0)
+        w = v
+        self.count = w + 1                 # PXA901: laundered snapshot
+
+    def deferred_snapshot(self, loop):
+        n = self.count
+
+        def bump():
+            self.count = n + 1             # PXA902: captured snapshot
+
+        loop.call_soon(bump)
+
+    async def lock_across_await(self):
+        with self._lock:
+            await asyncio.sleep(0)         # PXA903: loop-blocking hold
+
+    async def lambda_is_not_revalidation(self):
+        v = self.count
+        await asyncio.sleep(0)
+        self.probe = lambda: self.count    # load runs later, not here
+        self.count = v + 1                 # PXA901: decoy lambda load
+
+    # ---- clean shapes ---------------------------------------------------
+    async def atomic_rmw(self):
+        await asyncio.sleep(0)
+        self.count = self.count + 1        # read+write, no await between
+
+    async def atomic_aug(self):
+        await asyncio.sleep(0)
+        self.count += 1                    # reads at write time
+
+    async def revalidated(self):
+        v = self.count
+        await asyncio.sleep(0)
+        if self.count == v:
+            self.count = v + 1             # re-read after the await
+
+    async def fresh_guard(self):
+        await self.dial()
+        if self.conn is None:
+            self.conn = object()           # guard after the suspension
+
+    def deferred_reread(self, loop):
+        def bump():
+            self.count = self.count + 1    # callback re-reads
+
+        loop.call_soon(bump)
+
+    async def locals_only(self):
+        items = list(self.backlog)
+        await asyncio.sleep(0)
+        items.append(1)                    # plain local, not state
+
+    async def lock_with_deferred_task(self):
+        with self._lock:
+            async def task():
+                await self.dial()          # runs at a later tick
+
+            self.task_fn = task            # nothing suspends under the lock
+
+    async def read_after_await(self):
+        self.count = (await self.bump(0)) + self.count  # load after resumption
+
+    async def rebound_fresh(self):
+        await asyncio.sleep(0)
+        v = self.count
+        w = v
+        self.count = w + 1                 # snapshot taken after the await
+
+    async def dial(self):
+        return object()
+
+    async def bump(self, v):
+        return v + 1

@@ -1,0 +1,64 @@
+"""Shared-helper fixture for the cross-module-flow family (PXF8xx).
+
+A miniature ballot_ring: owns its planes via ``KEYS``, writes the
+``ballot`` register under a caller-supplied mask (the guard obligation
+the rule must chase to every call site in fixture_crossflow_kernel),
+tallies quorums against threshold parameters, and carries one seeded
+module-local mutant (``blind_bump``).  Parsed only, never imported.
+"""
+
+import jax.numpy as jnp
+
+KEYS = ("ballot", "active", "p1_acks", "log_bal", "log_cmd")
+
+
+def own_fx(st, stride):
+    return (st["ballot"] > 0) & (st["ballot"] % stride == 0)
+
+
+def depose_ok(st, mask, bal):
+    """Every call site passes a ballot-guarded mask — proven there."""
+    return {**st, "ballot": jnp.where(mask, bal, st["ballot"]),
+            "active": st["active"] & ~mask}
+
+
+def depose_unchecked(st, mask, bal):
+    """One call site passes a timer-derived mask — PXF801 fires here
+    with the offending call site named."""
+    return {**st, "ballot": jnp.where(mask, bal, st["ballot"])}
+
+
+def blind_bump(st, m):
+    """Seeded PXF801 (module-local): a message ballot lands in the
+    accepted-ballot plane with no comparison anywhere."""
+    oh = m["slot"] == 0
+    return {**st, "log_bal": jnp.where(oh, m["bal"], st["log_bal"])}
+
+
+def elect_fx(st, fire, stride):
+    """Monotone by construction: max over the current plane."""
+    new_bal = (jnp.max(st["ballot"], axis=0) // stride + 1) * stride
+    return {**st, "ballot": jnp.where(fire, new_bal, st["ballot"])}
+
+
+def tally_fx_p1(st, m, majority):
+    """Phase-1 tally: acks filtered by a ballot comparison, threshold
+    from the caller (the PXF803 derivation chases the argument)."""
+    ok = m["valid"] & (m["bal"] == st["ballot"])
+    acks = st["p1_acks"] | ok
+    win = own_fx(st, 8) & (jnp.sum(acks, axis=0) >= majority)
+    return {**st, "p1_acks": acks}, win
+
+
+def tally_fx_p2(st, m, majority):
+    """Phase-2 tally against the caller's threshold."""
+    ok = m["valid"] & (m["bal"] == st["ballot"])
+    acc = jnp.sum(ok, axis=0)
+    win = acc >= majority
+    return st, win
+
+
+def shared_write(st, sel):
+    """The owner's write to the shared ``log_cmd`` carry plane — the
+    PXF802 disjointness counterpart for the kernel's direct writes."""
+    return {**st, "log_cmd": jnp.where(sel, 7, st["log_cmd"])}

@@ -1,0 +1,67 @@
+"""Kernel fixture for the cross-module-flow family (PXF8xx).
+
+Drives fixture_crossflow_helper across the module boundary with a mix
+of clean shapes (ballot-guarded depose, monotone election, disjoint
+shared-plane write, intersecting majority pair) and seeded mutants:
+
+- a non-ballot mask passed into ``depose_unchecked`` (PXF801 at the
+  helper write, naming this call site);
+- a direct ``log_cmd`` write whose guard overlaps the helper's
+  (PXF802);
+- a thirds-threshold phase-1 tally that cannot intersect phase 2
+  (PXF803);
+- an unresolvable threshold (PXF804).
+
+Parsed only, never imported.
+"""
+
+import jax.numpy as jnp
+
+from tests.fixtures.lint import fixture_crossflow_helper as fh
+
+
+def mailbox_spec(cfg):
+    return {"p1": ("bal",), "p2": ("bal", "slot")}
+
+
+def step(state, inbox, ctx):
+    cfg = ctx.cfg
+    MAJ = cfg.majority
+    st = {k: state[k] for k in fh.KEYS}
+    m1, m2 = inbox["p1"], inbox["p2"]
+
+    # clean: the mask is a ballot comparison — the helper write is
+    # proven AT THIS CALL SITE (cross-module guard inheritance)
+    promote = m1["bal"] > st["ballot"]
+    st = fh.depose_ok(st, promote, m1["bal"])
+
+    # seeded PXF801 via the boundary: a timer mask deposes the ballot
+    idle = state["timer"] <= 0
+    st = fh.depose_unchecked(st, idle, m1["bal"])
+
+    # clean: monotone election through the helper
+    st = fh.elect_fx(st, idle, cfg.ballot_stride)
+
+    # clean quorum pair: majority x majority intersects for all n
+    st, win1 = fh.tally_fx_p1(st, m1, MAJ)
+    st, win2 = fh.tally_fx_p2(st, m2, MAJ)
+
+    # seeded PXF803: a thirds-sized phase-1 quorum cannot intersect
+    st, win3 = fh.tally_fx_p1(st, m1, cfg.n_replicas // 3)
+
+    # seeded PXF804: a threshold the evaluator cannot resolve
+    st, win4 = fh.tally_fx_p2(st, m2, ctx.magic_quorum)
+
+    # shared-plane writes to the helper-owned log_cmd carry field:
+    sel = st["ballot"] > 0
+    st = fh.shared_write(st, sel)
+    # clean: guarded by ~sel — disjoint from the helper's write
+    st = {**st, "log_cmd": jnp.where(~sel & (m2["slot"] == 0),
+                                     m2["slot"], st["log_cmd"]),
+          "active": st["active"]}
+    # seeded PXF802: overlapping guard on the same carry plane
+    st = {**st, "log_cmd": jnp.where(m2["slot"] > 1, m2["slot"],
+                                     st["log_cmd"]),
+          "active": st["active"]}
+
+    return st, {}

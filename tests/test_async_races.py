@@ -1,0 +1,148 @@
+"""Regression tests for the interleaving races the PXA9xx family
+(analysis/asyncflow.py) surfaced on the serving path.
+
+Static finding -> dynamic pin: each test reproduces the interleaving
+the rule flagged and asserts the fixed behavior, so the code can never
+quietly regress back to the shape the linter (now) rejects.
+"""
+
+import asyncio
+
+import pytest
+
+from paxi_tpu.host.client import _Conn
+from paxi_tpu.host.fabric import VirtualClockFabric
+
+
+class _MiniHTTP:
+    """Counts connections and answers one-line HTTP so _Conn's real
+    read loop can run against it."""
+
+    def __init__(self):
+        self.server = None
+        self.opened = 0
+        self.closed = 0
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._serve,
+                                                 "127.0.0.1", 0)
+        return self.server.sockets[0].getsockname()[1]
+
+    async def _serve(self, reader, writer):
+        self.opened += 1
+        try:
+            while True:
+                head = await reader.readuntil(b"\r\n\r\n")
+                if not head:
+                    break
+                writer.write(b"HTTP/1.1 200 OK\r\n"
+                             b"Content-Length: 2\r\n\r\nok")
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            self.closed += 1
+            writer.close()
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+
+
+def test_concurrent_ensure_single_pipeline():
+    """PXA901 regression (client.py _Conn.ensure): two tasks entering
+    ensure() concurrently both pass the writer-is-dead check and both
+    dial; before the fix the second adoption orphaned the first
+    pipeline (leaked socket, waiters failed spuriously).  The fix
+    re-validates after the await: the loser closes its own dial and
+    keeps the winner."""
+
+    async def main():
+        srv = _MiniHTTP()
+        port = await srv.start()
+        conn = _Conn(f"http://127.0.0.1:{port}")
+        await asyncio.gather(conn.ensure(), conn.ensure())
+        # both dialed (both passed the pre-await check)...
+        assert srv.opened == 2
+        # ...but exactly one connection was adopted; the loser closed
+        # its socket instead of replacing the winner's pipeline
+        for _ in range(50):
+            if srv.closed == 1:
+                break
+            await asyncio.sleep(0.01)
+        assert srv.closed == 1
+        assert conn.writer is not None and not conn.writer.is_closing()
+        # the surviving pipeline serves requests
+        status, _headers, payload = await conn.request("GET", "/1", {},
+                                                       b"")
+        assert (status, payload) == (200, b"ok")
+        # a third ensure() on the healthy connection is a no-op
+        await conn.ensure()
+        assert srv.opened == 2
+        conn.close()
+        await srv.stop()
+
+    asyncio.run(main())
+
+
+def test_ensure_still_replaces_dead_connection():
+    """The re-validation must not break the reconnect path: a closed
+    writer is replaced and displaced waiters fail instead of hanging."""
+
+    async def main():
+        srv = _MiniHTTP()
+        port = await srv.start()
+        conn = _Conn(f"http://127.0.0.1:{port}")
+        await conn.ensure()
+        first = conn.writer
+        failures = []
+        conn._waiters.append(
+            lambda s, h, p, e: failures.append(e))
+        first.close()
+        await asyncio.sleep(0)
+        await conn.ensure()
+        assert conn.writer is not first
+        assert len(failures) == 1 and failures[0] is not None
+        status, _h, payload = await conn.request("GET", "/1", {}, b"")
+        assert (status, payload) == (200, b"ok")
+        conn.close()
+        await srv.stop()
+
+    asyncio.run(main())
+
+
+def test_fabric_clock_is_shared_truth_across_resumes():
+    """PXA901 regression (fabric.py run): the clock register is read
+    fresh each iteration and advanced in place, never written back
+    from a pre-settle snapshot — resumed runs continue the step count
+    and drivers fire once per logical step."""
+
+    async def main():
+        fab = VirtualClockFabric()
+        fired = []
+        fab.on_step(fired.append)
+        seen = []
+        fab.attach("a", seen.append)
+        fab.submit("b", "a", "m0")            # delivered at step 1
+        await fab.run(3)
+        assert fab.step == 3
+        fab.submit("b", "a", "m1")            # stamped with step 3
+        await fab.run(2)
+        assert fab.step == 5
+        assert fired == [0, 1, 2, 3, 4]
+        assert seen == ["m0", "m1"]
+        assert [t for t, *_ in fab.delivery_log] == [1, 4]
+
+    asyncio.run(main())
+
+
+@pytest.mark.parametrize("n", [1, 4])
+def test_fabric_run_zero_heap_drain_unchanged(n):
+    """drain=True with nothing in flight stops at exactly n steps."""
+
+    async def main():
+        fab = VirtualClockFabric()
+        await fab.run(n)
+        assert fab.step == n
+
+    asyncio.run(main())

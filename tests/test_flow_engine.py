@@ -1,0 +1,197 @@
+"""Unit coverage for the analysis engine's two foundations: the
+symbolic integer evaluator (flow.SymEval — exact rationals, so quorum
+ceil idioms cannot drift) and the stage-3 ProjectIndex (import
+resolution and cross-module call binding).  Pure AST, no jax."""
+
+import ast
+from fractions import Fraction
+from pathlib import Path
+
+from paxi_tpu.analysis import flow
+from paxi_tpu.analysis.project import ProjectIndex
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def ev(src, env=None, **kw):
+    return flow.SymEval(env or {}, **kw).eval(
+        ast.parse(src, mode="eval").body)
+
+
+def evb(src, env=None):
+    return flow.SymEval(env or {}).eval_bool(
+        ast.parse(src, mode="eval").body)
+
+
+# ---- SymEval edge cases --------------------------------------------------
+def test_symeval_negative_floor_division():
+    """Python floors toward -inf; the evaluator must match (the
+    ``-(-3*n//4)`` ceil idiom depends on it)."""
+    assert ev("-7 // 2") == Fraction(-4)
+    assert ev("7 // -2") == Fraction(-4)
+    assert ev("-7 % 3") == Fraction(2)       # sign follows the divisor
+    assert ev("7 % -3") == Fraction(-2)
+
+
+def test_symeval_ceil_idioms_agree():
+    """``-(-3*n//4)``, ``math.ceil(3*n/4)`` and the exact Fraction
+    division all land on the same integer for every n, including the
+    n where 3n/4 is exact."""
+    for n in range(1, 50):
+        env = {"n": Fraction(n)}
+        a = ev("-(-3 * n // 4)", env)
+        b = ev("math.ceil(3 * n / 4)", env)
+        assert a == b == Fraction(-((-3 * n) // 4)), n
+
+
+def test_symeval_ceil_of_fraction_stays_exact():
+    """math.ceil over a true Fraction value (15/4 etc.) must not take
+    a float detour: 10**18 + tiny offsets stay exact."""
+    big = 10 ** 18
+    env = {"n": Fraction(big + 1)}
+    assert ev("math.ceil(n / 2)", env) == Fraction(big // 2 + 1)
+    assert ev("math.floor(n / 2)", env) == Fraction(big // 2)
+
+
+def test_symeval_max_min_nesting():
+    env = {"z": Fraction(5), "q": Fraction(2)}
+    assert ev("max(z - q + 1, 1)", env) == Fraction(4)
+    assert ev("max(min(z, q), min(1, 7))", env) == Fraction(2)
+    assert ev("min(max(z - 7, 0) + 1, q)", env) == Fraction(1)
+    # any unresolvable leaf poisons the call, not the whole run
+    assert ev("max(z, mystery)", env) is None
+    assert ev("abs(q - z)", env) == Fraction(3)
+
+
+def test_symeval_known_helper_expansion():
+    funcs = {"majority_size": (["n"], ast.parse("n // 2 + 1",
+                                                mode="eval").body)}
+    got = ev("majority_size(7)", {}, funcs=funcs)
+    assert got == Fraction(4)
+    # helpers compose with arithmetic around the call
+    got = ev("majority_size(n) + 1", {"n": Fraction(9)}, funcs=funcs)
+    assert got == Fraction(6)
+
+
+def test_symeval_bool_chains_and_ifexp():
+    assert evb("2 <= n < 5", {"n": Fraction(3)}) is True
+    assert evb("2 <= n < 5", {"n": Fraction(5)}) is False
+    assert evb("not (n > 2 and n < 4)", {"n": Fraction(3)}) is False
+    assert ev("(a if a > b else b) + 1",
+              {"a": Fraction(2), "b": Fraction(7)}) == Fraction(8)
+    assert evb("n > unknown", {"n": Fraction(3)}) is None
+
+
+def test_min_satisfying_threshold_derivation():
+    pred = ast.parse("len(self.acks) > n // 2", mode="eval").body
+    evr = flow.SymEval({"n": Fraction(5)})
+    assert flow.min_satisfying(pred, "len(self.acks)", evr, 5) == 3
+    evr = flow.SymEval({"n": Fraction(4)})
+    assert flow.min_satisfying(pred, "len(self.acks)", evr, 4) == 3
+
+
+# ---- ProjectIndex import resolution --------------------------------------
+def _mini_repo(tmp_path):
+    pkg = tmp_path / "pkg"
+    (pkg / "sub").mkdir(parents=True)
+    (pkg / "__init__.py").write_text(
+        "from pkg.core import spark\n")
+    (pkg / "core.py").write_text(
+        "def spark():\n    return 1\n"
+        "def helper_fn(x):\n    return x\n")
+    (pkg / "sub" / "__init__.py").write_text("")
+    (pkg / "sub" / "deep.py").write_text(
+        "def deep_fn():\n    return 2\n")
+    (pkg / "user.py").write_text(
+        "import pkg.sub.deep as dz\n"
+        "from pkg import core as c2\n"
+        "from pkg.core import helper_fn as hf\n"
+        "from pkg import spark\n"           # package re-export
+        "from . import core\n"              # relative module import
+        "def run():\n"
+        "    dz.deep_fn()\n"
+        "    c2.helper_fn(1)\n"
+        "    hf(2)\n"
+        "    spark()\n"
+        "    core.spark()\n")
+    # a fixture-style module under a namespace dir (no __init__.py)
+    ns = tmp_path / "ns"
+    ns.mkdir()
+    (ns / "leaf.py").write_text("def leaf_fn():\n    return 3\n")
+    (pkg / "nsuser.py").write_text(
+        "from ns import leaf\n"
+        "def go():\n    leaf.leaf_fn()\n")
+    # the call-graph universe is paxi_tpu/** + extras; give the mini
+    # repo its own package dir so build_graph sees it
+    (tmp_path / "paxi_tpu").mkdir()
+    return tmp_path
+
+
+def _calls_of(idx, rel):
+    info = idx.module(rel)
+    out = {}
+    for node in ast.walk(info.tree):
+        if isinstance(node, ast.Call):
+            out[ast.unparse(node.func)] = idx.resolve_call(rel, node)
+    return out
+
+
+def test_project_index_import_corner_cases(tmp_path):
+    root = _mini_repo(tmp_path)
+    idx = ProjectIndex(root)
+    calls = _calls_of(idx, "pkg/user.py")
+    assert calls["dz.deep_fn"] == ("pkg/sub/deep.py", "deep_fn")
+    assert calls["c2.helper_fn"] == ("pkg/core.py", "helper_fn")
+    assert calls["hf"] == ("pkg/core.py", "helper_fn")
+    # ``from pkg import spark`` chases the __init__ re-export
+    assert calls["spark"] == ("pkg/core.py", "spark")
+    # ``from . import core`` (relative, module-not-symbol)
+    assert calls["core.spark"] == ("pkg/core.py", "spark")
+
+
+def test_project_index_namespace_package(tmp_path):
+    """A dir with no __init__.py (how the lint fixtures live) still
+    resolves submodule imports."""
+    root = _mini_repo(tmp_path)
+    idx = ProjectIndex(root)
+    calls = _calls_of(idx, "pkg/nsuser.py")
+    assert calls["leaf.leaf_fn"] == ("ns/leaf.py", "leaf_fn")
+
+
+def test_project_index_unresolvables_are_none(tmp_path):
+    root = _mini_repo(tmp_path)
+    idx = ProjectIndex(root)
+    info = idx.module("pkg/user.py")
+    assert info is not None
+    assert idx.resolve_module("json") is None          # stdlib
+    assert idx.resolve_symbol("pkg/user.py", "nope") is None
+    assert idx.module("pkg/missing.py") is None
+
+
+def test_project_index_universe_dedups_extras(tmp_path):
+    """An extra file that already lives under paxi_tpu/ (how in-tree
+    TARGET files reach fixture-scoped lint runs) is indexed once —
+    duplicating it would double every call edge and the call-site
+    proofs callers_of feeds."""
+    root = _mini_repo(tmp_path)
+    (root / "paxi_tpu" / "inpkg.py").write_text(
+        "from pkg.core import helper_fn\n"
+        "def go():\n    helper_fn(1)\n")
+    idx = ProjectIndex(root,
+                       extra_files=[root / "paxi_tpu" / "inpkg.py"])
+    callers = idx.callers_of("pkg/core.py", "helper_fn")
+    assert [(c.caller_rel, c.caller_qual) for c in callers] == \
+        [("paxi_tpu/inpkg.py", "go")]
+
+
+def test_project_index_callers_and_dot(tmp_path):
+    root = _mini_repo(tmp_path)
+    idx = ProjectIndex(root, extra_files=[
+        root / "pkg" / "user.py", root / "pkg" / "core.py",
+        root / "pkg" / "sub" / "deep.py"])
+    callers = idx.callers_of("pkg/core.py", "helper_fn")
+    assert [(c.caller_rel, c.caller_qual) for c in callers] == \
+        [("pkg/user.py", "run"), ("pkg/user.py", "run")]
+    dot = idx.to_dot()
+    assert '"pkg.user:run" -> "pkg.core:helper_fn";' in dot
+    assert "fillcolor" in dot

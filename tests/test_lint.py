@@ -16,8 +16,8 @@ from pathlib import Path
 import pytest
 
 from paxi_tpu import analysis
-from paxi_tpu.analysis import (ballots, concurrency, handlers, parity,
-                               purity, quorum, tracemap)
+from paxi_tpu.analysis import (asyncflow, ballots, concurrency, crossflow,
+                               handlers, parity, purity, quorum, tracemap)
 from paxi_tpu.analysis.model import (Suppression, Violation,
                                      apply_suppressions, inline_disables,
                                      load_baseline)
@@ -448,6 +448,159 @@ def test_cli_rule_code_prefixes():
     assert analysis.resolve_rules(["trace-map", "PXT"]) == ["trace-map"]
     with pytest.raises(KeyError):
         analysis.resolve_rules(["PXZ"])
+
+
+# ---- cross-module flow (stage 3) -----------------------------------------
+CROSSFLOW_FIX = [FIX / "fixture_crossflow_kernel.py",
+                 FIX / "fixture_crossflow_helper.py"]
+
+
+def test_crossflow_fixture_catches_each_check():
+    vs = crossflow.check(ROOT, files=CROSSFLOW_FIX)
+    assert codes(vs) == ["PXF801", "PXF802", "PXF803", "PXF804"]
+    by = {c: [v for v in vs if v.code == c] for c in codes(vs)}
+    # the boundary mutant reports at the HELPER write, naming the
+    # kernel call site whose mask fails the proof
+    assert any("depose_unchecked" in v.message
+               and "fixture_crossflow_kernel" in v.message
+               for v in by["PXF801"])
+    # the module-local mutant needs no boundary at all
+    assert any("blind_bump" in v.message for v in by["PXF801"])
+    assert len(by["PXF801"]) == 2
+    assert "log_cmd" in by["PXF802"][0].message
+    assert "intersect" in by["PXF803"][0].message
+    assert "magic_quorum" in by["PXF804"][0].message
+    # negative controls: the guarded depose, the monotone election,
+    # the disjoint shared-plane write and the majority pair stay clean
+    msgs = " | ".join(v.message for v in vs)
+    assert "depose_ok" not in msgs and "elect_fx" not in msgs
+    assert sum(1 for v in vs if v.code == "PXF802") == 1
+    assert sum(1 for v in vs if v.code == "PXF803") == 1
+
+
+def test_crossflow_value_position_and_flipped_threshold(tmp_path):
+    """Review regressions: a fill-family call's VALUE is args[1] — a
+    foreign ballot there must not classify state-derived (PXF801) —
+    and a ``param <= tally`` comparison (threshold on the left) still
+    derives a ThresholdParam instead of silently skipping the proof."""
+    root = tmp_path
+    (root / "paxi_tpu").mkdir()
+    k = root / "kernel_fx.py"
+    k.write_text(
+        "import jax.numpy as jnp\n"
+        "def step(st, m):\n"
+        "    st = {**st,\n"
+        "          'ballot': jnp.full_like(st['ballot'], m['bal'])}\n"
+        "    return st\n")
+    vs = crossflow.check(root, files=[k])
+    assert [v.code for v in vs] == ["PXF801"]
+    h = root / "helper_fx.py"
+    h.write_text(
+        "import jax.numpy as jnp\n"
+        "def tally_p1(acks, majority):\n"
+        "    return majority <= jnp.sum(acks, axis=0)\n")
+    from paxi_tpu.analysis.project import ProjectIndex
+    eng = crossflow._engine_for(ProjectIndex(root, extra_files=[h]))
+    tps = crossflow.threshold_params(eng, "helper_fx.py")
+    assert [(t.param, t.strict, t.phase) for t in tps] == \
+        [("majority", False, "p1")]
+
+
+def test_crossflow_call_site_proof_shape():
+    """The clean depose is proven AT the kernel call site (the
+    cross-module guard-inheritance mechanism itself, not a silent
+    skip), and the election write by monotonicity."""
+    from paxi_tpu.analysis.project import shared_index
+    idx = shared_index(ROOT, extra_files=CROSSFLOW_FIX)
+    eng = crossflow._engine_for(idx)
+    rel = "tests/fixtures/lint/fixture_crossflow_helper.py"
+    sites = {f"{s.fn.name}.{s.plane}": crossflow.classify(eng, s)
+             for s in crossflow.write_sites(eng, rel,
+                                            crossflow.EPOCH_PLANES)}
+    assert sites["depose_ok.ballot"].verdict == "call-site"
+    assert "fixture_crossflow_kernel" in sites["depose_ok.ballot"].detail
+    assert sites["elect_fx.ballot"].verdict == "monotone"
+    assert sites["depose_ok.active"].verdict == "shrinking"
+
+
+def test_crossflow_repo_clean_and_covers_all_five_kernels():
+    """Tier-1 pin of the ISSUE's acceptance bar: the tree is clean and
+    the ballot-ring guard proof covers every consumer — the three
+    kernels importing sim/ballot_ring.py through its call sites, and
+    the two grid kernels (wpaxos/bpaxos) through their in-module
+    epoch writes."""
+    assert crossflow.check(ROOT) == []
+    cov = crossflow.coverage(ROOT)
+    br = cov["paxi_tpu/sim/ballot_ring.py"]
+    assert br["writes"] >= 10 and br["proven"] == br["writes"]
+    assert "call-site" in br["via"]
+    assert set(br["consumers"]) == {
+        "paxi_tpu/protocols/paxos/sim.py",
+        "paxi_tpu/protocols/sdpaxos/sim.py",
+        "paxi_tpu/protocols/wankeeper/sim.py",
+    }
+    # the cross-module proofs name all three importing kernels
+    proof_text = " ".join(br["call_site_proofs"])
+    for kernel in ("paxos/sim.py", "sdpaxos/sim.py", "wankeeper/sim.py"):
+        assert kernel in proof_text, kernel
+    for rel in ("paxi_tpu/protocols/wpaxos/sim.py",
+                "paxi_tpu/protocols/bpaxos/sim.py",
+                "paxi_tpu/protocols/paxos/sim_pg.py"):
+        assert cov[rel]["writes"] > 0, rel
+        assert cov[rel]["proven"] == cov[rel]["writes"], rel
+
+
+def test_crossflow_graph_dot(capsys):
+    """`lint --graph` dumps the cross-module call graph as DOT with
+    package-colored nodes — the inspectable-coverage satellite."""
+    from paxi_tpu.cli import main
+    assert main(["lint", "--graph"]) == 0
+    dot = capsys.readouterr().out
+    assert dot.startswith("digraph")
+    assert "fillcolor" in dot
+    assert '"paxi_tpu.sim.ballot_ring:merge_acker_logs"' in dot
+    assert "paxi_tpu.protocols.paxos.sim:step" in dot
+
+
+# ---- async atomicity (stage 3) -------------------------------------------
+def test_asyncflow_fixture_catches_each_check():
+    vs = asyncflow.check(ROOT, files=[FIX / "fixture_async.py"])
+    src = (FIX / "fixture_async.py").read_text().splitlines()
+
+    def line_of(marker):
+        return next(i for i, l in enumerate(src, 1) if marker in l)
+
+    got = sorted((v.code, v.line) for v in vs)
+    assert got == sorted([
+        ("PXA901", line_of("PXA901: stale snapshot")),
+        ("PXA901", line_of("PXA901: stale guard")),
+        ("PXA901", line_of("PXA901: stale across laps")),
+        ("PXA901", line_of("PXA901: awaited-arg snapshot")),
+        ("PXA901", line_of("PXA901: pre-await load")),
+        ("PXA901", line_of("PXA901: aug target pre-load")),
+        ("PXA901", line_of("PXA901: laundered snapshot")),
+        ("PXA901", line_of("PXA901: decoy lambda load")),
+        ("PXA902", line_of("PXA902: captured snapshot")),
+        ("PXA903", line_of("PXA903: loop-blocking hold")),
+    ])
+    # the clean shapes are negative controls (lock_with_deferred_task:
+    # an await inside a nested async def does NOT suspend under the
+    # lock — ast.walk pruning regression; rebound_fresh: a snapshot
+    # chain built entirely after the await stays fresh)
+    msgs = " | ".join(v.message for v in vs)
+    for clean in ("atomic_rmw", "atomic_aug", "revalidated",
+                  "fresh_guard", "deferred_reread", "locals_only",
+                  "read_after_await", "lock_with_deferred_task",
+                  "rebound_fresh"):
+        assert clean not in msgs, clean
+
+
+def test_asyncflow_repo_tree_is_clean():
+    """The serving path carries no RMW-across-await races (tier-1 pin;
+    the two real findings this rule surfaced — the _Conn.ensure
+    duplicate-dial and the fabric clock write-back — are fixed with
+    regression tests in tests/test_async_races.py)."""
+    assert asyncflow.check(ROOT) == []
 
 
 # ---- the repo-wide gate --------------------------------------------------
