@@ -551,6 +551,81 @@ def cmd_hunt(args) -> int:
     raise AssertionError(args.hunt_cmd)
 
 
+def cmd_scenario(args) -> int:
+    """The WAN topology / churn / reconfiguration scenario engine
+    (paxi_tpu/scenarios): list the named catalog, or run one scenario
+    on either runtime — the sim (scenario folded into the capturable
+    fault schedule) or the virtual-clock host fabric (scenario
+    compiled into a SeqSchedule)."""
+    from paxi_tpu import scenarios as scn
+
+    if args.scenario_cmd == "list":
+        for name in sorted(scn.NAMED):
+            print(json.dumps(scn.describe(scn.NAMED[name])))
+        return 0
+    assert args.scenario_cmd == "run"
+    try:
+        scenario = scn.named_scenario(args.scenario)
+    except KeyError as e:
+        print(f"scenario: {e.args[0]}", file=sys.stderr)
+        return 2
+    try:
+        scenario.validate(args.replicas)
+    except ValueError as e:
+        print(f"scenario: {e}", file=sys.stderr)
+        return 2
+
+    from paxi_tpu.sim import FuzzConfig, SimConfig
+    cfg = SimConfig(n_replicas=args.replicas, n_slots=args.slots,
+                    n_keys=args.keys, n_zones=args.zones,
+                    n_objects=args.objects, locality=args.locality)
+
+    if args.host:
+        # host runtime: the Scenario compiles into the virtual-clock
+        # fabric's fault surface (standing per-edge WAN latencies +
+        # per-step crash sets) and the hunt classifier's replay core
+        # drives the cluster under it.  The randomized-fault knobs are
+        # sim-only (the fabric replays the deterministic scenario
+        # schedule alone) — reject them instead of silently ignoring
+        if args.p_drop or args.max_delay > 1:
+            print("scenario: -p_drop/-max_delay apply to the sim "
+                  "runtime only (the -host fabric runs the scenario's "
+                  "deterministic schedule)", file=sys.stderr)
+            return 2
+        from paxi_tpu.host.simulation import chan_config
+        from paxi_tpu.hunt.classify import replay_schedule
+        hcfg = chan_config(args.replicas, zones=args.zones,
+                           tag="scenario")
+        sched = scn.seq_schedule_of(scenario, hcfg.ids, args.steps)
+        out = asyncio.run(replay_schedule(args.algorithm, cfg, sched,
+                                          cfg=hcfg, seed=args.seed))
+        payload = dict(out.to_json(), runtime="host",
+                       algorithm=args.algorithm, scenario=scenario.name,
+                       steps=args.steps)
+        print(json.dumps(payload))
+        return 0 if not out.violated else 1
+
+    from paxi_tpu.protocols import sim_protocol
+    from paxi_tpu.sim import simulate
+    proto = sim_protocol(args.algorithm)
+    fuzz = scn.with_scenario(
+        FuzzConfig(p_drop=args.p_drop, max_delay=args.max_delay),
+        scenario)
+    res = simulate(proto, cfg, args.groups, args.steps, fuzz=fuzz,
+                   seed=args.seed)
+    payload = {k: int(v) for k, v in res.metrics.items()
+               if not k.startswith("commit_lat_")}
+    payload.update(runtime="sim", algorithm=args.algorithm,
+                   scenario=scenario.name, groups=args.groups,
+                   steps=args.steps, replicas=args.replicas,
+                   invariant_violations=int(res.violations))
+    # the zone-latency split (the Cloud paper's headline measurement)
+    # in mean lock-step rounds, when the kernel instruments it
+    payload.update(scn.latency_split(res.metrics))
+    print(json.dumps(payload))
+    return 0 if payload["invariant_violations"] == 0 else 1
+
+
 def cmd_metrics(args) -> int:
     """Pretty-print a metrics snapshot from either source: scrape a
     live host node's /metrics endpoint, or pull the snapshots embedded
@@ -882,6 +957,33 @@ def main(argv=None) -> int:
                              "run (default: repo traces/)")
         hp.add_argument("-quiet", "--quiet", action="store_true")
     h.set_defaults(fn=cmd_hunt)
+
+    sc = sub.add_parser("scenario",
+                        help="WAN topology / churn / reconfig scenario "
+                             "engine (paxi_tpu/scenarios)")
+    scsub = sc.add_subparsers(dest="scenario_cmd", required=True)
+    scsub.add_parser("list", help="print the named-scenario catalog")
+    scr = scsub.add_parser("run",
+                           help="run one named scenario on the sim or "
+                                "(-host) the virtual-clock fabric")
+    scr.add_argument("-scenario", "--scenario", default="wan3z",
+                     help="a name from `scenario list`")
+    scr.add_argument("-algorithm", "--algorithm", default="wpaxos")
+    scr.add_argument("-host", "--host", action="store_true",
+                     help="drive the asyncio cluster on the "
+                          "virtual-clock fabric instead of the sim")
+    scr.add_argument("-groups", type=int, default=16)
+    scr.add_argument("-steps", type=int, default=120)
+    scr.add_argument("-replicas", type=int, default=9)
+    scr.add_argument("-zones", type=int, default=3)
+    scr.add_argument("-slots", type=int, default=16)
+    scr.add_argument("-keys", type=int, default=16)
+    scr.add_argument("-objects", type=int, default=6)
+    scr.add_argument("-locality", type=float, default=0.8)
+    scr.add_argument("-seed", type=int, default=0)
+    scr.add_argument("-p_drop", type=float, default=0.0)
+    scr.add_argument("-max_delay", type=int, default=1)
+    sc.set_defaults(fn=cmd_scenario)
 
     li = sub.add_parser(
         "lint", help="protocol-aware static analysis (paxi-lint)")

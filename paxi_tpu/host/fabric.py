@@ -129,13 +129,23 @@ class VirtualClockFabric:
             key = (src, dst, mt)
             occ = self._occ.get(key, 0)
             self._occ[key] = occ + 1
+            # standing per-edge WAN latency (scenario engine): every
+            # DELIVERED send on the edge pays it, on top of any
+            # per-occurrence fault delay below — mirroring the sim
+            # where the zone matrix is the delay DISTRIBUTION, not an
+            # event
+            edge = self.sched.edge_extra(src, dst)
             f = self.sched.fault_for(src, dst, mt, occ)
+            if f is not None and f.action == "drop":
+                self.stats["dropped_fault"] += 1
+                return
+            if edge:
+                extra += edge
+                self.stats["edge_delayed"] = \
+                    self.stats.get("edge_delayed", 0) + 1
             if f is not None:
-                if f.action == "drop":
-                    self.stats["dropped_fault"] += 1
-                    return
                 self.stats["delayed_fault"] += 1
-                extra = f.delay_steps
+                extra += f.delay_steps
         self._seq += 1
         heapq.heappush(self._heap, (t + 1 + extra, self._seq, src, dst,
                                     msg))

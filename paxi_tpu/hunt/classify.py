@@ -195,19 +195,41 @@ async def replay_witness(trace: Trace, *, tail_steps: Optional[int] = None,
     - ``HUNT_TAIL_STEPS``: fault-free tail length after the schedule
       (default 10; see the note above).
     """
+    algorithm = host_algorithm(trace.protocol)
+    if algorithm is None:
+        raise ValueError(f"{trace.protocol!r} has no host runtime")
+    scfg = trace.sim_config()
+    from paxi_tpu.host.simulation import chan_config
+    cfg = chan_config(scfg.n_replicas, zones=scfg.n_zones, tag="hunt")
+    sched, _ = seq_schedule(trace, cfg.ids,
+                            msg_map=trace_msg_map(trace.protocol))
+    return await replay_schedule(
+        algorithm, scfg, sched, cfg=cfg, seed=trace.seed,
+        tail_steps=tail_steps, op_every=op_every, op_timeout=op_timeout)
+
+
+async def replay_schedule(algorithm: str, scfg, sched, *, cfg=None,
+                          seed: int = 0,
+                          tail_steps: Optional[int] = None,
+                          op_every: int = 2, op_timeout: float = 5.0
+                          ) -> HostOutcome:
+    """Drive the host runtime under an arbitrary ``SeqSchedule`` on the
+    virtual-clock fabric — the schedule-level core of
+    ``replay_witness``, also the scenario engine's host runner (CLI
+    ``scenario run --host`` compiles a Scenario into a SeqSchedule via
+    ``scenarios.compile.seq_schedule_of`` and lands here).  ``cfg`` is
+    the cluster config whose ids the schedule was keyed with (built
+    from ``scfg``'s geometry when omitted — pass the one you projected
+    the schedule with so the two cannot drift)."""
     from paxi_tpu.host.fabric import VirtualClockFabric
     from paxi_tpu.host.history import History
     from paxi_tpu.host.simulation import Cluster, chan_config
     from paxi_tpu.core.command import Command, Request
     from paxi_tpu.protocols import _HOST_MODULES
 
-    algorithm = host_algorithm(trace.protocol)
-    if algorithm is None:
-        raise ValueError(f"{trace.protocol!r} has no host runtime")
-    scfg = trace.sim_config()
-    cfg = chan_config(scfg.n_replicas, zones=scfg.n_zones, tag="hunt")
-    sched, _ = seq_schedule(trace, cfg.ids,
-                            msg_map=trace_msg_map(trace.protocol))
+    if cfg is None:
+        cfg = chan_config(scfg.n_replicas, zones=scfg.n_zones,
+                          tag="hunt")
     fabric = VirtualClockFabric(sched)
     cluster = Cluster(algorithm, cfg=cfg, http=False, fabric=fabric)
     await cluster.start()
@@ -227,7 +249,7 @@ async def replay_witness(trace: Trace, *, tail_steps: Optional[int] = None,
             # of unique values so the history checker's read-from
             # edges are unambiguous
             history = History()
-            rng = random.Random(trace.seed)
+            rng = random.Random(seed)
             ids = sorted(cluster.ids)
             n_keys = max(1, min(scfg.n_keys, 4))
 

@@ -36,6 +36,14 @@ _SIM_MODULES = {
     # witnesses are the hunt pipeline's "reproduced" positive control
     # for a real protocol (fragile_counter covers the demo kernel)
     "bpaxos_noread": "paxi_tpu.protocols.bpaxos.sim:PROTOCOL_NOREAD",
+    # scenario-engine twins (paxi_tpu/scenarios): relay_churn is the
+    # CHURN-sensitive seeded pair (matching host twin in
+    # scenarios/demo_host.py — the hunt's reproduced control for
+    # scenario schedules); wpaxos_thinq1 thins the steal's phase-1
+    # grid quorum by one zone so WAN geo-latency schedules produce
+    # capturable agreement witnesses (sim-only, like wankeeper_nofloor)
+    "relay_churn": "paxi_tpu.scenarios.demo",
+    "wpaxos_thinq1": "paxi_tpu.protocols.wpaxos.sim:PROTOCOL_THINQ1",
 }
 
 _HOST_MODULES = {
@@ -54,6 +62,8 @@ _HOST_MODULES = {
     "blockchain": "paxi_tpu.protocols.blockchain.host",
     "bpaxos": "paxi_tpu.protocols.bpaxos.host",
     "bpaxos_noread": "paxi_tpu.protocols.bpaxos.noread",
+    # host twin of the scenario engine's churn-sensitive demo kernel
+    "relay_churn": "paxi_tpu.scenarios.demo_host",
 }
 
 

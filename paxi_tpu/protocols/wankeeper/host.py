@@ -546,4 +546,14 @@ SIM_STATE_MAP = {
     "viol_acc":   "",  # invariant accumulator (oracle)
     "writes":     "",  # leader write counter (metrics)
     "transfers":  "",  # token-transfer counter (metrics)
+    # zone-latency accounting (scenario bench axis) — measurement
+    # planes, not protocol state; excluded from the trace witness hash
+    "m_wr_t":          "",
+    "m_wr_p":          "",
+    "m_acq_t":         "",
+    "m_acq_p":         "",
+    "m_lat_local_sum": "",
+    "m_lat_local_n":   "",
+    "m_lat_cross_sum": "",
+    "m_lat_cross_n":   "",
 }

@@ -162,6 +162,21 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
             (jnp.arange(R, dtype=i32) * cfg.election_timeout)[:, None],
             (R, G)),
         stuck=jnp.zeros((R, G), i32),
+        # ---- zone-latency accounting (scenario bench axis) ----------
+        # measurement planes, ``m_`` prefix = excluded from the trace
+        # witness hash (trace/replay.state_hash).  LOCAL latency: a
+        # zone leader's write (version bump) until its zone-majority
+        # commit.  CROSS latency: a token request (treq) until the
+        # grant lands — the root round trips, WanKeeper's cross-zone
+        # cost.  One outstanding sample per (leader, object).
+        m_wr_t=jnp.zeros((R, O, G), i32),
+        m_wr_p=jnp.zeros((R, O, G), bool),
+        m_acq_t=jnp.zeros((R, O, G), i32),
+        m_acq_p=jnp.zeros((R, O, G), bool),
+        m_lat_local_sum=jnp.zeros((G,), i32),
+        m_lat_local_n=jnp.zeros((G,), i32),
+        m_lat_cross_sum=jnp.zeros((G,), i32),
+        m_lat_cross_n=jnp.zeros((G,), i32),
     )
 
 
@@ -235,6 +250,21 @@ def step(state, inbox, ctx: StepCtx, gver_floor: bool = True):
     avz = jnp.where(zsel, aver, -1)
     committed_v = jnp.maximum(
         jnp.sort(avz, axis=1)[:, R - ZMAJ], 0)           # (ldr, O, G)
+
+    # ---- zone-latency accounting: settle LOCAL write samples ----------
+    # (write -> zone-majority commit; sampled before this step's bump)
+    m_wr_t, m_wr_p = state["m_wr_t"], state["m_wr_p"]
+    m_acq_t, m_acq_p = state["m_acq_t"], state["m_acq_p"]
+    m_lat_local_sum = state["m_lat_local_sum"]
+    m_lat_local_n = state["m_lat_local_n"]
+    m_lat_cross_sum = state["m_lat_cross_sum"]
+    m_lat_cross_n = state["m_lat_cross_n"]
+    settled = m_wr_p & (committed_v >= ver)              # (ldr, O, G)
+    wdt = jnp.clip(ctx.t - m_wr_t, 0, None)
+    m_lat_local_sum = m_lat_local_sum + jnp.sum(
+        jnp.where(settled, wdt, 0), axis=(0, 1))
+    m_lat_local_n = m_lat_local_n + jnp.sum(settled, axis=(0, 1))
+    m_wr_p = m_wr_p & ~settled
 
     # ============ root log: shared Multi-Paxos core =====================
     st, out_p1b, promote = br.promise_p1a(st, inbox["p1a"])
@@ -399,6 +429,10 @@ def step(state, inbox, ctx: StepCtx, gver_floor: bool = True):
     w_do = is_zldr[:, None] & held & (d_ver - d_cv < 2)
     ver = ver + (w_do[:, None, :] & dsel)
     writes = writes + w_do
+    # latency clock: the OLDEST outstanding write keeps its start
+    start_w = w_do[:, None, :] & dsel & ~m_wr_p
+    m_wr_t = jnp.where(start_w, ctx.t, m_wr_t)
+    m_wr_p = m_wr_p | start_w
 
     # zrep out: per-destination go-back-N (like sdpaxos's C-plane) —
     # send each zone member the NEXT version it has not acked of my
@@ -429,8 +463,21 @@ def step(state, inbox, ctx: StepCtx, gver_floor: bool = True):
         "ver": jnp.broadcast_to(ack_ver[:, None, :], (R, R, G)),
     }
 
+    # ---- zone-latency accounting: CROSS (token-acquisition) samples ----
+    # a grant landed for an object my zone was waiting on: treq ->
+    # token arrival is WanKeeper's cross-zone (root round-trip) cost
+    arrived = m_acq_p & (token_zone == my_zone[:, None, None])
+    adt = jnp.clip(ctx.t - m_acq_t, 0, None)
+    m_lat_cross_sum = m_lat_cross_sum + jnp.sum(
+        jnp.where(arrived, adt, 0), axis=(0, 1))
+    m_lat_cross_n = m_lat_cross_n + jnp.sum(arrived, axis=(0, 1))
+    m_acq_p = m_acq_p & ~arrived
+
     # treq out: a zone leader demanding a non-held object asks the root
     t_do = is_zldr[:, None] & ~held & (d_holder != my_zone[:, None])
+    start_a = t_do[:, None, :] & dsel & ~m_acq_p
+    m_acq_t = jnp.where(start_a, ctx.t, m_acq_t)
+    m_acq_p = m_acq_p | start_a
     out_treq = {
         "valid": jnp.broadcast_to(t_do[:, None, :], (R, R, G)),
         "obj": jnp.broadcast_to(demand[:, None, :], (R, R, G)),
@@ -480,7 +527,10 @@ def step(state, inbox, ctx: StepCtx, gver_floor: bool = True):
         st, token_zone=token_zone, prev_zone=prev_zone, ver=ver,
         aver=aver, want=want, relv=relv, pend=pend, pgen=pgen,
         rgen=rgen, gver=gver, viol_acc=viol_acc, writes=writes,
-        transfers=transfers)
+        transfers=transfers,
+        m_wr_t=m_wr_t, m_wr_p=m_wr_p, m_acq_t=m_acq_t, m_acq_p=m_acq_p,
+        m_lat_local_sum=m_lat_local_sum, m_lat_local_n=m_lat_local_n,
+        m_lat_cross_sum=m_lat_cross_sum, m_lat_cross_n=m_lat_cross_n)
     outbox = {"zrep": out_zrep, "zack": out_zack, "treq": out_treq,
               "rel": out_rel, "p1a": out_p1a, "p1b": out_p1b,
               "p2a": out_p2a, "p2b": out_p2b, "p3": out_p3}
@@ -494,6 +544,13 @@ def metrics(state, cfg: SimConfig):
         "root_execute": jnp.sum(jnp.max(state["execute"], axis=0)),
         "has_root": jnp.sum(jnp.any(state["active"], axis=0)
                             .astype(jnp.int32)),
+        # zone-latency split (scenario bench axis): LOCAL = write ->
+        # zone-majority commit; CROSS = treq -> grant landing (the
+        # root round trip), in lock-step rounds
+        "commit_lat_local_sum": jnp.sum(state["m_lat_local_sum"]),
+        "commit_lat_local_n": jnp.sum(state["m_lat_local_n"]),
+        "commit_lat_cross_sum": jnp.sum(state["m_lat_cross_sum"]),
+        "commit_lat_cross_n": jnp.sum(state["m_lat_cross_n"]),
     }
 
 

@@ -431,4 +431,11 @@ SIM_STATE_MAP = {
     "base":        "",  # ring-window base: host logs are unbounded dicts
     "proposed":    "",  # own-ballot P2a mask: implied by Entry existence
     "steal_timer": "",  # steal retry step-timer: host retries are wall-clock
+    # zone-latency accounting (scenario bench axis) — measurement
+    # planes, not protocol state; excluded from the trace witness hash
+    "m_prop_t":        "",
+    "m_lat_local_sum": "",
+    "m_lat_local_n":   "",
+    "m_lat_cross_sum": "",
+    "m_lat_cross_n":   "",
 }

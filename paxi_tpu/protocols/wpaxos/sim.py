@@ -43,6 +43,7 @@ TPU re-design (not a translation):
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Tuple
 
 import jax
@@ -115,14 +116,37 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
         p1_acks=jnp.zeros((R, G), i32),    # bit-packed, in-flight steal
         steal_timer=jnp.zeros((R, G), i32),
         steals=jnp.zeros((G,), i32),       # completed steals (metric)
+        # ---- zone-latency accounting (scenario bench axis) ----------
+        # measurement planes, ``m_`` prefix = excluded from the trace
+        # witness hash (trace/replay.state_hash) — pure read-side
+        # accounting that never feeds a transition.  m_prop_t records
+        # each slot's FIRST propose step; commits split into
+        # zone-local (the owner's own zone alone satisfied the grid
+        # quorum) vs cross-zone, accumulating propose->commit step
+        # latencies — the Cloud paper's headline split.
+        m_prop_t=jnp.zeros((R, O, S, G), i32),
+        m_lat_local_sum=jnp.zeros((G,), i32),
+        m_lat_local_n=jnp.zeros((G,), i32),
+        m_lat_cross_sum=jnp.zeros((G,), i32),
+        m_lat_cross_n=jnp.zeros((G,), i32),
     )
 
 
-def step(state, inbox, ctx: StepCtx):
+def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
+    """``q1_full=False`` is the SEEDED BUG twin (PROTOCOL_THINQ1): the
+    steal's phase-1 grid quorum is one zone too thin (``Z - q2``
+    instead of ``Z - q2 + 1``), so a stealer's read set can MISS the
+    old owner's write zone entirely (with q2=1 commits live in one
+    zone) and re-propose over chosen entries — the flexible-quorum
+    intersection break.  WAN geo-latency scenarios are exactly what
+    exposes it: cross-zone delays widen the in-flight phase-1 window,
+    so racing steals with disjoint-enough read sets actually happen.
+    It exists so the scenario engine has a real, capturable wpaxos
+    witness to minimize; never soak it as a correctness case."""
     cfg = ctx.cfg
     R, O, S = cfg.n_replicas, cfg.n_objects, cfg.n_slots
     Z, STRIDE = cfg.n_zones, cfg.ballot_stride
-    Q1 = Z - cfg.grid_q2 + 1
+    Q1 = Z - cfg.grid_q2 + (1 if q1_full else 0)
     Q2 = cfg.grid_q2
     RETAIN = max(S // 2, 1)
     ridx = jnp.arange(R, dtype=jnp.int32)
@@ -145,6 +169,11 @@ def step(state, inbox, ctx: StepCtx):
     steal_obj = state["steal_obj"]    # (R, G)
     p1_acks = state["p1_acks"]        # (R, G) packed
     steals = state["steals"]
+    m_prop_t = state["m_prop_t"]      # (R, O, S, G) first-propose step
+    m_lat_local_sum = state["m_lat_local_sum"]
+    m_lat_local_n = state["m_lat_local_n"]
+    m_lat_cross_sum = state["m_lat_cross_sum"]
+    m_lat_cross_n = state["m_lat_cross_n"]
     G = steal_obj.shape[-1]
 
     T = dst_major          # mailbox (src, dst, G) -> (me=dst, src, G)
@@ -268,6 +297,7 @@ def step(state, inbox, ctx: StepCtx):
     log_commit = shift_window(log_commit, adv_me, False)
     proposed = shift_window(proposed, adv_me, False)
     log_acks = shift_window(log_acks, adv_me, 0)
+    m_prop_t = shift_window(m_prop_t, adv_me, 0)
     w4 = win_oh[:, :, None, :]                         # (me, O, 1, G)
     iw4 = in_win[:, None, :, :]                        # (me, 1, S, G)
     my_bal_so = at_obj(ballot, so)                     # (me, G)
@@ -280,6 +310,8 @@ def step(state, inbox, ctx: StepCtx):
                          proposed)
     log_acks = jnp.where(w4, jnp.where(iw4, self_bit2[:, :, None, None], 0),
                          log_acks)
+    # adopted rows restart their latency clocks at the takeover step
+    m_prop_t = jnp.where(w4, jnp.where(iw4, ctx.t, 0), m_prop_t)
     base = jnp.where(win_oh, base_star[:, None, :], base)
     next_slot = jnp.where(win_oh, new_next[:, None, :], next_slot)
     # adopt execute/register from the max-base acker when it is ahead
@@ -360,6 +392,23 @@ def step(state, inbox, ctx: StepCtx):
     newly = ((active & own)[:, :, None, :] & (zq2 >= Q2)
              & ~log_commit & (log_cmd != NO_CMD) & proposed)
     log_commit = log_commit | newly
+    # zone-latency split (the Cloud paper's headline measurement): a
+    # commit is ZONE-LOCAL when the owner's own zone's acks alone
+    # satisfy the grid quorum (for q2=1, the steady-state WAN win this
+    # kernel exists to show; for q2>1 own-zone-alone can never
+    # suffice, so every commit is honestly cross-zone)
+    ZR = R // Z
+    zbits = jnp.int32((1 << ZR) - 1) << ((ridx // ZR) * ZR)   # (own,)
+    own_zq = _zone_quorums(log_acks & zbits[:, None, None, None], cfg)
+    local = newly & (own_zq >= Q2)
+    cross = newly & ~(own_zq >= Q2)
+    dt = jnp.clip(ctx.t - m_prop_t, 0, None)
+    m_lat_local_sum = m_lat_local_sum + jnp.sum(
+        jnp.where(local, dt, 0), axis=(0, 1, 2))
+    m_lat_local_n = m_lat_local_n + jnp.sum(local, axis=(0, 1, 2))
+    m_lat_cross_sum = m_lat_cross_sum + jnp.sum(
+        jnp.where(cross, dt, 0), axis=(0, 1, 2))
+    m_lat_cross_n = m_lat_cross_n + jnp.sum(cross, axis=(0, 1, 2))
 
     # ---------------- P3: commit notifications --------------------------
     # Zombie fences (see sim/ballot_ring.py apply_p3): a higher-ballot
@@ -425,6 +474,7 @@ def step(state, inbox, ctx: StepCtx):
     log_commit = jnp.where(a4, s_com | my_com_s, log_commit)
     proposed = jnp.where(a4, False, proposed)
     log_acks = jnp.where(a4, 0, log_acks)
+    m_prop_t = jnp.where(a4, 0, m_prop_t)
     base = jnp.where(adopt, b_own, base)
     execute = jnp.where(adopt, e_own, execute)
     kv = jnp.where(adopt, k_own, kv)
@@ -470,6 +520,9 @@ def step(state, inbox, ctx: StepCtx):
     log_bal = jnp.where(p_oh, d_bal[:, None, None, :], log_bal)
     log_cmd = jnp.where(p_oh & ~log_commit, prop_cmd[:, None, None, :],
                         log_cmd)
+    # latency clock: a slot's FIRST propose starts it (re-proposals
+    # keep the original start — the honest end-to-end commit latency)
+    m_prop_t = jnp.where(p_oh & ~proposed, ctx.t, m_prop_t)
     proposed = proposed | p_oh
     log_acks = log_acks | jnp.where(p_oh, self_bit2[..., None, None], 0)
     next_slot = next_slot + ((do & ~has_re & can_new)[:, None, :] & d_oh)
@@ -556,6 +609,7 @@ def step(state, inbox, ctx: StepCtx):
     log_commit = shift_window(log_commit, adv, False)
     proposed = shift_window(proposed, adv, False)
     log_acks = shift_window(log_acks, adv, 0)
+    m_prop_t = shift_window(m_prop_t, adv, 0)
 
     new_state = dict(
         ballot=ballot, active=active, log_bal=log_bal, log_cmd=log_cmd,
@@ -563,6 +617,9 @@ def step(state, inbox, ctx: StepCtx):
         base=new_base, next_slot=next_slot, execute=new_execute, kv=kv,
         hits=hits, steal_obj=steal_obj, p1_acks=p1_acks,
         steal_timer=steal_timer, steals=steals,
+        m_prop_t=m_prop_t, m_lat_local_sum=m_lat_local_sum,
+        m_lat_local_n=m_lat_local_n, m_lat_cross_sum=m_lat_cross_sum,
+        m_lat_cross_n=m_lat_cross_n,
     )
     outbox = {"p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
               "p2b": out_p2b, "p3": out_p3}
@@ -574,6 +631,12 @@ def metrics(state, cfg: SimConfig):
         "committed_slots": jnp.sum(jnp.max(state["execute"], axis=0)),
         "steals": jnp.sum(state["steals"]),
         "owned_objects": jnp.sum(state["active"]).astype(jnp.int32),
+        # zone-local vs cross-zone commit-latency split (propose ->
+        # commit, in lock-step rounds) — the scenario bench axis
+        "commit_lat_local_sum": jnp.sum(state["m_lat_local_sum"]),
+        "commit_lat_local_n": jnp.sum(state["m_lat_local_n"]),
+        "commit_lat_cross_sum": jnp.sum(state["m_lat_cross_sum"]),
+        "commit_lat_cross_n": jnp.sum(state["m_lat_cross_n"]),
     }
 
 
@@ -626,6 +689,22 @@ PROTOCOL = SimProtocol(
     mailbox_spec=mailbox_spec,
     init_state=init_state,
     step=step,
+    metrics=metrics,
+    invariants=invariants,
+    batched=True,
+)
+
+# the seeded thin-read-quorum bug twin (see step's docstring): the
+# scenario engine's capturable wpaxos witness source — WAN geo-latency
+# widens the racing-steal window until a one-zone-thin phase-1 read
+# set misses the write zone and the agreement oracle fires.
+# Registered as ``wpaxos_thinq1`` (sim-only, like wankeeper_nofloor);
+# never a correctness case.
+PROTOCOL_THINQ1 = SimProtocol(
+    name="wpaxos_thinq1",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=functools.partial(step, q1_full=False),
     metrics=metrics,
     invariants=invariants,
     batched=True,

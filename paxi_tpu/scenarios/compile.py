@@ -1,0 +1,148 @@
+"""Compile Scenarios into the two runtimes' schedule surfaces + the
+named-scenario registry.
+
+Sim side: a scenario rides INSIDE the FuzzConfig (``with_scenario``)
+— the exchange layer folds its zone-latency plane into the delay draw
+and its kill overlay into the crash plane (scenarios/schedule.py), so
+every scenario run goes through the runner's existing sched/capture
+path: recordable, bit-for-bit replayable, ddmin-shrinkable.
+
+Host side: ``seq_schedule_of`` compiles the SAME scenario into a
+``trace.host.SeqSchedule`` for the virtual-clock fabric
+(host/fabric.py) — the zone matrix becomes a standing per-edge
+``edge_delay``, kills become per-logical-step crash sets from the
+same ``crashed_plane`` the sim overlay materializes — so one Scenario
+definition drives both runtimes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Sequence
+
+from paxi_tpu.scenarios import schedule as _sched
+from paxi_tpu.scenarios.spec import (LeaderChurn, Reconfig, Scenario,
+                                     ZoneLatency, ZoneOutage)
+from paxi_tpu.sim.types import FuzzConfig
+
+
+def with_scenario(fuzz: FuzzConfig, scn: Scenario) -> FuzzConfig:
+    """The FuzzConfig that runs ``fuzz``'s randomized faults inside
+    ``scn``'s environment."""
+    return dataclasses.replace(fuzz, scenario=scn)
+
+
+def seq_schedule_of(scn: Scenario, ids: Sequence, n_steps: int):
+    """Compile ``scn`` into the virtual-clock fabric's fault surface.
+    ``ids`` is the host config's replica-ID list; sim replica r maps to
+    ``sorted(ids)`` position r (the zone-block layout both runtimes
+    derive from the id list, same as trace/host.py projections)."""
+    from paxi_tpu.core.ident import ID
+    from paxi_tpu.trace.host import SeqSchedule
+
+    ids = [str(i) for i in sorted(ID(str(i)) for i in ids)]
+    n = len(ids)
+    scn.validate(n)
+    edge_delay: Dict = {}
+    if scn.zones is not None:
+        base = _sched.delay_base(scn, n)
+        for i in range(n):
+            for j in range(n):
+                if i != j and int(base[i, j]) > 1:
+                    edge_delay[(ids[i], ids[j])] = int(base[i, j]) - 1
+    crashed: Dict[str, list] = {}
+    if scn.kills_nodes():
+        plane = _sched.crashed_plane(scn, n, n_steps)
+        for r in range(n):
+            ts = [t for t in range(n_steps) if plane[t, r]]
+            if ts:
+                crashed[ids[r]] = ts
+    return SeqSchedule(n_steps=n_steps, crashed=crashed,
+                       edge_delay=edge_delay)
+
+
+# ---- named scenarios -----------------------------------------------------
+# The built-in catalog (CLI `scenario list|run -scenario NAME`, hunt
+# case rows, bench_all's scenario axis).  Latencies are lock-step
+# rounds; the matrices model the Cloud paper's WAN shape: cheap
+# intra-zone, expensive asymmetric cross-zone.
+WAN3Z = Scenario(
+    name="wan3z", n_zones=3,
+    zones=ZoneLatency(matrix=((1, 3, 5),
+                              (3, 1, 3),
+                              (5, 3, 1)), jitter=1))
+
+WAN2Z = Scenario(
+    name="wan2z", n_zones=2,
+    zones=ZoneLatency(matrix=((1, 4),
+                              (4, 1)), jitter=1))
+
+CHURN = Scenario(
+    name="churn",
+    churn=LeaderChurn(start=6, period=30, kill_for=16))
+
+WAN3Z_CHURN = Scenario(
+    name="wan3z_churn", n_zones=3,
+    zones=ZoneLatency(matrix=((1, 3, 5),
+                              (3, 1, 3),
+                              (5, 3, 1)), jitter=1),
+    churn=LeaderChurn(start=20, period=50, kill_for=24))
+
+ZONE_FLAP = Scenario(
+    name="zoneflap", n_zones=3,
+    zones=ZoneLatency(matrix=((1, 2, 3),
+                              (2, 1, 2),
+                              (3, 2, 1))),
+    outages=(ZoneOutage(zone=1, t0=30, t1=60),
+             ZoneOutage(zone=2, t0=80, t1=110)))
+
+# membership shrink/grow for a 5-replica group: 5 -> 3 -> 5 (epoch
+# bumps mid-run expressed at the transport level)
+SHRINK_GROW5 = Scenario(
+    name="shrink_grow5",
+    reconfig=Reconfig(epochs=((0, (0, 1, 2, 3, 4)),
+                              (40, (0, 1, 2)),
+                              (90, (0, 1, 2, 3, 4)))))
+
+NAMED: Dict[str, Scenario] = {s.name: s for s in (
+    WAN3Z, WAN2Z, CHURN, WAN3Z_CHURN, ZONE_FLAP, SHRINK_GROW5)}
+
+
+def named_scenario(name: str) -> Scenario:
+    if name not in NAMED:
+        raise KeyError(f"unknown scenario {name!r}; "
+                       f"have {sorted(NAMED)}")
+    return NAMED[name]
+
+
+def latency_split(metrics) -> Dict:
+    """Fold the zone-aware kernels' ``commit_lat_{local,cross}_{sum,n}``
+    accounting counters into mean lock-step rounds — the Cloud paper's
+    zone-local vs cross-zone commit-latency split, shared by the
+    ``scenario run`` CLI and bench_all's scenario rows (one definition
+    of the metric key names)."""
+    out: Dict = {}
+    for side in ("local", "cross"):
+        n = int(metrics.get(f"commit_lat_{side}_n", 0))
+        if n:
+            out[f"commit_lat_{side}_rounds"] = round(
+                int(metrics[f"commit_lat_{side}_sum"]) / n, 2)
+            out[f"commit_lat_{side}_n"] = n
+    return out
+
+
+def describe(scn: Scenario) -> Dict:
+    """One-line-able summary for `scenario list`."""
+    out: Dict = {"name": scn.name, "n_zones": scn.n_zones,
+                 "max_latency": scn.max_latency()}
+    if scn.zones is not None:
+        out["zones"] = {"matrix": [list(r) for r in scn.zones.matrix],
+                        "jitter": scn.zones.jitter}
+    if scn.churn is not None:
+        out["churn"] = dataclasses.asdict(scn.churn)
+    if scn.reconfig is not None:
+        out["reconfig"] = {"epochs": [[t, list(l)] for t, l
+                                      in scn.reconfig.epochs]}
+    if scn.outages:
+        out["outages"] = [dataclasses.asdict(o) for o in scn.outages]
+    return out

@@ -1,0 +1,99 @@
+"""``relay_churn``: the scenario engine's seeded CHURN-SENSITIVE twin
+(sim half; host twin in scenarios/demo_host.py).
+
+A sequence relay with leader takeover — and two deliberate bugs that
+ONLY leader churn exposes, shared by both runtimes so its witnesses
+are the hunt pipeline's REPRODUCED positive control for scenario
+schedules (the churn sibling of ``fragile_counter``'s drop control):
+
+- the broadcaster keeps incrementing its own sequence counter while
+  comms-dead, so a revived leader resumes ABOVE what receivers saw
+  (counter drift);
+- a takeover replica's FIRST broadcast skips one sequence number
+  (the classic off-by-one takeover handoff).
+
+Protocol: replica 0 broadcasts an increasing sequence every step.
+Receivers apply in order and count a violation on any gap
+(``v > last + 1``).  A replica r > 0 takes over broadcasting when it
+has heard nothing for ``election_timeout * r`` steps (rank-staggered
+timeouts — the deterministic succession order the scenario engine's
+churn rotation tracks).  Fault-free, replica 0 broadcasts forever and
+nobody times out: the run is clean.  Kill the leader (churn) and the
+takeover skip + revival drift fire deterministically.
+
+NOT a real protocol — never add it to the soak matrix as a
+correctness case; its violations are the expected output.  Per-group
+(vmapped) kernel layout, like fragile_counter.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+
+
+def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
+    return {"seq": ("v",)}
+
+
+def init_state(cfg: SimConfig, rng: jax.Array):
+    del rng
+    R = cfg.n_replicas
+    return {
+        "last": jnp.zeros((R,), jnp.int32),     # highest seq applied
+        "silence": jnp.zeros((R,), jnp.int32),  # steps since a seq
+        "gaps": jnp.zeros((), jnp.int32),       # ordering violations
+    }
+
+
+def step(state, inbox, ctx: StepCtx):
+    cfg = ctx.cfg
+    R = cfg.n_replicas
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    m = inbox["seq"]
+    v = m["valid"]                                  # (src, dst)
+    got = jnp.any(v, axis=0)                        # (dst,)
+    vmax = jnp.max(jnp.where(v, m["v"], 0), axis=0)
+    last = state["last"]
+    gap = got & (vmax > last + 1)
+    gaps = state["gaps"] + jnp.sum(gap.astype(jnp.int32))
+    last = jnp.where(got, jnp.maximum(last, vmax), last)
+    silence = jnp.where(got, 0, state["silence"] + 1)
+
+    # rank-staggered takeover: replica r broadcasts while its silence
+    # is at/over ``election_timeout * r`` (r=0: always — the leader).
+    # The FIRST takeover broadcast (silence exactly at threshold)
+    # skips one sequence number — the seeded handoff bug.
+    thr = cfg.election_timeout * ridx
+    bcast = silence >= thr
+    skip = (ridx > 0) & (silence == thr)
+    # broadcasters advance their own counter (no self-edge to echo it)
+    new_last = jnp.where(bcast, last + 1 + skip, last)
+    out = {"seq": {
+        "valid": jnp.broadcast_to(bcast[:, None], (R, R)),
+        "v": jnp.broadcast_to(new_last[:, None], (R, R)),
+    }}
+    return {"last": new_last, "silence": silence, "gaps": gaps}, out
+
+
+def metrics(state, cfg: SimConfig):
+    return {"delivered": jnp.sum(state["last"])}
+
+
+def invariants(old, new, cfg: SimConfig) -> jax.Array:
+    return (new["gaps"] - old["gaps"]).astype(jnp.int32)
+
+
+PROTOCOL = SimProtocol(
+    name="relay_churn",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=step,
+    metrics=metrics,
+    invariants=invariants,
+    batched=False,
+)

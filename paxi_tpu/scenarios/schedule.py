@@ -1,0 +1,79 @@
+"""Compile a Scenario into the sim's schedule planes.
+
+This is the sim half of the scenario engine: pure functions of
+``(scenario, t, shapes)`` that the exchange layer (sim/mailbox.py,
+sim/lanes.py) folds into its existing fault draws.  Everything here is
+deterministic in the step index ``t`` (a traced scalar) and static
+geometry — no extra PRNG draws beyond the jitter (which reuses the
+delay key the non-scenario path already splits) — so the capturable-
+schedule contract holds unchanged: the runner records the materialized
+conn/crashed/delay planes, and a pinned replay substitutes them
+verbatim, bit-for-bit.
+
+Latency: ``delay_base(scn, n)`` is the static (src, dst) plane of
+per-edge delivery latencies from the zone matrix; the exchange draws
+``clip(base + U{0..jitter}, 1, wheel)`` instead of the uniform
+``U{1..max_delay}``.  Kills: ``forced_crash(scn, t, n)`` is the (n,)
+comms-dead overlay from churn, zone outages and reconfiguration
+epochs, OR-ed into the fault state every step (like ``perm_crash`` —
+held, never resampled away).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paxi_tpu.scenarios.spec import Scenario, zone_of
+
+
+def delay_base(scn: Scenario, n: int) -> np.ndarray:
+    """(n, n) int32 per-edge latency plane from the zone matrix (all
+    ones when the scenario has no zone latencies)."""
+    if scn.zones is None:
+        return np.ones((n, n), np.int32)
+    zmap = zone_of(n, scn.n_zones)
+    m = np.asarray(scn.zones.matrix, np.int32)
+    zi = np.asarray(zmap)
+    return m[zi[:, None], zi[None, :]].astype(np.int32)
+
+
+def zone_mask(scn: Scenario, zone: int, n: int) -> np.ndarray:
+    """(n,) bool membership mask for ``zone``."""
+    return np.asarray([z == zone for z in zone_of(n, scn.n_zones)])
+
+
+def forced_crash(scn: Scenario, t, n: int):
+    """(n,) bool comms-dead overlay at step ``t`` (traced or concrete)
+    from churn + outages + reconfig.  Deterministic in t; the caller
+    ORs it into the crash plane every step."""
+    import jax.numpy as jnp
+
+    ridx = jnp.arange(n)
+    dead = jnp.zeros((n,), bool)
+    c = scn.churn
+    if c is not None:
+        k = jnp.maximum(t - c.start, 0) // c.period
+        phase = (t - c.start) % c.period
+        victim = (c.first + k * c.stride) % n
+        on = (t >= c.start) & (phase < c.kill_for)
+        dead = dead | ((ridx == victim) & on)
+    for o in scn.outages:
+        zm = jnp.asarray(zone_mask(scn, o.zone, n))
+        dead = dead | (zm & (t >= o.t0) & (t < o.t1))
+    if scn.reconfig is not None and scn.reconfig.epochs:
+        eps = scn.reconfig.epochs
+        for i, (t0, live) in enumerate(eps):
+            t1 = eps[i + 1][0] if i + 1 < len(eps) else None
+            alive = np.zeros((n,), bool)
+            alive[[r for r in live if r < n]] = True
+            inside = (t >= t0) if t1 is None else ((t >= t0) & (t < t1))
+            dead = dead | (jnp.asarray(~alive) & inside)
+    return dead
+
+
+def crashed_plane(scn: Scenario, n: int, n_steps: int) -> np.ndarray:
+    """(T, n) bool materialization of ``forced_crash`` over a horizon —
+    the host-side compiler (scenarios/compile.py) and the tests use it
+    so both runtimes consume ONE kill schedule definition."""
+    return np.stack([np.asarray(forced_crash(scn, t, n))
+                     for t in range(n_steps)])

@@ -1,0 +1,200 @@
+"""The declarative scenario vocabulary: WAN topology, churn and
+reconfiguration as data.
+
+A ``Scenario`` describes the *environment* a protocol runs in — the
+axis the SIGMOD paper (and "The Performance of Paxos in the Cloud",
+PAPERS.md) measures and the uniform drop/delay/dup/crash/cut fuzz
+surface cannot express:
+
+- **zones**: a per-(src_zone, dst_zone) latency matrix — the
+  asymmetric WAN delay plane generalizing ``FuzzConfig.max_delay``'s
+  single knob.  Entry ``[i][j]`` is the delivery latency in lock-step
+  rounds (1 = the fault-free next-step minimum); ``jitter`` adds a
+  uniform 0..jitter random extra per message.
+- **churn**: a timed kill/revive schedule aimed at the leader
+  position.  The victim rotates deterministically
+  (``(first + k*stride) % R`` for the k-th kill), tracking the
+  deterministic succession order most protocols elect in — a
+  state-independent approximation of "kill whichever node currently
+  leads", which is what keeps the schedule *capturable*: the sim
+  records the materialized crash plane, so replay is exact even when
+  the rotation misses the actual leader.
+- **reconfig**: membership epochs — at each epoch step the live set
+  shrinks or grows; nodes outside the epoch's live set are comms-dead
+  (the transport-level expression of an epoch bump mid-run).
+- **outages**: whole-zone blackout windows.
+
+Everything is a frozen dataclass of ints/tuples: hashable (scenarios
+ride inside ``FuzzConfig``, a jit static argument), trivially
+serializable (``dataclasses.asdict`` -> trace meta JSON), and
+reconstructible via ``from_dict`` (trace/format.py loads pre-scenario
+traces with ``scenario=None`` and new ones by rebuilding this spec).
+
+This module is dependency-free on purpose: ``sim/types.py`` carries a
+``Scenario`` by duck type, ``scenarios/schedule.py`` compiles it into
+jnp planes, and ``scenarios/compile.py`` into host-fabric directives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class ZoneLatency:
+    """Per-(src_zone, dst_zone) delivery latency in lock-step rounds."""
+
+    matrix: Tuple[Tuple[int, ...], ...]
+    jitter: int = 0      # uniform extra 0..jitter rounds per message
+
+
+@dataclass(frozen=True)
+class LeaderChurn:
+    """Timed kills/revivals rotating over the leader succession order:
+    kill k targets replica ``(first + k*stride) % R`` during steps
+    ``[start + k*period, start + k*period + kill_for)``."""
+
+    start: int = 10
+    period: int = 40     # steps between consecutive kills
+    kill_for: int = 20   # steps each victim stays comms-dead
+    first: int = 0       # initial victim (the initial leader)
+    stride: int = 1      # succession stride
+
+
+@dataclass(frozen=True)
+class ZoneOutage:
+    """Zone ``zone`` is comms-dead during steps [t0, t1)."""
+
+    zone: int
+    t0: int
+    t1: int
+
+
+@dataclass(frozen=True)
+class Reconfig:
+    """Membership epochs: ``epochs[k] = (step, live_replica_ids)`` —
+    from ``step`` until the next epoch's step, replicas outside the
+    live set are comms-dead.  Steps must be strictly increasing."""
+
+    epochs: Tuple[Tuple[int, Tuple[int, ...]], ...] = ()
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """A WAN topology / churn / reconfiguration scenario (module
+    docstring).  ``n_zones`` is the zone-grid width used by ``zones``
+    and ``outages`` (replica r lives in zone ``r // (R // n_zones)``
+    when R divides evenly, ``r * n_zones // R`` otherwise)."""
+
+    name: str = "scenario"
+    n_zones: int = 1
+    zones: Optional[ZoneLatency] = None
+    churn: Optional[LeaderChurn] = None
+    reconfig: Optional[Reconfig] = None
+    outages: Tuple[ZoneOutage, ...] = field(default_factory=tuple)
+
+    # ---- static shape the sim needs ------------------------------------
+    def max_latency(self) -> int:
+        """Deepest delivery latency the delay wheel must hold."""
+        if self.zones is None:
+            return 1
+        return max(max(row) for row in self.zones.matrix) \
+            + max(self.zones.jitter, 0)
+
+    def kills_nodes(self) -> bool:
+        """Does this scenario ever force a comms-dead node?"""
+        return (self.churn is not None or len(self.outages) > 0
+                or (self.reconfig is not None
+                    and len(self.reconfig.epochs) > 0))
+
+    # ---- validation -----------------------------------------------------
+    def validate(self, n_replicas: int) -> "Scenario":
+        """Raise ValueError on an inconsistent spec; returns self so
+        call sites can chain."""
+        Z = self.n_zones
+        if Z < 1:
+            raise ValueError(f"scenario {self.name!r}: n_zones must be "
+                             f">= 1, got {Z}")
+        if Z > n_replicas:
+            raise ValueError(f"scenario {self.name!r}: n_zones={Z} > "
+                             f"n_replicas={n_replicas}")
+        if self.zones is not None:
+            m = self.zones.matrix
+            if len(m) != Z or any(len(row) != Z for row in m):
+                raise ValueError(
+                    f"scenario {self.name!r}: latency matrix must be "
+                    f"{Z}x{Z}, got {[len(r) for r in m]}")
+            if any(e < 1 for row in m for e in row):
+                raise ValueError(f"scenario {self.name!r}: latency "
+                                 "entries are rounds >= 1")
+            if self.zones.jitter < 0:
+                raise ValueError(f"scenario {self.name!r}: jitter < 0")
+        if self.churn is not None:
+            c = self.churn
+            if c.period < 1 or c.kill_for < 1 or c.start < 0:
+                raise ValueError(f"scenario {self.name!r}: churn needs "
+                                 "period/kill_for >= 1 and start >= 0")
+            if c.kill_for > c.period:
+                # the overlay holds ONE victim at a time (phase-within-
+                # period arithmetic): a kill window longer than the
+                # period would silently truncate, not overlap
+                raise ValueError(f"scenario {self.name!r}: churn "
+                                 f"kill_for={c.kill_for} must be <= "
+                                 f"period={c.period}")
+        if self.reconfig is not None and self.reconfig.epochs:
+            steps = [t for t, _ in self.reconfig.epochs]
+            if steps != sorted(set(steps)):
+                raise ValueError(f"scenario {self.name!r}: reconfig "
+                                 "epoch steps must be strictly increasing")
+            for t, live in self.reconfig.epochs:
+                if any(r < 0 or r >= n_replicas for r in live):
+                    raise ValueError(
+                        f"scenario {self.name!r}: epoch @{t} names a "
+                        f"replica outside 0..{n_replicas - 1}")
+        for o in self.outages:
+            if o.zone < 0 or o.zone >= Z:
+                raise ValueError(f"scenario {self.name!r}: outage zone "
+                                 f"{o.zone} outside 0..{Z - 1}")
+            if o.t1 < o.t0:
+                raise ValueError(f"scenario {self.name!r}: outage window "
+                                 f"[{o.t0}, {o.t1}) is empty-backwards")
+        return self
+
+    # ---- (de)serialization ----------------------------------------------
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Scenario":
+        """Rebuild from ``dataclasses.asdict`` output after a JSON
+        round-trip (lists back to tuples) — the trace-meta path."""
+        z = d.get("zones")
+        zones = (ZoneLatency(
+            matrix=tuple(tuple(int(e) for e in row)
+                         for row in z["matrix"]),
+            jitter=int(z.get("jitter", 0))) if z else None)
+        c = d.get("churn")
+        churn = LeaderChurn(**{k: int(v) for k, v in c.items()}) \
+            if c else None
+        rc = d.get("reconfig")
+        reconfig = (Reconfig(epochs=tuple(
+            (int(t), tuple(int(r) for r in live))
+            for t, live in rc["epochs"])) if rc else None)
+        outages = tuple(ZoneOutage(**{k: int(v) for k, v in o.items()})
+                        for o in d.get("outages", ()))
+        return Scenario(name=str(d.get("name", "scenario")),
+                        n_zones=int(d.get("n_zones", 1)),
+                        zones=zones, churn=churn, reconfig=reconfig,
+                        outages=outages)
+
+
+def zone_of(n_replicas: int, n_zones: int):
+    """Replica -> zone mapping (python list, static).  Zone-block
+    layout matching the kernels' ``r // (R/Z)`` when R divides evenly;
+    balanced blocks (``r * Z // R``) otherwise — uneven splits only
+    arise for scenarios on zone-free kernels (e.g. a WAN matrix over
+    bpaxos roles), where no quorum geometry depends on the mapping."""
+    if n_zones <= 1:
+        return [0] * n_replicas
+    if n_replicas % n_zones == 0:
+        per = n_replicas // n_zones
+        return [r // per for r in range(n_replicas)]
+    return [(r * n_zones) // n_replicas for r in range(n_replicas)]

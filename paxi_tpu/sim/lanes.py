@@ -75,9 +75,14 @@ def fault_state_init(n: int, g: int) -> Dict[str, jax.Array]:
 def fault_state_refresh(fs, rng, t, fuzz: FuzzConfig, n: int):
     """Resample partition/crash schedule every ``fuzz.window`` steps —
     shaped draws give every group an independent schedule from one key
-    (semantics of mailbox.fault_state_refresh, G-last)."""
+    (semantics of mailbox.fault_state_refresh, G-last).  Scenario
+    churn/outage/reconfig kills OR in every step like ``perm_crash``
+    (identical for every group: a scenario is the environment, not a
+    draw — see paxi_tpu/scenarios/schedule.py)."""
+    scn = fuzz.scenario
+    scn_kills = scn is not None and scn.kills_nodes()
     if not (fuzz.p_partition > 0 or fuzz.p_crash > 0
-            or fuzz.perm_crash >= 0):
+            or fuzz.perm_crash >= 0 or scn_kills):
         return fs
     g = fs["crashed"].shape[-1]
     k1, k2, k3 = jr.split(rng, 3)
@@ -97,6 +102,13 @@ def fault_state_refresh(fs, rng, t, fuzz: FuzzConfig, n: int):
         forced = ((jnp.arange(n)[:, None] == fuzz.perm_crash)
                   & (t >= fuzz.perm_crash_at))
         new["crashed"] = new["crashed"] | forced
+    if scn_kills:
+        from paxi_tpu.scenarios.schedule import forced_crash
+        # un-stick yesterday's deterministic overlay before OR-ing
+        # today's, so churn revivals happen (see mailbox twin)
+        new["crashed"] = (
+            (new["crashed"] & ~forced_crash(scn, t - 1, n)[:, None])
+            | forced_crash(scn, t, n)[:, None])
     return new
 
 

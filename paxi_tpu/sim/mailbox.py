@@ -78,9 +78,16 @@ def fault_state_refresh(fs, rng, t, fuzz: FuzzConfig, n: int):
     are dropped (socket.go Drop generalized).  Crash: a replica's sends
     and receives are suppressed (socket.go Crash — the node keeps its
     state, matching the reference where Crash only stops the transport).
+
+    A scenario's churn/outage/reconfig kills (paxi_tpu/scenarios) OR
+    into the crash plane EVERY step, like ``perm_crash`` — held
+    overlays deterministic in t, never resampled away — so they
+    materialize into the recorded schedule like any drawn fault.
     """
+    scn = fuzz.scenario
+    scn_kills = scn is not None and scn.kills_nodes()
     if not (fuzz.p_partition > 0 or fuzz.p_crash > 0
-            or fuzz.perm_crash >= 0):
+            or fuzz.perm_crash >= 0 or scn_kills):
         return fs
     k1, k2, k3 = jr.split(rng, 3)
     side = jr.bernoulli(k1, 0.5, (n,))
@@ -98,6 +105,16 @@ def fault_state_refresh(fs, rng, t, fuzz: FuzzConfig, n: int):
         forced = ((jnp.arange(n) == fuzz.perm_crash)
                   & (t >= fuzz.perm_crash_at))
         new["crashed"] = new["crashed"] | forced
+    if scn_kills:
+        from paxi_tpu.scenarios.schedule import forced_crash
+        # the carried crash plane includes LAST step's overlay; the
+        # scenario is deterministic in t, so un-stick yesterday's
+        # overlay before OR-ing today's — that is what makes revivals
+        # (churn's whole point) actually happen.  A window-drawn crash
+        # coinciding with a scenario kill revives with it (and is
+        # redrawn at the next window boundary) — scenario revival wins.
+        new["crashed"] = ((new["crashed"] & ~forced_crash(scn, t - 1, n))
+                          | forced_crash(scn, t, n))
     return new
 
 
@@ -111,6 +128,8 @@ def draw_edge_faults(rng, outbox: Mailboxes, fuzz: FuzzConfig):
     (pinned replay); the key-split structure is unchanged from the old
     inline draws, so existing runs stay bit-for-bit identical."""
     d = fuzz.wheel
+    scn = fuzz.scenario
+    geo = scn is not None and scn.zones is not None
     names = sorted(outbox.keys())
     keys = jr.split(rng, 3 * len(names))
     faults = {}
@@ -119,7 +138,21 @@ def draw_edge_faults(rng, outbox: Mailboxes, fuzz: FuzzConfig):
         kd, kdel, kdup = keys[3 * i], keys[3 * i + 1], keys[3 * i + 2]
         drop = (jr.bernoulli(kd, fuzz.p_drop, shape)
                 if fuzz.p_drop > 0 else jnp.zeros(shape, bool))
-        if d > 1:
+        if geo:
+            # WAN latency plane (paxi_tpu/scenarios): the per-edge zone
+            # matrix replaces the uniform delay distribution — base
+            # latency per (src_zone, dst_zone) plus uniform jitter,
+            # clipped to the wheel (which FuzzConfig.wheel sized to the
+            # matrix).  Same key-split structure as the uniform draw,
+            # so scenario-free runs stay bit-for-bit identical.
+            from paxi_tpu.scenarios.schedule import delay_base
+            base = jnp.asarray(delay_base(scn, shape[0]))
+            base = base.reshape(base.shape + (1,) * (len(shape) - 2))
+            if scn.zones.jitter > 0:
+                base = base + jr.randint(kdel, shape, 0,
+                                         scn.zones.jitter + 1)
+            delay = jnp.clip(base, 1, d).astype(jnp.int32)
+        elif d > 1:
             delay = jr.randint(kdel, shape, 1, d + 1)  # arrive in 1..d steps
         else:
             delay = jnp.ones(shape, jnp.int32)

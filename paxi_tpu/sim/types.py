@@ -94,16 +94,28 @@ class FuzzConfig:
     # protocols to exercise real recovery/takeover, not just retries
     perm_crash: int = -1
     perm_crash_at: int = 0
+    # WAN topology / churn / reconfiguration scenario
+    # (paxi_tpu/scenarios/spec.Scenario; Any-typed to keep this module
+    # import-cycle-free — scenarios/compile.py imports FuzzConfig).
+    # Folded into the schedule draws by sim/mailbox.py + sim/lanes.py:
+    # the zone matrix replaces the uniform delay draw, churn/outage/
+    # reconfig kills OR into the crash plane — both still materialize
+    # into the recorded schedule, so capture/replay/shrink work
+    # unchanged.
+    scenario: Any = None
 
     @property
     def wheel(self) -> int:
-        return max(self.max_delay, 1)
+        d = max(self.max_delay, 1)
+        if self.scenario is not None:
+            d = max(d, self.scenario.max_latency())
+        return d
 
     @property
     def faulty(self) -> bool:
         return (self.p_drop > 0 or self.p_dup > 0 or self.p_crash > 0
                 or self.p_partition > 0 or self.max_delay > 1
-                or self.perm_crash >= 0)
+                or self.perm_crash >= 0 or self.scenario is not None)
 
 
 FAULT_FREE = FuzzConfig()

@@ -39,7 +39,14 @@ from paxi_tpu.sim.types import FuzzConfig, SimConfig
 _META_KEY = "__paxi_tpu_trace_meta__"
 _SEP = "|"
 # bump on incompatible schedule-layout changes; load() refuses a
-# mismatch with a clear error instead of a downstream shape error
+# mismatch with a clear error instead of a downstream shape error.
+# The scenario engine (paxi_tpu/scenarios) did NOT bump this: the
+# schedule planes are unchanged (a zone-latency delay is just a deeper
+# per-edge delay value, churn is just crash-plane occupancy) and the
+# meta extension is additive — ``fuzz.scenario`` is reconstructed when
+# present and defaults to None for pre-scenario traces, the same
+# subset-compatibility rule the counter check follows (cli.py `trace
+# replay` compares only RECORDED counter keys).
 TRACE_VERSION = 1
 
 
@@ -75,7 +82,7 @@ class Trace:
         return SimConfig(**self.meta["sim_cfg"])
 
     def fuzz_config(self) -> FuzzConfig:
-        return FuzzConfig(**self.meta["fuzz"])
+        return fuzz_from_meta(self.meta["fuzz"])
 
     def n_events(self) -> int:
         """Total fault events in the schedule (what the shrinker
@@ -99,6 +106,22 @@ class Trace:
             # (e.g. shrunk) trace to its parent
             meta["schedule_hash"] = schedule_hash(t)
         return t
+
+
+def fuzz_from_meta(d: Dict[str, Any]) -> FuzzConfig:
+    """Rebuild a FuzzConfig from trace meta (``dataclasses.asdict``
+    after a JSON round-trip).  Pre-scenario traces have no
+    ``scenario`` key and reconstruct with ``scenario=None``; newer
+    traces rebuild the nested Scenario spec (lists back to tuples) so
+    the pinned replay sizes its delay wheel and kill overlay exactly
+    like the captured run did."""
+    d = dict(d)
+    scn = d.pop("scenario", None)
+    fz = FuzzConfig(**d)
+    if scn is not None:
+        from paxi_tpu.scenarios.spec import Scenario
+        fz = dataclasses.replace(fz, scenario=Scenario.from_dict(scn))
+    return fz
 
 
 def schedule_hash(trace: "Trace") -> str:

@@ -142,12 +142,22 @@ class SeqSchedule:
     """A trace projected onto the virtual-clock fabric's fault surface:
     occurrence-indexed per-message faults plus per-logical-step crash
     and partition-cut sets — the exact-order alternative to the
-    windowed ``host_directives`` projection."""
+    windowed ``host_directives`` projection.
+
+    ``edge_delay`` is the scenario engine's WAN plane
+    (paxi_tpu/scenarios): EXTRA logical steps added to every send on
+    an (src, dst) edge — a standing per-edge latency rather than an
+    occurrence-indexed event.  Trace projections leave it empty (a
+    recorded schedule already carries its latency inside the per-event
+    ``delay_steps``); ``scenarios.compile.seq_schedule_of`` fills it
+    when a Scenario drives the fabric directly."""
 
     n_steps: int
     faults: List[SeqFault] = dataclasses.field(default_factory=list)
     crashed: Dict[str, List[int]] = dataclasses.field(default_factory=dict)
     cut: Dict[Tuple[str, str], List[int]] = dataclasses.field(
+        default_factory=dict)
+    edge_delay: Dict[Tuple[str, str], int] = dataclasses.field(
         default_factory=dict)
     # fault events the fabric cannot replay exactly: planes with no
     # TRACE_MSG_MAP entry (mailbox -> event count) and duplications
@@ -175,6 +185,10 @@ class SeqSchedule:
     def is_cut(self, src: str, dst: str, step: int) -> bool:
         return step in self._cut.get((src, dst), ())
 
+    def edge_extra(self, src: str, dst: str) -> int:
+        """Standing per-edge latency (extra logical steps per send)."""
+        return self.edge_delay.get((src, dst), 0)
+
     @property
     def exact(self) -> bool:
         """True when every recorded fault event replays exactly."""
@@ -187,6 +201,8 @@ class SeqSchedule:
             "crashed": {i: list(ts) for i, ts in self.crashed.items()},
             "cut": {f"{s}->{d}": list(ts)
                     for (s, d), ts in self.cut.items()},
+            "edge_delay": {f"{s}->{d}": x
+                           for (s, d), x in self.edge_delay.items()},
             "unmapped": dict(self.unmapped),
             "dups_skipped": self.dups_skipped,
         }
@@ -275,8 +291,9 @@ def host_directives(trace: Trace, ids: Sequence, step_s: float = 0.05,
                                 (hi + 1) * step_s))
 
     # delays -> SlowWin per contiguous run; the per-event magnitude is
-    # the schedule's wheel depth (max_delay steps)
-    lag = max(trace.fuzz_config().max_delay - 1, 1) * step_s
+    # the schedule's wheel depth (max_delay steps, or the scenario
+    # latency matrix's deepest entry — FuzzConfig.wheel covers both)
+    lag = max(trace.fuzz_config().wheel - 1, 1) * step_s
     slow_edge: Dict[Tuple[int, int], set] = {}
     for name in sorted(sched["faults"]):
         delay = np.asarray(sched["faults"][name]["delay"])

@@ -58,7 +58,20 @@ class ReplayResult:
 
 
 def state_hash(state) -> str:
-    """Order-, dtype- and shape-sensitive fingerprint of a pytree."""
+    """Order-, dtype- and shape-sensitive fingerprint of a pytree.
+
+    Protocol-state keys prefixed ``m_`` are EXCLUDED: they are
+    measurement accumulators (e.g. the zone-latency accounting planes
+    the wpaxos/wankeeper kernels carry for the scenario bench), pure
+    read-side accounting that never feeds a transition — excluding
+    them keeps traces captured before a kernel grew an instrumentation
+    plane replaying hash-clean, the state-side twin of the counter
+    subset-compare rule (trace/format.py TRACE_VERSION note).  Their
+    determinism is still pinned: they land in the run metrics, which
+    the replay tests compare directly."""
+    if isinstance(state, dict):
+        state = {k: v for k, v in state.items()
+                 if not k.startswith("m_")}
     h = hashlib.sha256()
     for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
         a = np.asarray(leaf)

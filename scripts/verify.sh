@@ -118,10 +118,15 @@ PYEOF
     echo "== hunt micro-campaign (paxi_tpu/hunt/) =="
     # fresh campaign dir each time: the smoke checks the whole loop
     # (fuzz -> capture -> shrink -> fabric replay -> classify), and
-    # `hunt run` exits 2 on any unclassified witness
+    # `hunt run` exits 2 on any unclassified witness.  relay_churn is
+    # the scenario engine's micro WAN case: leader churn (plus the
+    # wan3z latency matrix on its second schedule) must produce
+    # witnesses that classify — the churn twin shares its seeded bugs
+    # across runtimes, so they land REPRODUCED
     HUNT_DIR=$(mktemp -d /tmp/paxi_hunt_smoke.XXXXXX)
-    timeout -k 10 420 env JAX_PLATFORMS=cpu python -m paxi_tpu hunt run \
-      --budget 2 --quick --protocols paxos,abd,bpaxos,fragile_counter \
+    timeout -k 10 480 env JAX_PLATFORMS=cpu python -m paxi_tpu hunt run \
+      --budget 2 --quick \
+      --protocols paxos,abd,bpaxos,fragile_counter,relay_churn \
       --dir "$HUNT_DIR" --traces-dir "$HUNT_DIR/noseed" || exit $?
     rm -rf "$HUNT_DIR"
   elif [ "$1" = "--lint" ]; then

@@ -268,6 +268,9 @@ def _assert_counter_roundtrip(name: str):
 
 
 @pytest.mark.jax
+@pytest.mark.slow  # tier-1 budget audit (PR 10): ~16s, and the
+# vmapped-layout counter roundtrip is also pinned tier-1 by the
+# scenario capture/replay counter checks (tests/test_scenarios.py)
 def test_sim_counters_recorded_equals_pinned_replay():
     _assert_counter_roundtrip("paxos_pg")       # vmapped layout
 

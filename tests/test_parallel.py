@@ -82,6 +82,9 @@ def test_lane_major_sharded_exact_metrics_fault_free():
         assert int(m8[k]) == int(res1.metrics[k]), k
 
 
+@pytest.mark.slow  # tier-1 budget audit (PR 10): ~26s sharded compile
+# pinning the pad path, which only fires when group counts don't
+# divide the mesh — every bench/CLI default shape divides evenly
 def test_indivisible_groups_pad_and_subtract():
     """12 groups shard over 8 devices via inert padding; the pad
     groups' contribution is excluded from the psum'd metrics, and —

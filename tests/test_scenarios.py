@@ -1,0 +1,308 @@
+"""Scenario engine (paxi_tpu/scenarios): WAN topology, churn &
+reconfiguration as capturable schedule extensions.
+
+Fast cases ride the ``relay_churn`` demo kernel (tiny compile) and the
+pure-python spec/compile layer; the wpaxos 3-zone geo witness — the
+acceptance round-trip (capture -> bit-for-bit replay -> ddmin shrink)
+on a real kernel — runs under ``-m slow`` with the other big-kernel
+scenario fuzz variants (tier-1 keeps one scenario variant per big
+kernel, inside each kernel's own test file)."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from paxi_tpu import scenarios as scn
+from paxi_tpu import trace as tr
+from paxi_tpu.hunt import cases as hc
+from paxi_tpu.protocols import sim_protocol
+from paxi_tpu.scenarios.schedule import crashed_plane, delay_base
+from paxi_tpu.sim import FuzzConfig, SimConfig, simulate
+
+pytestmark = pytest.mark.jax
+
+RELAY_CFG = SimConfig(n_replicas=3)
+# light loss on top of the churn rotation: the shrinker gets both
+# drawn and scenario-forced events to chew on
+CHURN_LOSSY = FuzzConfig(p_drop=0.05, scenario=scn.NAMED["churn"])
+
+
+# ---- spec: validation + (de)serialization -------------------------------
+def test_spec_validation_rejects_inconsistencies():
+    with pytest.raises(ValueError, match="matrix must be"):
+        scn.Scenario(n_zones=2, zones=scn.ZoneLatency(
+            matrix=((1,),))).validate(4)
+    with pytest.raises(ValueError, match="rounds >= 1"):
+        scn.Scenario(n_zones=2, zones=scn.ZoneLatency(
+            matrix=((1, 0), (2, 1)))).validate(4)
+    with pytest.raises(ValueError, match="n_zones=5"):
+        scn.Scenario(n_zones=5).validate(3)
+    with pytest.raises(ValueError, match="strictly increasing"):
+        scn.Scenario(reconfig=scn.Reconfig(
+            epochs=((10, (0, 1)), (10, (0,))))).validate(3)
+    with pytest.raises(ValueError, match="outside 0..2"):
+        scn.Scenario(reconfig=scn.Reconfig(
+            epochs=((0, (0, 3)),))).validate(3)
+    with pytest.raises(ValueError, match="outage zone"):
+        scn.Scenario(n_zones=2, outages=(
+            scn.ZoneOutage(zone=2, t0=0, t1=5),)).validate(4)
+    # kill_for > period would silently truncate each kill window to
+    # the period (the overlay holds one victim at a time) — rejected
+    with pytest.raises(ValueError, match="kill_for=20"):
+        scn.Scenario(churn=scn.LeaderChurn(
+            period=10, kill_for=20)).validate(3)
+
+
+def test_spec_json_roundtrip_rebuilds_equal_spec():
+    # the trace-meta path: asdict -> JSON -> from_dict must rebuild an
+    # EQUAL (hashable, tuple-typed) spec for every field family
+    rich = scn.Scenario(
+        name="rich", n_zones=3,
+        zones=scn.ZoneLatency(matrix=((1, 2, 3), (2, 1, 2), (3, 2, 1)),
+                              jitter=2),
+        churn=scn.LeaderChurn(start=4, period=20, kill_for=8, first=1,
+                              stride=2),
+        reconfig=scn.Reconfig(epochs=((0, (0, 1, 2)), (30, (0, 1)))),
+        outages=(scn.ZoneOutage(zone=1, t0=10, t1=20),))
+    back = scn.Scenario.from_dict(
+        json.loads(json.dumps(dataclasses.asdict(rich))))
+    assert back == rich
+    assert hash(back) == hash(rich)
+    for named in scn.NAMED.values():
+        d = json.loads(json.dumps(dataclasses.asdict(named)))
+        assert scn.Scenario.from_dict(d) == named
+
+
+def test_zone_of_layouts():
+    assert scn.zone_of(9, 3) == [0, 0, 0, 1, 1, 1, 2, 2, 2]
+    assert scn.zone_of(4, 1) == [0, 0, 0, 0]
+    # uneven split: balanced blocks, every zone populated
+    assert scn.zone_of(7, 2) == [0, 0, 0, 0, 1, 1, 1]
+
+
+# ---- schedule compilation: delay plane + kill overlay -------------------
+def test_delay_base_maps_zone_matrix_per_edge():
+    wan3z = scn.NAMED["wan3z"]
+    base = delay_base(wan3z, 9)
+    assert base.shape == (9, 9)
+    assert int(base[0, 1]) == 1          # intra-zone
+    assert int(base[0, 3]) == 3          # zone 0 -> zone 1
+    assert int(base[0, 8]) == 5          # zone 0 -> zone 2 (far edge)
+    assert int(base[8, 0]) == 5
+    # scenario-free spec compiles to the all-ones plane
+    assert (delay_base(scn.Scenario(), 4) == 1).all()
+
+
+def test_kill_overlay_churn_rotation_and_revival():
+    churn = scn.NAMED["churn"]          # start=6 period=30 kill_for=16
+    plane = crashed_plane(churn, 3, 70)  # (T, R)
+    assert not plane[:6].any()                    # pre-start: alive
+    assert plane[6, 0] and not plane[6, 1:].any()  # kill 0: replica 0
+    assert plane[21, 0]
+    assert not plane[22].any()                    # revival happened
+    assert plane[36, 1] and not plane[36, 0]      # kill 1: rotated
+    assert not plane[52:66].any()
+
+
+def test_kill_overlay_reconfig_and_outage():
+    sg = scn.NAMED["shrink_grow5"]      # 5 -> 3 @40 -> 5 @90
+    plane = crashed_plane(sg, 5, 100)
+    assert not plane[:40].any()
+    assert (plane[40:90, 3:] == True).all()       # noqa: E712
+    assert not plane[40:90, :3].any()
+    assert not plane[90:].any()
+    zf = scn.NAMED["zoneflap"]          # zone 1 out [30,60), zone 2 [80,110)
+    plane = crashed_plane(zf, 9, 90)
+    assert plane[30:60, 3:6].all() and not plane[30:60, :3].any()
+    assert not plane[60:80].any()
+    assert plane[80:90, 6:9].all()
+
+
+def test_fuzz_config_wheel_sized_to_scenario():
+    geo = scn.with_scenario(FuzzConfig(), scn.NAMED["wan3z"])
+    assert geo.wheel == 6                 # max matrix entry 5 + jitter 1
+    assert geo.faulty
+    assert scn.with_scenario(FuzzConfig(max_delay=8),
+                             scn.NAMED["wan3z"]).wheel == 8
+
+
+def test_seq_schedule_of_compiles_both_surfaces():
+    ids = ["1.1", "1.2", "2.1", "2.2"]
+    sched = scn.seq_schedule_of(scn.NAMED["wan2z"], ids, 20)
+    # cross-zone edges carry base-1 EXTRA steps; intra-zone edges none
+    assert sched.edge_extra("1.1", "2.1") == 3
+    assert sched.edge_extra("1.1", "1.2") == 0
+    assert not sched.crashed
+    churn = scn.seq_schedule_of(scn.NAMED["churn"], ["1.1", "1.2", "1.3"],
+                                40)
+    plane = crashed_plane(scn.NAMED["churn"], 3, 40)
+    for r, i in enumerate(["1.1", "1.2", "1.3"]):
+        assert churn.crashed.get(i, []) == \
+            [t for t in range(40) if plane[t, r]]
+    assert not churn.edge_delay
+
+
+# ---- structural schedule naming (hunt/cases.py satellite) ---------------
+def test_sched_name_is_structural_not_identity():
+    assert hc.sched_name(hc.DROP) == "drop"
+    assert hc.sched_name(hc.DUP) == "dup"
+    assert hc.sched_name(hc.PART) == "partition"
+    assert hc.sched_name(hc.KILL) == "perm_kill"
+    assert hc.sched_name(hc.GEO3Z) == "wan3z+drop"
+    assert hc.sched_name(hc.GEO_CHURN) == "wan3z_churn"
+    # the old id()-keyed table named any reconstructed-but-equal config
+    # "sched" — structural naming is a pure function of the contents
+    assert hc.sched_name(FuzzConfig(p_drop=0.25, max_delay=2)) == "drop"
+    assert hc.sched_name(FuzzConfig()) == "sched"
+    assert hc.sched_name(FuzzConfig(max_delay=3)) == "delay"
+
+
+# ---- the capturable-schedule contract under a scenario ------------------
+@pytest.fixture(scope="module")
+def relay():
+    return sim_protocol("relay_churn")
+
+
+@pytest.fixture(scope="module")
+def churn_witness(relay):
+    t = tr.capture(relay, RELAY_CFG, CHURN_LOSSY, seed=0, n_groups=8,
+                   n_steps=60)
+    assert t is not None, "churn must violate the relay twin"
+    return t
+
+
+def test_relay_twin_is_churn_sensitive(relay):
+    clean = simulate(relay, RELAY_CFG, 8, 60, seed=0)
+    assert int(clean.violations) == 0, "fault-free relay must be clean"
+
+
+def test_scenario_capture_replays_bit_for_bit(churn_witness):
+    t = churn_witness
+    # scenario survives the meta round-trip as a rebuilt spec
+    assert t.fuzz_config().scenario == scn.NAMED["churn"]
+    r = tr.check_determinism(t)       # two replays, identical outcome
+    assert r.violations == t.meta["group_violations"]
+    assert r.state_hash == t.meta["capture_state_hash"]
+    # counter determinism rides along (the recorded whole-batch keys)
+    for k, v in t.meta["capture_counters"].items():
+        assert r.counters.get(k) == v, k
+
+
+def test_scenario_trace_save_load_roundtrip(churn_witness, tmp_path):
+    p = tr.save(str(tmp_path / "churn"), churn_witness)
+    t2 = tr.load(p)
+    # meta equality modulo JSON normalization (the spec's tuples come
+    # back as lists; fuzz_config() rebuilds the typed spec)
+    assert t2.meta == json.loads(json.dumps(churn_witness.meta))
+    assert t2.fuzz_config() == churn_witness.fuzz_config()
+    r = tr.replay(t2)
+    assert r.state_hash == t2.meta["capture_state_hash"]
+
+
+def test_scenario_witness_shrinks_via_ddmin(churn_witness):
+    mini, stats = tr.shrink(churn_witness, max_trials=60)
+    assert stats["violations"] > 0
+    assert stats["events_after"] <= stats["events_before"]
+    assert stats["steps_after"] <= stats["steps_before"]
+    r = tr.replay(mini)
+    assert r.violations == mini.meta["group_violations"] > 0
+    assert r.state_hash == mini.meta["replay_state_hash"]
+
+
+def test_pre_scenario_trace_stays_green(tmp_path):
+    """Format-compat regression (satellite): a trace whose meta
+    predates the scenario field (no ``fuzz.scenario`` key) must load
+    with ``scenario=None`` and replay hash-clean."""
+    fragile = sim_protocol("fragile_counter")
+    t = tr.capture(fragile, RELAY_CFG, FuzzConfig(p_drop=0.2, max_delay=2),
+                   seed=0, n_groups=4, n_steps=20)
+    assert t is not None
+    assert "scenario" in t.meta["fuzz"]
+    del t.meta["fuzz"]["scenario"]      # what an old capture looks like
+    p = tr.save(str(tmp_path / "old"), t)
+    t2 = tr.load(p)
+    fz = t2.fuzz_config()
+    assert fz.scenario is None
+    assert fz == FuzzConfig(p_drop=0.2, max_delay=2)
+    r = tr.replay(t2)
+    assert r.violations == t2.meta["group_violations"]
+    assert r.state_hash == t2.meta["capture_state_hash"]
+
+
+def test_state_hash_ignores_measurement_planes():
+    # the ``m_`` exclusion rule that keeps pre-instrumentation traces
+    # hash-compatible (trace/replay.state_hash)
+    base = {"log": np.arange(4), "ver": np.ones(3)}
+    with_m = dict(base, m_lat_local_sum=np.full(3, 7))
+    assert tr.state_hash(base) == tr.state_hash(with_m)
+    assert tr.state_hash(base) != tr.state_hash(
+        dict(base, ver=np.zeros(3)))
+
+
+# ---- the host fabric half -----------------------------------------------
+@pytest.mark.host
+def test_fabric_churn_schedule_replays_deterministically():
+    """Two-replay determinism pin for a churn schedule on the
+    virtual-clock fabric (satellite): same scenario, same seed ->
+    identical oracle count and fabric stats."""
+    import asyncio
+
+    from paxi_tpu.hunt.classify import replay_schedule
+
+    ids = ["1.1", "1.2", "1.3"]
+    sched = scn.seq_schedule_of(scn.NAMED["churn"], ids, 60)
+    outs = []
+    for _ in range(2):
+        s = scn.seq_schedule_of(scn.NAMED["churn"], ids, 60)
+        outs.append(asyncio.run(replay_schedule(
+            "relay_churn", RELAY_CFG, s, seed=0)))
+    a, b = outs
+    assert a.oracle_violations == b.oracle_violations > 0
+    assert a.fabric_stats == b.fabric_stats
+    assert sched.crashed  # the schedule actually carried kills
+
+
+@pytest.mark.host
+def test_churn_witness_classifies_reproduced(churn_witness):
+    """The hunt pipeline's positive control for scenario schedules:
+    the relay twin shares its seeded bugs across runtimes, so a sim
+    churn witness must classify REPRODUCED end to end."""
+    from paxi_tpu.hunt import classify_witness
+
+    c = classify_witness(churn_witness)
+    assert c.outcome == "reproduced", c.to_json()
+    assert c.host["oracle_violations"] > 0
+
+
+# ---- the acceptance round-trip on a real kernel (slow tier) -------------
+@pytest.mark.slow
+def test_wpaxos_3zone_geo_witness_end_to_end():
+    """A captured wpaxos 3-zone asymmetric-latency scenario witness
+    replays bit-for-bit (state hash + counters) and shrinks via ddmin
+    — the acceptance criterion, on the thin-read-quorum seeded twin
+    whose intersection break only WAN geo-latency exposes."""
+    thinq1 = sim_protocol("wpaxos_thinq1")
+    cfg = SimConfig(n_replicas=9, n_zones=3, n_objects=4, n_slots=16,
+                    steal_threshold=2, locality=0.3)
+    t = tr.capture(thinq1, cfg, hc.GEO3Z, seed=0, n_groups=16,
+                   n_steps=100)
+    assert t is not None, "wan3z must expose the thin-Q1 twin"
+    assert t.fuzz_config().scenario == scn.NAMED["wan3z"]
+    r = tr.check_determinism(t)
+    assert r.violations == t.meta["group_violations"]
+    assert r.state_hash == t.meta["capture_state_hash"]
+    for k, v in t.meta["capture_counters"].items():
+        assert r.counters.get(k) == v, k
+    mini, stats = tr.shrink(t, max_trials=40)
+    assert stats["violations"] > 0
+    assert stats["events_after"] <= stats["events_before"]
+    rm = tr.replay(mini)
+    assert rm.violations == mini.meta["group_violations"] > 0
+    assert rm.state_hash == mini.meta["replay_state_hash"]
+    # the REAL kernel stays safe under the same geo schedule: the
+    # witness is the seeded quorum thinning, not the scenario engine
+    real = simulate(sim_protocol("wpaxos"), cfg, 16, 100, fuzz=hc.GEO3Z,
+                    seed=0)
+    assert int(real.violations) == 0

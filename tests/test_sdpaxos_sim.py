@@ -35,6 +35,9 @@ def test_commands_from_every_owner_execute():
     assert (best > 0).all(), best
 
 
+@pytest.mark.slow  # tier-1 budget audit (PR 10): ~14s second compile;
+# determinism is the shared runner's property (same demotion the
+# wpaxos/wankeeper twins got in PR 7)
 def test_deterministic():
     r1, _ = run(groups=4, steps=50, seed=7)
     r2, _ = run(groups=4, steps=50, seed=7)
