@@ -209,6 +209,11 @@ def worker() -> int:
         "compile_s": round(compile_s, 3),
         "warmup_s": round(warmup_s, 3),
         "invariant_violations": int(viols),
+        # on-device verification & latency observability (PR 11): the
+        # in-kernel commit-latency histogram (p50/p99 in lock-step
+        # rounds) and the in-scan linearizability verdict — the bench
+        # asserts safety at full speed, not just slot counts
+        "inscan_violations": int(metrics.get("inscan_violations", -1)),
         "groups": n_groups,
         "replicas": n_replicas,
         "steps": n_steps,
@@ -220,6 +225,23 @@ def worker() -> int:
         "device": ("cpu-fallback" if os.environ.get("BENCH_FALLBACK")
                    else str(dev)),
     }
+    from paxi_tpu.metrics import lathist
+    hist = lathist.total_hist(state)
+    if hist is not None:
+        lat = lathist.summarize(hist, int(metrics.get("commit_lat_sum",
+                                                      0)))
+        result["commit_latency"] = lat
+        result["latency_p50_rounds"] = lat["p50_rounds"]
+        result["latency_p99_rounds"] = lat["p99_rounds"]
+        # host-registry-format snapshot: `python -m paxi_tpu metrics
+        # --file <artifact>` renders sim and host histograms through
+        # the one registry code path
+        result["sim_metrics"] = {"histograms": [{
+            "name": "paxi_sim_commit_latency_seconds",
+            "labels": {"kernel": proto.name, "source": "sim"},
+            **lathist.to_host_snapshot(
+                hist, int(metrics.get("commit_lat_sum", 0))),
+        }]}
 
     # the artifact line goes out FIRST: a tunnel wedge during the
     # optional scaling sweep below must never cost an already-completed

@@ -149,7 +149,7 @@ def main() -> int:
         compiled = run.lower(jr.PRNGKey(0), groups, steps).compile()
         jax.block_until_ready(compiled(jr.PRNGKey(1)))
         t0 = time.perf_counter()
-        _, metrics, viols = compiled(jr.PRNGKey(0))
+        state, metrics, viols = compiled(jr.PRNGKey(0))
         jax.block_until_ready(viols)
         dt = time.perf_counter() - t0
         n = int(metrics[key])
@@ -172,6 +172,18 @@ def main() -> int:
         # lock-step rounds — propose->commit inside the owner's zone
         # vs across the WAN matrix
         line.update(scn.latency_split(metrics))
+        # on-device observability (instrumented kernels): commit-latency
+        # distribution (p50/p99/p999 in lock-step rounds, from the
+        # in-kernel m_lat_hist plane) + the in-scan linearizability
+        # verdict — every row asserts safety, not just throughput
+        from paxi_tpu.metrics import lathist
+        hist = lathist.total_hist(state)
+        if hist is not None:
+            line["commit_latency"] = lathist.summarize(
+                hist, int(metrics.get("commit_lat_sum", 0)))
+            line["inscan_violations"] = int(
+                metrics.get("inscan_violations", 0))
+            worst = max(worst, line["inscan_violations"])
         worst = max(worst, int(viols))
         results.append(line)
         print(json.dumps(line), flush=True)

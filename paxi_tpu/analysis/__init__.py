@@ -23,6 +23,11 @@ across file boundaries; ``lint --graph`` dumps it as DOT):
 - ``cross-module-flow``    (crossflow.py,   PXF8xx)
 - ``async-atomicity``      (asyncflow.py,   PXA9xx)
 
+Observability isolation (taint walk over the sim kernels' step
+functions; guards the PR-11 on-device measurement layer):
+
+- ``measurement-isolation`` (measure.py,    PXM10x)
+
 Entry points: ``python -m paxi_tpu lint [--rule ...] [--json]`` (cli.py;
 ``--rule`` takes family names or code prefixes like ``PXQ,PXB``) and
 :func:`run_lint` for tests/tooling.  Intentional exceptions live in
@@ -38,7 +43,7 @@ from pathlib import Path
 from typing import Dict, List, Optional, Sequence
 
 from paxi_tpu.analysis import astutil, asyncflow, ballots, concurrency, \
-    crossflow, handlers, parity, purity, quorum, tracemap
+    crossflow, handlers, measure, parity, purity, quorum, tracemap
 from paxi_tpu.analysis.model import (LintReport, Suppression, Violation,
                                      apply_suppressions, inline_disables,
                                      load_baseline)
@@ -58,6 +63,7 @@ RULES = {
     parity.RULE: parity,
     crossflow.RULE: crossflow,
     asyncflow.RULE: asyncflow,
+    measure.RULE: measure,
 }
 
 # violation-code prefix -> rule family, the CLI's short spelling
@@ -73,6 +79,7 @@ CODE_PREFIXES = {
     "PXS": parity.RULE,
     "PXF": crossflow.RULE,
     "PXA": asyncflow.RULE,
+    "PXM": measure.RULE,
 }
 
 # pair-driven rules (registry-derived sim/host pairs instead of globs)

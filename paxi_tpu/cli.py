@@ -646,14 +646,46 @@ def cmd_metrics(args) -> int:
                           max_delay=args.max_delay)
         res = simulate(proto, cfg, args.groups, args.steps, fuzz=fuzz,
                        seed=args.seed, series=True)
-        print(json.dumps({
-            "algorithm": args.algorithm,
-            "groups": args.groups,
-            "steps": args.steps,
-            "violations": int(res.violations),
-            "series": {k: [int(x) for x in v]
-                       for k, v in res.counter_series.items()},
-        }))
+        series = {k: [int(x) for x in v]
+                  for k, v in sorted(res.counter_series.items())}
+        lat = res.latency_summary()
+        if getattr(args, "csv", False):
+            # artifact-ready CSV: one row per step, one column per
+            # counter; run-level context (incl. the in-kernel
+            # commit-latency histogram summary) as '#' header comments
+            lines = [f"# algorithm={args.algorithm} groups={args.groups}"
+                     f" steps={args.steps}"
+                     f" violations={int(res.violations)}"]
+            if lat is not None:
+                lines.append(
+                    f"# commit_latency n={lat['n']}"
+                    f" p50_rounds={lat['p50_rounds']}"
+                    f" p99_rounds={lat['p99_rounds']}"
+                    f" p999_rounds={lat['p999_rounds']}"
+                    f" inscan_violations={res.inscan_violations}")
+            names = list(series)
+            lines.append(",".join(["step"] + names))
+            for t in range(args.steps):
+                lines.append(",".join(
+                    [str(t)] + [str(series[n][t]) for n in names]))
+            text = "\n".join(lines) + "\n"
+        else:
+            doc = {
+                "algorithm": args.algorithm,
+                "groups": args.groups,
+                "steps": args.steps,
+                "violations": int(res.violations),
+                "series": series,
+            }
+            if lat is not None:
+                doc["commit_latency"] = lat
+                doc["inscan_violations"] = res.inscan_violations
+            text = json.dumps(doc) + "\n"
+        if getattr(args, "out", ""):
+            with open(args.out, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
         return 0
 
     def _find_snapshots(doc, out):
@@ -1025,6 +1057,14 @@ def main(argv=None) -> int:
     me.add_argument("-series", "--series", action="store_true",
                     help="run the sim and export the per-step counter "
                          "time series instead")
+    me.add_argument("-csv", "--csv", action="store_true",
+                    help="with -series: emit CSV (one row per step, "
+                         "one column per counter; run-level "
+                         "latency-histogram summary in '#' header "
+                         "comments) instead of JSON")
+    me.add_argument("-out", "--out", default="",
+                    help="write the -series export to this file "
+                         "instead of stdout")
     me.add_argument("-algorithm", "--algorithm", default="paxos")
     me.add_argument("-groups", type=int, default=64)
     me.add_argument("-steps", type=int, default=100)

@@ -1,0 +1,228 @@
+"""Sim-side in-kernel commit-latency histograms.
+
+The post-hoc latency accounting the zone-aware kernels pioneered
+(PR 10's ``m_lat_*_sum/_n`` planes) reports *means*; the source papers'
+point is that tails, not means, are what degrade first ("The
+Performance of Paxos in the Cloud", PAPERS.md).  This module is the
+distribution-shaped version: protocol kernels stamp each slot's FIRST
+propose step into an ``m_prop_t`` plane, and on commit bin the
+propose->commit step delta into a fixed log2-spaced int32 histogram
+plane (``m_lat_hist``) *inside the scan body* — so a 100k-group bench
+run reports p50/p99/p999 without ever materializing per-slot latencies
+on host.
+
+Layout: ``N_BUCKETS`` buckets over step deltas; bucket 0 holds
+``dt <= 1``, bucket ``i`` (1..N_BUCKETS-2) holds ``dt`` in
+``(2**(i-1), 2**i]``, the last bucket is overflow.  The layout is
+FIXED so histogram planes merge by bucket-count addition — across
+groups (the kernel's in-scan accumulate), across shards
+(``parallel/mesh.py`` returns the plane inside the sharded state), and
+across runs (plain vector adds).
+
+Interop with the host layout: ``to_host_snapshot`` converts a sim
+bucket vector into the host registry's snapshot schema
+(``metrics/registry.py``, scheme ``log6:1e-6:54``) by mapping each sim
+bucket's geometric-midpoint latency — at a caller-chosen
+``step_seconds`` per lock-step round — onto the host bounds.  The
+result bucket-merges exactly with host histograms and renders through
+the registry's single ``pretty``/``percentile`` code path, which is
+what lets ``python -m paxi_tpu metrics`` show sim and host
+distributions side by side.
+
+Like ``simcount.py``, the kernel-side helpers import jax; host-only
+code should import ``paxi_tpu.metrics`` (registry only) instead.
+All ``m_``-prefixed planes are excluded from the trace witness hash
+(``trace/replay.state_hash``) and must never feed protocol logic —
+enforced statically by the PXM10x rule family (analysis/measure.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+# bucket i (1..N-2) holds dt in (2**(i-1), 2**i] steps; bucket 0 is
+# dt <= 1; the last bucket is overflow (dt > 2**(N-2)).  2**10 = 1024
+# steps covers every sim horizon in the tree; longer runs land in the
+# overflow bucket, which percentile() reports honestly as ">= bound".
+N_BUCKETS = 12
+BOUNDS_STEPS = tuple(2 ** i for i in range(N_BUCKETS - 1))  # 1..1024
+
+
+def empty_hist(n_groups: Optional[int] = None):
+    """Zeroed ``m_lat_hist`` plane: (N_BUCKETS, G) lane-major, or
+    (N_BUCKETS,) for per-group kernels."""
+    import jax.numpy as jnp
+    shape = (N_BUCKETS,) if n_groups is None else (N_BUCKETS, n_groups)
+    return jnp.zeros(shape, jnp.int32)
+
+
+def hist_update(hist, dt, mask):
+    """Accumulate masked step deltas into a histogram plane, in-scan.
+
+    ``dt``/``mask`` share a shape whose trailing dims match
+    ``hist[1:]`` (lane-major: trailing group axis; per-group: hist is
+    (N_BUCKETS,) and everything reduces to scalars).  Implemented as
+    one masked count per bucket BOUND (cumulative counts above each
+    bound, then adjacent differences) — N_BUCKETS-1 fused
+    compare+reduce passes, no (..., N_BUCKETS) one-hot intermediate.
+    """
+    import jax.numpy as jnp
+    axes = tuple(range(dt.ndim - (hist.ndim - 1)))
+
+    def tot(x):
+        return jnp.sum(x, axis=axes, dtype=jnp.int32)
+
+    above = [tot(mask & (dt > b)) for b in BOUNDS_STEPS]
+    rows = [tot(mask) - above[0]]
+    rows += [above[i] - above[i + 1] for i in range(len(above) - 1)]
+    rows.append(above[-1])
+    return hist + jnp.stack(rows)
+
+
+def flush_every(n_slots: int) -> int:
+    """Deferred-binning period for per-group kernels (see
+    ``sim/runner`` ``flush_measurements``): a committed cell's pending
+    delta must be binned before the ring can recycle the cell into a
+    NEW commit, which takes at least ``n_slots`` frontier steps — so
+    any period <= n_slots/2 is loss-free with margin."""
+    return max(1, min(16, n_slots // 2))
+
+
+def flush_pending(state):
+    """Bin one group's pending ``m_commit_dt`` plane into its
+    ``m_lat_hist`` and clear it (jnp; runs under the runner's
+    every-K-steps ``lax.cond`` so the N_BUCKETS reduction fan costs
+    1/K of a per-step implementation)."""
+    import jax.numpy as jnp
+    pend = state["m_commit_dt"]
+    hist = hist_update(state["m_lat_hist"], pend, pend > 0)
+    return dict(state, m_lat_hist=hist,
+                m_commit_dt=jnp.zeros_like(pend))
+
+
+# ---- host-side reductions (numpy; run after the scan) -------------------
+
+def to_sparse(counts) -> Dict[str, int]:
+    """Sparse ``{bucket_index: count}`` JSON form of a bucket vector —
+    the ONE definition behind ``capture_lat_hist`` trace meta,
+    ``ReplayResult.lat_hist`` and ``summarize()``'s buckets: capture
+    and replay compare these byte-for-byte, so they must share the
+    construction."""
+    return {str(i): int(c)
+            for i, c in enumerate(np.asarray(counts).reshape(-1)) if c}
+
+
+def bin_steps(dts) -> np.ndarray:
+    """Histogram a flat array of positive step deltas (numpy twin of
+    ``hist_update``; used to fold an end-of-run pending plane)."""
+    out = np.zeros(N_BUCKETS, np.int32)
+    dts = np.asarray(dts).reshape(-1)
+    dts = dts[dts > 0]
+    if dts.size:
+        idx = np.sum(dts[:, None] > np.asarray(BOUNDS_STEPS)[None, :],
+                     axis=1)
+        np.add.at(out, idx, 1)
+    return out
+
+
+def total_hist(state) -> Optional[np.ndarray]:
+    """Whole-state commit-latency bucket vector: the accumulated
+    ``m_lat_hist`` plane (group axis summed out) plus any samples
+    still pending in ``m_commit_dt`` (committed after the last in-scan
+    flush).  Works on the runner's group-major final state and on a
+    single traced group's state; None when uninstrumented."""
+    if not (isinstance(state, dict) and "m_lat_hist" in state):
+        return None
+    h = np.asarray(state["m_lat_hist"]).astype(np.int64)
+    h = h.reshape(-1, N_BUCKETS).sum(axis=0).astype(np.int32)
+    if "m_commit_dt" in state:
+        h = h + bin_steps(state["m_commit_dt"])
+    return h
+
+def _midpoint_steps(i: int) -> float:
+    """Geometric midpoint of bucket ``i`` in steps."""
+    if i == 0:
+        return 1.0
+    if i >= N_BUCKETS - 1:                      # overflow
+        return 2.0 * BOUNDS_STEPS[-1]
+    return math.sqrt(BOUNDS_STEPS[i - 1] * BOUNDS_STEPS[i])
+
+
+def percentile_steps(counts, p: float) -> float:
+    """Nearest-rank percentile of a sim bucket vector, in steps (the
+    same rule as ``registry.Histogram.percentile``, one bucket wide)."""
+    counts = np.asarray(counts).reshape(-1)
+    total = int(counts.sum())
+    if not total:
+        return 0.0
+    rank = max(math.ceil(p / 100.0 * total), 1)
+    acc = 0
+    for i, c in enumerate(counts):
+        acc += int(c)
+        if acc >= rank:
+            return _midpoint_steps(i)
+    return _midpoint_steps(N_BUCKETS - 1)
+
+
+def to_host_snapshot(counts, sum_steps: int,
+                     step_seconds: float = 1.0) -> Dict[str, Any]:
+    """Convert a sim bucket vector to the host registry's histogram
+    snapshot schema (``registry.HIST_SCHEME``), at ``step_seconds``
+    simulated seconds per lock-step round.
+
+    Each sim bucket's count lands in the host bucket holding its
+    geometric midpoint, so the conversion is exact bucket addition up
+    to one (sim) bucket of quantization — the same envelope the host
+    percentiles already carry.  The result merges with live host
+    snapshots via ``registry.merge_snapshots`` and renders through the
+    one registry code path (``pretty``/``Histogram.percentile``).
+    min/max are bucket-bound envelopes (the kernel keeps no exact
+    extrema), clamped to be mutually consistent for empty-adjacent
+    layouts."""
+    import bisect
+
+    from paxi_tpu.metrics.registry import HIST_BOUNDS, HIST_SCHEME
+
+    counts = np.asarray(counts).reshape(-1)
+    assert counts.shape == (N_BUCKETS,), counts.shape
+    n = len(HIST_BOUNDS)
+    host = [0] * (n + 1)
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        v = _midpoint_steps(i) * step_seconds
+        host[min(bisect.bisect_left(HIST_BOUNDS, v), n)] += int(c)
+    total = int(counts.sum())
+    nz = np.nonzero(counts)[0]
+    vmin = vmax = 0.0
+    if nz.size:
+        lo = 0.0 if nz[0] == 0 else float(BOUNDS_STEPS[nz[0] - 1])
+        hi = (float(BOUNDS_STEPS[nz[-1]]) if nz[-1] < N_BUCKETS - 1
+              else 2.0 * BOUNDS_STEPS[-1])
+        vmin, vmax = lo * step_seconds, hi * step_seconds
+    return {
+        "scheme": HIST_SCHEME,
+        "count": total,
+        "sum": float(sum_steps) * step_seconds,
+        "min": vmin,
+        "max": vmax,
+        "buckets": {str(i): c for i, c in enumerate(host) if c},
+    }
+
+
+def summarize(counts, sum_steps: int) -> Dict[str, Any]:
+    """The bench-row form: p50/p99/p999 in lock-step rounds plus the
+    sample count and mean — small enough to embed per artifact row."""
+    counts = np.asarray(counts).reshape(-1)
+    total = int(counts.sum())
+    return {
+        "n": total,
+        "mean_rounds": round(float(sum_steps) / total, 3) if total else 0.0,
+        "p50_rounds": round(percentile_steps(counts, 50), 3),
+        "p99_rounds": round(percentile_steps(counts, 99), 3),
+        "p999_rounds": round(percentile_steps(counts, 99.9), 3),
+        "buckets": to_sparse(counts),
+    }

@@ -296,5 +296,6 @@ def pretty(snap: Dict[str, Any]) -> str:
                 f"p50={h.percentile(50) * 1e3:.3f}ms "
                 f"p95={h.percentile(95) * 1e3:.3f}ms "
                 f"p99={h.percentile(99) * 1e3:.3f}ms "
+                f"p999={h.percentile(99.9) * 1e3:.3f}ms "
                 f"max={h.max * 1e3:.3f}ms")
     return "\n".join(lines) if lines else "(empty)"

@@ -50,7 +50,8 @@ if _shard_map is None:  # pragma: no cover - version-dependent
     from jax.experimental.shard_map import shard_map as _shard_map
 _HAS_VMA = hasattr(jax.lax, "pcast") and hasattr(jax, "typeof")
 
-from paxi_tpu.sim.runner import (_group_step, finish_run, init_carry,
+from paxi_tpu.sim.runner import (_group_step, finish_run,
+                                 flush_measurements, init_carry,
                                  make_scan_body)
 from paxi_tpu.sim.types import FAULT_FREE, FuzzConfig, SimConfig, SimProtocol
 
@@ -174,6 +175,10 @@ def make_sharded_run(proto: SimProtocol, cfg: SimConfig,
                 def body(c, t):
                     c, (viol, counts) = jax.vmap(
                         step1, in_axes=(0, None))(c, t)
+                    # the observability layer's deferred binning: same
+                    # absolute flush steps as the single-device body,
+                    # so sharded runs stay bit-for-bit
+                    c = flush_measurements(proto, cfg, c, t)
                     if real is not None:
                         viol = jnp.where(real, viol, 0)
                         counts = {k: jnp.sum(jnp.where(real, v, 0))
@@ -249,6 +254,7 @@ def make_sharded_pinned_run(proto: SimProtocol, cfg: SimConfig,
                     lambda cg, on: _group_step(proto, cfg, fuzz, cg, t,
                                                sched_t=sched_t, pin_on=on),
                     in_axes=(0, 0))(c, on_local)
+                c = flush_measurements(proto, cfg, c, t)
                 # violations: traced group only (the replay oracle);
                 # counters: whole real batch, like make_pinned_run
                 viol_g = jnp.sum(jnp.where(on_local, viol, 0))

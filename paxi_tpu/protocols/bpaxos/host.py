@@ -514,6 +514,13 @@ SIM_STATE_MAP = {
     "recovered":  "recovered",
     "base":       "",   # ring-window base: the host log is an unbounded dict
     "rec_timer":  "",   # step-timer: host restarts are strike-driven
+    # on-device observability (PR 11) — measurement planes, excluded
+    # from the trace witness hash; the host twins are the registry's
+    # live latency histograms and the post-hoc linearizability checker
+    "m_prop_t":      "",
+    "m_lat_hist":    "",
+    "m_lat_sum":     "",
+    "m_inscan_viol": "",
 }
 
 

@@ -64,7 +64,9 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
+from paxi_tpu.metrics import lathist
 from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim import inscan
 from paxi_tpu.sim.ring import require_packable
 from paxi_tpu.sim.ring import shift_window as _shift
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
@@ -171,6 +173,15 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
         rec_round=jnp.zeros((R, G), i32),     # attempts (ballot rounds)
         rec_timer=jnp.zeros((R, G), i32),
         recovered=jnp.zeros((R, G), i32),     # completed takeovers (metric)
+        # ---- on-device observability (``m_`` planes: excluded from
+        # the witness hash, never read by protocol logic — PXM10x):
+        # per-slot first-propose step at its proxy, the shared log2
+        # commit-latency histogram (metrics/lathist) and the in-scan
+        # linearizability spot-check accumulator (sim/inscan)
+        m_prop_t=jnp.zeros((R, S, G), i32),
+        m_lat_hist=lathist.empty_hist(G),
+        m_lat_sum=jnp.zeros((G,), i32),
+        m_inscan_viol=jnp.zeros((G,), i32),
     )
 
 
@@ -299,6 +310,13 @@ def _step(state, inbox, ctx: StepCtx, *, read_quorum: bool = True):
     newly = (is_proxy[:, None, :] & st["proposed"] & ~committed
              & (rowq >= W_ROWS) & (vcmd != NO_CMD))
     committed = committed | newly
+    # in-kernel commit-latency histogram: propose->commit step delta of
+    # every newly committed (proxy, slot), log2-binned on device
+    m_prop_t = st["m_prop_t"]
+    lat_dt = jnp.clip(ctx.t - m_prop_t, 0, None)
+    m_lat_hist = lathist.hist_update(st["m_lat_hist"], lat_dt, newly)
+    m_lat_sum = st["m_lat_sum"] + jnp.sum(jnp.where(newly, lat_dt, 0),
+                                          axis=(0, 1), dtype=jnp.int32)
 
     rowq_rec = _row_quorums(rec_acks, cfg)
     rec_done = is_proxy & (rec_phase == 2) & (rowq_rec >= W_ROWS)
@@ -350,6 +368,7 @@ def _step(state, inbox, ctx: StepCtx, *, read_quorum: bool = True):
         committed = jnp.where(a2, s_com | my_com, committed)
         proposed = jnp.where(a2, False, proposed)
         p2_acks = jnp.where(a2, 0, p2_acks)
+        m_prop_t = jnp.where(a2, 0, m_prop_t)  # adopted rows: new clocks
         kv = jnp.where(adopt[:, None, :], kv[s][None], kv)
         cum_cmds = jnp.where(adopt, cum_cmds[s][None], cum_cmds)
         execute = jnp.where(adopt, execute[s][None, :], execute)
@@ -435,6 +454,11 @@ def _step(state, inbox, ctx: StepCtx, *, read_quorum: bool = True):
     vcmd = jnp.where(ohw, prop_cmd[:, None, :], vcmd)
     vbsz = jnp.where(ohw, prop_bsz[:, None, :], vbsz)
     vbal = jnp.where(ohw, bal0[:, None, :], vbal)
+    # latency clock: a slot's FIRST propose starts it (go-back-N
+    # reopens keep the original start; recycled cells re-arm via the
+    # slide's 0 fill)
+    m_prop_t = jnp.where(do[:, None, :] & oh_p & ~proposed
+                         & (m_prop_t == 0), ctx.t, m_prop_t)
     proposed = proposed | (do[:, None, :] & oh_p)
     next_slot = next_slot + jnp.where(is_new & do, P, 0)
 
@@ -525,10 +549,23 @@ def _step(state, inbox, ctx: StepCtx, *, read_quorum: bool = True):
     # ------------- slide the ring past the executed prefix --------------
     new_base = jnp.maximum(base, new_execute - RETAIN)
     adv = new_base - base
+    new_committed = _shift(committed, adv, False)
+    new_vcmd = _shift(vcmd, adv, NO_CMD)
+
+    # in-scan linearizability spot-check (sim/inscan): an independent
+    # oracle beside invariants(), accumulated on device per group
+    m_inscan_viol = state["m_inscan_viol"] + inscan.spot_check(
+        state["execute"], new_execute, state["base"], new_base,
+        state["base"][:, None, :] + sidx[None, :, None],
+        new_base[:, None, :] + sidx[None, :, None],
+        state["vcmd"], new_vcmd,
+        state["committed"], new_committed,
+        kv=kv, lane_major=True)
+
     new_state = dict(
         abal=_shift(abal, adv, 0), vbal=_shift(vbal, adv, 0),
-        vcmd=_shift(vcmd, adv, NO_CMD), vbsz=_shift(vbsz, adv, 0),
-        committed=_shift(committed, adv, False),
+        vcmd=new_vcmd, vbsz=_shift(vbsz, adv, 0),
+        committed=new_committed,
         proposed=_shift(proposed, adv, False),
         p2_acks=_shift(p2_acks, adv, 0),
         next_slot=next_slot, base=new_base, execute=new_execute,
@@ -537,6 +574,8 @@ def _step(state, inbox, ctx: StepCtx, *, read_quorum: bool = True):
         rec_acks=rec_acks, rec_vbal=rec_vbal, rec_vcmd=rec_vcmd,
         rec_vbsz=rec_vbsz, rec_round=rec_round, rec_timer=rec_timer,
         recovered=recovered,
+        m_prop_t=_shift(m_prop_t, adv, 0), m_lat_hist=m_lat_hist,
+        m_lat_sum=m_lat_sum, m_inscan_viol=m_inscan_viol,
     )
     outbox = {"p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
               "p2b": out_p2b, "p3": out_p3}
@@ -552,6 +591,9 @@ def metrics(state, cfg: SimConfig):
         "committed_cmds": jnp.sum(jnp.max(state["cum_cmds"], axis=0)),
         "min_execute": jnp.sum(jnp.min(state["execute"], axis=0)),
         "recoveries": jnp.sum(state["recovered"]),
+        "commit_lat_sum": jnp.sum(state["m_lat_sum"]),
+        "commit_lat_n": jnp.sum(state["m_lat_hist"]),
+        "inscan_violations": jnp.sum(state["m_inscan_viol"]),
     }
 
 

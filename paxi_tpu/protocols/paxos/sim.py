@@ -43,8 +43,10 @@ from typing import Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from paxi_tpu.metrics import lathist
 from paxi_tpu.ops.hashing import fib_key
 from paxi_tpu.sim import ballot_ring as br
+from paxi_tpu.sim import inscan
 from paxi_tpu.sim.ballot_ring import NO_CMD, NOOP
 from paxi_tpu.sim.ring import require_packable
 from paxi_tpu.sim.ring import shift_window as _shift
@@ -100,6 +102,26 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
             (jnp.arange(R, dtype=i32) * cfg.election_timeout)[:, None],
             (R, G)),
         stuck=jnp.zeros((R, G), i32),         # frontier-stall counter
+        # ---- on-device observability (PR-10 ``m_`` zone-accounting
+        # template): measurement planes, excluded from the trace
+        # witness hash (trace/replay.state_hash), never read by
+        # protocol logic (PXM10x).  m_prop_t records each slot's FIRST
+        # propose step at its leader; commits bin the propose->commit
+        # step delta into the fixed log2 histogram (metrics/lathist);
+        # m_inscan_viol accumulates the in-scan linearizability
+        # spot-check (sim/inscan).
+        m_prop_t=jnp.zeros((R, S, G), i32),
+        # pending propose->commit deltas: commits store their delta
+        # here (one masked write) and the runner bins them into
+        # m_lat_hist every flush_every(S) steps under a lax.cond
+        # (runner.flush_measurements) — position-free samples, so the
+        # plane is deliberately NOT shifted with the ring (a shift's
+        # fill would drop pending samples); the flush period is
+        # shorter than any cell-reuse cycle
+        m_commit_dt=jnp.zeros((R, S, G), i32),
+        m_lat_hist=lathist.empty_hist(G),
+        m_lat_sum=jnp.zeros((G,), i32),
+        m_inscan_viol=jnp.zeros((G,), i32),
     )
 
 
@@ -113,17 +135,38 @@ def step(state, inbox, ctx: StepCtx):
 
     st = {k: state[k] for k in BR_KEYS}
     kv = state["kv"]
+    # measurement planes (never passed into ballot_ring: the helpers
+    # shift the log planes by base deltas, so m_prop_t is re-aligned
+    # here by the SAME delta after every base-moving call)
+    m_prop_t = state["m_prop_t"]
+    m_lat_hist = state["m_lat_hist"]
+    m_lat_sum = state["m_lat_sum"]
 
     # ---------------- ballot/ring consensus core (shared) ---------------
     st, out_p1b, promote = br.promise_p1a(st, inbox["p1a"])
     st, p1_win, amask = br.tally_p1b(st, inbox["p1b"], MAJ, STRIDE)
+    b0 = st["base"]
     st, ex = br.adopt_best_acker(st, amask, p1_win, {"kv": kv})
     kv = ex["kv"]
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
     st = br.merge_acker_logs(st, amask, p1_win)
+    # a takeover restarts the adopted slots' latency clocks (re-owned
+    # re-proposals measure from the takeover, like the wpaxos kernel)
+    m_prop_t = jnp.where(p1_win[:, None, :] & st["proposed"]
+                         & (m_prop_t == 0), ctx.t, m_prop_t)
     st, out_p2b, acc_ok, _ = br.accept_p2a(st, inbox["p2a"])
     st, newly = br.tally_p2b(st, inbox["p2b"], MAJ, STRIDE)
+    # in-kernel commit latency: every newly committed (leader, slot)
+    # stores its propose->commit step delta in the pending plane; the
+    # runner's deferred flush log2-bins it (see init_state)
+    dt = jnp.clip(ctx.t - m_prop_t, 0, None)
+    m_commit_dt = jnp.where(newly, dt, state["m_commit_dt"])
+    m_lat_sum = m_lat_sum + jnp.sum(jnp.where(newly, dt, 0),
+                                    axis=(0, 1), dtype=jnp.int32)
+    b0 = st["base"]
     st, ex, c_has, c_bal = br.apply_p3(st, inbox["p3"], {"kv": kv})
     kv = ex["kv"]
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
 
     # ---------------- leader proposes (new cmd or re-proposal) ----------
     # the closed-loop client: one fresh command per step, window
@@ -136,6 +179,11 @@ def step(state, inbox, ctx: StepCtx):
     prop_cmd = jnp.where(is_new, encode_cmd(st["ballot"], prop_slot),
                          re_cmd)
     do = is_leader & (has_re | can_new)
+    # latency clock: a slot's FIRST propose starts it (re-proposals and
+    # go-back-N retries keep the original start — honest end-to-end
+    # commit latency; recycled cells re-arm via the shift's 0 fill)
+    m_prop_t = jnp.where(do[:, None, :] & oh_p & ~st["proposed"]
+                         & (m_prop_t == 0), ctx.t, m_prop_t)
     st, out_p2a = br.propose_write(st, do, is_new, prop_cmd, prop_slot,
                                    oh_p)
 
@@ -161,9 +209,23 @@ def step(state, inbox, ctx: StepCtx):
     st = br.retry_stuck(st, new_execute, is_leader, cfg.retry_timeout)
     heard = promote | acc_ok | (c_has & (c_bal >= st["ballot"]))
     st, out_p1a = br.election_tick(st, heard, ctx.rng, cfg)
+    b0 = st["base"]
     st = br.slide_window(st, new_execute, RETAIN)
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
 
-    new_state = dict(st, kv=kv)
+    # in-scan linearizability spot-check (sim/inscan): an independent
+    # oracle beside invariants(), accumulated on device per group
+    m_inscan_viol = state["m_inscan_viol"] + inscan.spot_check(
+        state["execute"], st["execute"], state["base"], st["base"],
+        state["base"][:, None, :] + sidx[None, :, None],
+        st["base"][:, None, :] + sidx[None, :, None],
+        state["log_cmd"], st["log_cmd"],
+        state["log_commit"], st["log_commit"],
+        kv=kv, lane_major=True)
+
+    new_state = dict(st, kv=kv, m_prop_t=m_prop_t,
+                     m_commit_dt=m_commit_dt, m_lat_hist=m_lat_hist,
+                     m_lat_sum=m_lat_sum, m_inscan_viol=m_inscan_viol)
     outbox = {"p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
               "p2b": out_p2b, "p3": out_p3}
     return new_state, outbox
@@ -178,6 +240,15 @@ def metrics(state, cfg: SimConfig):
         "min_execute": jnp.sum(jnp.min(state["execute"], axis=0)),
         "has_leader": jnp.sum(jnp.any(state["active"], axis=0)
                               .astype(jnp.int32)),
+        # on-device observability scalars (the histogram itself rides
+        # in state as m_lat_hist — vectors don't fit the metrics
+        # dict); the sample count includes deltas still pending the
+        # runner's deferred flush
+        "commit_lat_sum": jnp.sum(state["m_lat_sum"]),
+        "commit_lat_n": (jnp.sum(state["m_lat_hist"])
+                         + jnp.sum((state["m_commit_dt"] > 0)
+                                   .astype(jnp.int32))),
+        "inscan_violations": jnp.sum(state["m_inscan_viol"]),
     }
 
 

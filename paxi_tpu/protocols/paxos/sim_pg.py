@@ -64,11 +64,13 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
+from paxi_tpu.metrics import lathist
 from paxi_tpu.ops.hashing import fib_key  # noqa: F401 (re-export parity)
 # one definition of the wire/command encoding for both layouts — a tweak
 # to either must reach the parity test and the bench backend switch
 from paxi_tpu.protocols.paxos.sim import (NO_CMD, NOOP, cmd_key,
                                           encode_cmd, mailbox_spec)
+from paxi_tpu.sim import inscan
 from paxi_tpu.sim.ring import require_packable
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
@@ -102,6 +104,22 @@ def init_state(cfg: SimConfig, rng: jax.Array):
         # replica 0's timer fires at step 0 => immediate first election
         timer=jnp.arange(R, dtype=jnp.int32) * cfg.election_timeout,
         stuck=jnp.zeros((R,), jnp.int32),         # frontier-stall counter
+        # ---- on-device observability (``m_`` planes: excluded from
+        # the witness hash, never read by protocol logic — PXM10x).
+        # Per-group layout: the histogram is (N_BUCKETS,), the
+        # accumulators scalars; the runner's vmap gives them their
+        # group axis.  Same semantics as the lane-major kernel.
+        m_prop_t=jnp.zeros((R, S), jnp.int32),
+        # pending propose->commit deltas: the step stores each newly
+        # committed cell's delta here (one masked write); the RUNNER
+        # bins them into m_lat_hist every flush_every(S) steps under a
+        # batch-level lax.cond (sim/runner.flush_measurements) — the
+        # N_BUCKETS reduction fan is off the per-step hot path, which
+        # is what keeps the 100k-group bench overhead single-digit
+        m_commit_dt=jnp.zeros((R, S), jnp.int32),
+        m_lat_hist=lathist.empty_hist(),
+        m_lat_sum=jnp.zeros((), jnp.int32),
+        m_inscan_viol=jnp.zeros((), jnp.int32),
     )
 
 
@@ -127,6 +145,9 @@ def step(state, inbox, ctx: StepCtx):
     next_slot = state["next_slot"]
     execute = state["execute"]
     kv = state["kv"]
+    m_prop_t = state["m_prop_t"]
+    m_lat_hist = state["m_lat_hist"]
+    m_lat_sum = state["m_lat_sum"]
 
     # ---------------- P1a: promise to the highest proposer --------------
     m = inbox["p1a"]
@@ -176,6 +197,7 @@ def step(state, inbox, ctx: StepCtx):
     log_commit = log_commit & ~drop
     proposed = proposed & ~drop
     log_acks = jnp.where(drop, 0, log_acks)
+    m_prop_t = jnp.where(drop, 0, m_prop_t)
 
     # ---------------- phase-1 win: merge ackers' logs -------------------
     # Fixed cell mapping: leader cell c and acker cell c hold the SAME
@@ -211,6 +233,8 @@ def step(state, inbox, ctx: StepCtx):
     log_acks = jnp.where(w, jnp.where(in_win, bit[:, None], 0), log_acks)
     next_slot = jnp.where(p1_win, new_next, next_slot)
     active = active | p1_win
+    # a takeover restarts the adopted slots' latency clocks
+    m_prop_t = jnp.where(w & proposed & (m_prop_t == 0), ctx.t, m_prop_t)
 
     # ---------------- P2a: accept from the highest-ballot leader --------
     m = inbox["p2a"]
@@ -256,6 +280,15 @@ def step(state, inbox, ctx: StepCtx):
     newly = ((active & own_bal)[:, None] & (acks_n >= MAJ)
              & ~log_commit & (log_cmd != NO_CMD) & proposed)
     log_commit = log_commit | newly
+    # in-kernel commit latency: store every newly committed (leader,
+    # slot)'s propose->commit step delta into the pending plane — the
+    # runner's deferred flush log2-bins it into m_lat_hist (see
+    # init_state); the pending plane survives recycling/adoption
+    # untouched, its flush period is shorter than any cell-reuse cycle
+    lat_dt = jnp.clip(ctx.t - m_prop_t, 0, None)
+    m_commit_dt = jnp.where(newly, lat_dt, state["m_commit_dt"])
+    m_lat_sum = m_lat_sum + jnp.sum(jnp.where(newly, lat_dt, 0),
+                                    dtype=jnp.int32)
 
     # ---------------- P3: commit notifications --------------------------
     # Zombie fences (see sim/ballot_ring.py apply_p3): a higher-ballot
@@ -306,6 +339,7 @@ def step(state, inbox, ctx: StepCtx):
     log_commit = jnp.where(a2, s_com | my_com, log_commit)
     proposed = jnp.where(a2, False, proposed)
     log_acks = jnp.where(a2, 0, log_acks)
+    m_prop_t = jnp.where(a2, 0, m_prop_t)
     kv = jnp.where(a2, kv[c_src], kv)
     execute = jnp.where(adopt, execute[c_src], execute)
     next_slot = jnp.where(adopt, jnp.maximum(next_slot, execute), next_slot)
@@ -329,6 +363,11 @@ def step(state, inbox, ctx: StepCtx):
     oh = do[:, None] & (sidx[None, :] == prop_cell[:, None])
     log_bal = jnp.where(oh, ballot[:, None], log_bal)
     log_cmd = jnp.where(oh & ~log_commit, prop_cmd[:, None], log_cmd)
+    # latency clock: a slot's FIRST propose starts it (re-proposals and
+    # go-back-N retries keep the original start — honest end-to-end
+    # commit latency; recycled cells re-arm via the drop clears)
+    m_prop_t = jnp.where(oh & ~proposed & (m_prop_t == 0),
+                         ctx.t, m_prop_t)
     proposed = proposed | oh
     log_acks = log_acks | jnp.where(oh, bit[:, None], 0)  # self ack
     next_slot = next_slot + (is_new & do)
@@ -416,12 +455,25 @@ def step(state, inbox, ctx: StepCtx):
     log_commit = log_commit & ~drop
     proposed = proposed & ~drop
     log_acks = jnp.where(drop, 0, log_acks)
+    m_prop_t = jnp.where(drop, 0, m_prop_t)
+
+    # in-scan linearizability spot-check (sim/inscan): an independent
+    # oracle beside invariants(), accumulated on device
+    m_inscan_viol = state["m_inscan_viol"] + inscan.spot_check(
+        state["execute"], new_execute, state["base"], new_base,
+        _cell_abs(state["base"], S), _cell_abs(new_base, S),
+        state["log_cmd"], log_cmd,
+        state["log_commit"], log_commit,
+        kv=kv, lane_major=False)
 
     new_state = dict(
         ballot=ballot, active=active, p1_acks=p1_acks, base=new_base,
         log_bal=log_bal, log_cmd=log_cmd, log_commit=log_commit,
         log_acks=log_acks, proposed=proposed, next_slot=next_slot,
         execute=new_execute, kv=kv, timer=timer, stuck=stuck,
+        m_prop_t=m_prop_t, m_commit_dt=m_commit_dt,
+        m_lat_hist=m_lat_hist, m_lat_sum=m_lat_sum,
+        m_inscan_viol=m_inscan_viol,
     )
     outbox = {"p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
               "p2b": out_p2b, "p3": out_p3}
@@ -435,6 +487,15 @@ def metrics(state, cfg: SimConfig):
         "committed_slots": jnp.max(state["execute"]),
         "min_execute": jnp.min(state["execute"]),
         "has_leader": jnp.any(state["active"]).astype(jnp.int32),
+        # observability scalars (the histogram itself rides in state
+        # as m_lat_hist; a vector would not survive the per-group
+        # metric summation).  The sample count includes deltas still
+        # pending the runner's deferred flush.
+        "commit_lat_sum": state["m_lat_sum"],
+        "commit_lat_n": (jnp.sum(state["m_lat_hist"])
+                         + jnp.sum((state["m_commit_dt"] > 0)
+                                   .astype(jnp.int32))),
+        "inscan_violations": state["m_inscan_viol"],
     }
 
 

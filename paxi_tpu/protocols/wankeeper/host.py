@@ -556,4 +556,9 @@ SIM_STATE_MAP = {
     "m_lat_local_n":   "",
     "m_lat_cross_sum": "",
     "m_lat_cross_n":   "",
+    # on-device commit-latency histogram + in-scan spot-check (PR 11)
+    "m_prop_t":        "",
+    "m_lat_hist":      "",
+    "m_lat_sum":       "",
+    "m_inscan_viol":   "",
 }

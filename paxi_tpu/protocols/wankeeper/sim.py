@@ -58,7 +58,9 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
+from paxi_tpu.metrics import lathist
 from paxi_tpu.sim import ballot_ring as br
+from paxi_tpu.sim import inscan
 from paxi_tpu.sim.ballot_ring import NO_CMD
 from paxi_tpu.sim.ring import dst_major
 from paxi_tpu.sim.ring import require_packable
@@ -177,6 +179,12 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
         m_lat_local_n=jnp.zeros((G,), i32),
         m_lat_cross_sum=jnp.zeros((G,), i32),
         m_lat_cross_n=jnp.zeros((G,), i32),
+        # root-log commit-latency histogram + in-scan spot-check
+        # (PR-11 layer; shared bucket layout — metrics/lathist)
+        m_prop_t=jnp.zeros((R, S, G), i32),
+        m_lat_hist=lathist.empty_hist(G),
+        m_lat_sum=jnp.zeros((G,), i32),
+        m_inscan_viol=jnp.zeros((G,), i32),
     )
 
 
@@ -255,6 +263,9 @@ def step(state, inbox, ctx: StepCtx, gver_floor: bool = True):
     # (write -> zone-majority commit; sampled before this step's bump)
     m_wr_t, m_wr_p = state["m_wr_t"], state["m_wr_p"]
     m_acq_t, m_acq_p = state["m_acq_t"], state["m_acq_p"]
+    m_prop_t = state["m_prop_t"]
+    m_lat_hist = state["m_lat_hist"]
+    m_lat_sum = state["m_lat_sum"]
     m_lat_local_sum = state["m_lat_local_sum"]
     m_lat_local_n = state["m_lat_local_n"]
     m_lat_cross_sum = state["m_lat_cross_sum"]
@@ -277,23 +288,38 @@ def step(state, inbox, ctx: StepCtx, gver_floor: bool = True):
     extras = {"token_zone": token_zone, "prev_zone": prev_zone,
               "ver": ver, "want": want, "relv": relv, "pend": pend,
               "pgen": pgen, "rgen": rgen, "gver": gver}
+    b0 = st["base"]
     st, ex = br.adopt_best_acker(st, amask, p1_win, extras)
     token_zone, prev_zone, want, relv, pend, pgen, rgen = (
         ex["token_zone"], ex["prev_zone"], ex["want"], ex["relv"],
         ex["pend"], ex["pgen"], ex["rgen"])
     ver = jnp.maximum(ver, ex["ver"])
     gver = jnp.maximum(gver, ex["gver"])
+    # measurement plane re-alignment: ballot_ring shifts the log planes
+    # by the base delta; m_prop_t (never passed in) follows suit
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
     st = br.merge_acker_logs(st, amask, p1_win)
+    # a takeover restarts the adopted slots' latency clocks
+    m_prop_t = jnp.where(p1_win[:, None, :] & st["proposed"]
+                         & (m_prop_t == 0), ctx.t, m_prop_t)
     # a fresh root starts with a clean proposal-dedup slate: a stale
     # adopted pend (for a revoke the merge lost) would block the object
     # forever, while a duplicate revoke is an idempotent no-op
     pend = jnp.where(p1_win[:, None, :], False, pend)
     st, out_p2b, acc_ok, _ = br.accept_p2a(st, inbox["p2a"])
     st, newly = br.tally_p2b(st, inbox["p2b"], MAJ, STRIDE)
+    # in-kernel commit-latency histogram: propose->commit step delta of
+    # every newly committed root-log (leader, slot)
+    rdt = jnp.clip(ctx.t - m_prop_t, 0, None)
+    m_lat_hist = lathist.hist_update(m_lat_hist, rdt, newly)
+    m_lat_sum = m_lat_sum + jnp.sum(jnp.where(newly, rdt, 0),
+                                    axis=(0, 1), dtype=jnp.int32)
     extras = {"token_zone": token_zone, "prev_zone": prev_zone,
               "ver": ver, "want": want, "relv": relv, "pend": pend,
               "pgen": pgen, "rgen": rgen, "gver": gver}
+    b0 = st["base"]
     st, ex, c_has, c_bal = br.apply_p3(st, inbox["p3"], extras)
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
     token_zone, prev_zone, want, relv, pend, pgen, rgen = (
         ex["token_zone"], ex["prev_zone"], ex["want"], ex["relv"],
         ex["pend"], ex["pgen"], ex["rgen"])
@@ -344,6 +370,10 @@ def step(state, inbox, ctx: StepCtx, gver_floor: bool = True):
     is_new = ~has_re & can_new & (any_g | any_r)
     prop_cmd = jnp.where(is_new, new_cmd, re_cmd)
     do = is_root & (has_re | is_new)
+    # latency clock: a slot's FIRST propose starts it (retries keep
+    # the original start; recycled cells re-arm via the shifts' 0 fill)
+    m_prop_t = jnp.where(do[:, None, :] & oh_p & ~st["proposed"]
+                         & (m_prop_t == 0), ctx.t, m_prop_t)
     st, out_p2a = br.propose_write(st, do, is_new, prop_cmd, prop_slot,
                                    oh_p)
     # soft bookkeeping for the entry just proposed (revoke-dedup and
@@ -521,7 +551,20 @@ def step(state, inbox, ctx: StepCtx, gver_floor: bool = True):
     st = br.retry_stuck(st, new_execute, is_root, cfg.retry_timeout)
     heard = promote | acc_ok | (c_has & (c_bal >= st["ballot"]))
     st, out_p1a = br.election_tick(st, heard, ctx.rng, cfg)
+    b0 = st["base"]
     st = br.slide_window(st, new_execute, RETAIN)
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
+
+    # in-scan linearizability spot-check over the root log (sim/inscan;
+    # no register plane — WanKeeper's ver/gver tables are zone-local
+    # views, not a function of the root frontier alone)
+    m_inscan_viol = state["m_inscan_viol"] + inscan.spot_check(
+        state["execute"], st["execute"], state["base"], st["base"],
+        state["base"][:, None, :] + sidx[None, :, None],
+        st["base"][:, None, :] + sidx[None, :, None],
+        state["log_cmd"], st["log_cmd"],
+        state["log_commit"], st["log_commit"],
+        kv=None, lane_major=True)
 
     new_state = dict(
         st, token_zone=token_zone, prev_zone=prev_zone, ver=ver,
@@ -530,7 +573,9 @@ def step(state, inbox, ctx: StepCtx, gver_floor: bool = True):
         transfers=transfers,
         m_wr_t=m_wr_t, m_wr_p=m_wr_p, m_acq_t=m_acq_t, m_acq_p=m_acq_p,
         m_lat_local_sum=m_lat_local_sum, m_lat_local_n=m_lat_local_n,
-        m_lat_cross_sum=m_lat_cross_sum, m_lat_cross_n=m_lat_cross_n)
+        m_lat_cross_sum=m_lat_cross_sum, m_lat_cross_n=m_lat_cross_n,
+        m_prop_t=m_prop_t, m_lat_hist=m_lat_hist, m_lat_sum=m_lat_sum,
+        m_inscan_viol=m_inscan_viol)
     outbox = {"zrep": out_zrep, "zack": out_zack, "treq": out_treq,
               "rel": out_rel, "p1a": out_p1a, "p1b": out_p1b,
               "p2a": out_p2a, "p2b": out_p2b, "p3": out_p3}
@@ -551,6 +596,9 @@ def metrics(state, cfg: SimConfig):
         "commit_lat_local_n": jnp.sum(state["m_lat_local_n"]),
         "commit_lat_cross_sum": jnp.sum(state["m_lat_cross_sum"]),
         "commit_lat_cross_n": jnp.sum(state["m_lat_cross_n"]),
+        "commit_lat_sum": jnp.sum(state["m_lat_sum"]),
+        "commit_lat_n": jnp.sum(state["m_lat_hist"]),
+        "inscan_violations": jnp.sum(state["m_inscan_viol"]),
     }
 
 

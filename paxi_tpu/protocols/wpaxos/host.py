@@ -438,4 +438,10 @@ SIM_STATE_MAP = {
     "m_lat_local_n":   "",
     "m_lat_cross_sum": "",
     "m_lat_cross_n":   "",
+    # on-device commit-latency histogram + in-scan spot-check (PR 11)
+    # — the host-side twin is the registry's live latency histograms
+    # and the post-hoc linearizability checker, not node state
+    "m_lat_hist":      "",
+    "m_lat_sum":       "",
+    "m_inscan_viol":   "",
 }

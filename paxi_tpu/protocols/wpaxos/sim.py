@@ -50,6 +50,8 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
+from paxi_tpu.metrics import lathist
+from paxi_tpu.sim import inscan
 from paxi_tpu.sim.ring import (dst_major, require_packable,
                                shift_window)
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
@@ -129,6 +131,11 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
         m_lat_local_n=jnp.zeros((G,), i32),
         m_lat_cross_sum=jnp.zeros((G,), i32),
         m_lat_cross_n=jnp.zeros((G,), i32),
+        # commit-latency histogram + in-scan spot-check (PR-11 layer;
+        # same bucket layout as every kernel — metrics/lathist)
+        m_lat_hist=lathist.empty_hist(G),
+        m_lat_sum=jnp.zeros((G,), i32),
+        m_inscan_viol=jnp.zeros((G,), i32),
     )
 
 
@@ -409,6 +416,12 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
     m_lat_cross_sum = m_lat_cross_sum + jnp.sum(
         jnp.where(cross, dt, 0), axis=(0, 1, 2))
     m_lat_cross_n = m_lat_cross_n + jnp.sum(cross, axis=(0, 1, 2))
+    # the distribution-shaped twin of the local/cross mean split: every
+    # newly committed (owner, object, slot) bins its propose->commit
+    # delta into the shared log2 histogram (metrics/lathist)
+    m_lat_hist = lathist.hist_update(state["m_lat_hist"], dt, newly)
+    m_lat_sum = state["m_lat_sum"] + jnp.sum(
+        jnp.where(newly, dt, 0), axis=(0, 1, 2), dtype=jnp.int32)
 
     # ---------------- P3: commit notifications --------------------------
     # Zombie fences (see sim/ballot_ring.py apply_p3): a higher-ballot
@@ -611,6 +624,17 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
     log_acks = shift_window(log_acks, adv, 0)
     m_prop_t = shift_window(m_prop_t, adv, 0)
 
+    # in-scan linearizability spot-check (sim/inscan), per (replica,
+    # object) lane over the per-object rings
+    sidx4 = sidx[None, None, :, None]
+    m_inscan_viol = state["m_inscan_viol"] + inscan.spot_check(
+        state["execute"], new_execute, state["base"], new_base,
+        state["base"][:, :, None, :] + sidx4,
+        new_base[:, :, None, :] + sidx4,
+        state["log_cmd"], log_cmd,
+        state["log_commit"], log_commit,
+        kv=kv, lane_major=True)
+
     new_state = dict(
         ballot=ballot, active=active, log_bal=log_bal, log_cmd=log_cmd,
         log_commit=log_commit, log_acks=log_acks, proposed=proposed,
@@ -619,7 +643,8 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
         steal_timer=steal_timer, steals=steals,
         m_prop_t=m_prop_t, m_lat_local_sum=m_lat_local_sum,
         m_lat_local_n=m_lat_local_n, m_lat_cross_sum=m_lat_cross_sum,
-        m_lat_cross_n=m_lat_cross_n,
+        m_lat_cross_n=m_lat_cross_n, m_lat_hist=m_lat_hist,
+        m_lat_sum=m_lat_sum, m_inscan_viol=m_inscan_viol,
     )
     outbox = {"p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
               "p2b": out_p2b, "p3": out_p3}
@@ -637,6 +662,9 @@ def metrics(state, cfg: SimConfig):
         "commit_lat_local_n": jnp.sum(state["m_lat_local_n"]),
         "commit_lat_cross_sum": jnp.sum(state["m_lat_cross_sum"]),
         "commit_lat_cross_n": jnp.sum(state["m_lat_cross_n"]),
+        "commit_lat_sum": jnp.sum(state["m_lat_sum"]),
+        "commit_lat_n": jnp.sum(state["m_lat_hist"]),
+        "inscan_violations": jnp.sum(state["m_inscan_viol"]),
     }
 
 

@@ -1,0 +1,103 @@
+"""In-scan linearizability spot-checker (the vectorized lincheck slice).
+
+``sim/lincheck.py``'s stale/future-read oracle and the host precedence
+checker run post-hoc over materialized op histories — they cannot keep
+up with the 100k-group lane-major kernels, so every bench number above
+the post-hoc scale was trusted on counters alone.  This module is the
+slice of those invariants that CAN run inside the scan body at full
+speed, as pure elementwise reductions over the ring-log planes every
+instrumented kernel already carries:
+
+1. **Monotone commit frontier** — ``execute`` and ``base`` never
+   regress per lane.  (Deliberately NOT in the protocol oracles:
+   ``proto.invariants`` checks ``execute >= base``, not monotonicity.)
+2. **Committed-value stability, same-cell** — a committed cell whose
+   absolute slot is unchanged between steps must keep its commit bit
+   and value.  (Cells recycled by a window slide are covered by the
+   protocol oracle's shifted check; this is the alignment-free spot
+   version that costs no gathers.)
+3. **Per-slot agreement across lanes** — committed cells holding the
+   SAME absolute slot at different replicas must hold the same value;
+   checked on the cells aligned with the most-advanced replica's frame
+   (``abs == max_r abs``), which is every cell in the steady state.
+4. **Register condition** (the lincheck projection): two replicas with
+   the same execute frontier have executed the same committed prefix,
+   so their state-machine registers must be bitwise equal — the
+   "a read must see the latest completed write" condition, evaluated
+   on the materialized registers instead of an op history.
+
+All checks are elementwise / small-pair reductions — no per-step
+gathers — so the spot-checker rides inside the 100k-group scan with
+single-digit-percent overhead.  Results accumulate into each kernel's
+``m_inscan_viol`` measurement plane (excluded from the witness hash,
+surfaced as the ``inscan_violations`` metric): an independent oracle
+beside ``proto.invariants``, not a replacement.
+
+Layout conventions: lane axis 0 = replicas, slot axis = -2 (lane-major,
+trailing group axis) or -1 (per-group kernels).  Callers pass the
+ABSOLUTE-slot plane (``abs_``) for their cell layout — ``base + sidx``
+for ring-position kernels, ``_cell_abs`` for fixed-cell — which is
+what makes one implementation serve both.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def spot_check(old_exec, new_exec, old_base, new_base,
+               old_abs, new_abs, old_cmd, new_cmd,
+               old_commit, new_commit,
+               kv: Optional[jnp.ndarray] = None, *,
+               lane_major: bool):
+    """One step's spot-check violation count.
+
+    Shapes: ``*_exec``/``*_base`` are ``(R, ..., G?)`` lane planes,
+    ``*_abs``/``*_cmd``/``*_commit`` add a slot axis before the
+    (optional, lane-major) trailing group axis.  ``kv``, when given,
+    is the register plane for check 4 — either shaped like ``new_exec``
+    (one register per frontier, e.g. wpaxos objects) or with one extra
+    value axis at position 1 (e.g. the (R, K, G) KV stores).  Returns
+    int32 counts: ``(G,)`` lane-major, scalar otherwise.
+    """
+    def red(x):
+        if lane_major:
+            return jnp.sum(x, axis=tuple(range(x.ndim - 1)),
+                           dtype=jnp.int32)
+        return jnp.sum(x, dtype=jnp.int32)
+
+    # 1. monotone commit frontier
+    v = red(new_exec < old_exec) + red(new_base < old_base)
+
+    # 2. same-cell committed-value stability
+    v = v + red(old_commit & (old_abs == new_abs)
+                & (~new_commit | (new_cmd != old_cmd)))
+
+    # 3. per-slot agreement on the most-advanced replica's frame.
+    # Sentinels are the full int32 extremes: encode_cmd can legally
+    # reach 0x7FFFFFFF once ballots pass 0x4000, so a 2^30-style
+    # sentinel would read as a disagreeing lane on a safe run
+    # (committed values are NOOP(-2)/NO_CMD(-1)/non-negative ids, so
+    # iinfo.min can never collide with a real value, and an iinfo.max
+    # value agrees with the mn fill exactly when all lanes hold it)
+    vis = new_commit & (new_abs == jnp.max(new_abs, axis=0,
+                                           keepdims=True))
+    info = jnp.iinfo(jnp.int32)
+    mx = jnp.max(jnp.where(vis, new_cmd, info.min), axis=0)
+    mn = jnp.min(jnp.where(vis, new_cmd, info.max), axis=0)
+    v = v + red(jnp.any(vis, axis=0) & (mx != mn))
+
+    # 4. register condition: equal frontier => equal registers
+    if kv is not None:
+        R = new_exec.shape[0]
+        eq = new_exec[:, None] == new_exec[None, :]       # (R, R, ...)
+        if kv.ndim == new_exec.ndim + 1:
+            diff = jnp.any(kv[:, None] != kv[None, :], axis=2)
+        else:
+            diff = kv[:, None] != kv[None, :]
+        pair = (jnp.arange(R)[:, None] < jnp.arange(R)[None, :]).reshape(
+            (R, R) + (1,) * (eq.ndim - 2))
+        v = v + red(eq & diff & pair)
+    return v

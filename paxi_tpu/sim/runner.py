@@ -50,6 +50,51 @@ class SimResult:
         (see paxi_tpu/metrics/simcount.py), prefix stripped."""
         return counters_of(self.metrics)
 
+    # ---- on-device observability (instrumented kernels only) ---------
+    # The commit-latency histogram rides in state as the ``m_lat_hist``
+    # measurement plane ((G, N_BUCKETS) group-major here) because the
+    # metrics dict is scalar-valued by contract; these views fold it
+    # over groups.  ``None``/absent on kernels without the planes.
+    @property
+    def latency_hist(self):
+        """Whole-batch commit-latency bucket vector ((N_BUCKETS,)
+        int32 numpy, metrics/lathist layout; any deltas still pending
+        the deferred flush are folded in), or None."""
+        from paxi_tpu.metrics import lathist
+        return lathist.total_hist(self.state)
+
+    @property
+    def inscan_violations(self) -> Optional[int]:
+        """Total in-scan linearizability spot-check violations
+        (sim/inscan), or None when the kernel is uninstrumented."""
+        v = self.metrics.get("inscan_violations")
+        return None if v is None else int(v)
+
+    def latency_summary(self) -> Optional[Dict[str, Any]]:
+        """The bench-row form: p50/p99/p999 in lock-step rounds plus
+        sample count, mean and sparse buckets (lathist.summarize)."""
+        from paxi_tpu.metrics import lathist
+        hist = self.latency_hist
+        if hist is None:
+            return None
+        return lathist.summarize(hist,
+                                 int(self.metrics.get("commit_lat_sum", 0)))
+
+    def latency_snapshot(self, step_seconds: float = 1.0,
+                         name: str = "paxi_sim_commit_latency_seconds",
+                         **labels: str) -> Optional[Dict[str, Any]]:
+        """Host-registry-format histogram snapshot (merges and renders
+        through metrics/registry's one code path); None when
+        uninstrumented."""
+        from paxi_tpu.metrics import lathist
+        hist = self.latency_hist
+        if hist is None:
+            return None
+        snap = lathist.to_host_snapshot(
+            hist, int(self.metrics.get("commit_lat_sum", 0)),
+            step_seconds=step_seconds)
+        return {"name": name, "labels": dict(labels), **snap}
+
 
 def init_carry(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
                n_groups: int, rng: jax.Array):
@@ -164,6 +209,38 @@ def _group_step(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
     return (new_state, wheel, fs, rng), (viol, counts)
 
 
+def flush_measurements(proto: SimProtocol, cfg: SimConfig, carry, t):
+    """Deferred commit-latency binning for per-group kernels (the
+    observability layer, metrics/lathist).
+
+    An instrumented per-group kernel stores each newly committed
+    cell's propose->commit delta in an ``m_commit_dt`` pending plane
+    (one masked write on the hot path) instead of binning per step;
+    this hook — called by EVERY scan body that vmaps a per-group step
+    (make_run / record / pinned / the sharded twins, so all runners
+    bin at identical steps and capture/replay determinism holds) —
+    runs the N_BUCKETS reduction fan only every ``flush_every(S)``
+    steps, under a batch-level ``lax.cond`` (a real dynamic branch:
+    the predicate is group-independent, so it sits OUTSIDE the vmap
+    where cond does not degrade to select).  End-of-run residuals are
+    folded on host by ``lathist.total_hist``.  No-op for kernels
+    without the plane; lane-major kernels with one flush directly
+    (their group axis is a trailing array dim, no vmap involved)."""
+    state = carry[0]
+    if not (isinstance(state, dict) and "m_commit_dt" in state):
+        return carry
+    from paxi_tpu.metrics import lathist
+    every = lathist.flush_every(cfg.n_slots)
+
+    def do(s):
+        if proto.batched:
+            return lathist.flush_pending(s)
+        return jax.vmap(lathist.flush_pending)(s)
+
+    state = jax.lax.cond((t + 1) % every == 0, do, lambda s: s, state)
+    return (state,) + tuple(carry[1:])
+
+
 def per_group_invariants(proto: SimProtocol, cfg: SimConfig, old, new):
     """Per-group invariant violations for a lane-major kernel.  Batched
     ``invariants`` return already-aggregated scalars and index arrays
@@ -193,10 +270,15 @@ def make_scan_body(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
     step1 = functools.partial(_group_step, proto, cfg, fuzz,
                               exchange=exchange)
     if proto.batched:
-        return step1
+        def bbody(carry, t):
+            carry, ys = step1(carry, t)
+            return flush_measurements(proto, cfg, carry, t), ys
+
+        return bbody
 
     def body(carry, t):
         carry, (viol, counts) = jax.vmap(step1, in_axes=(0, None))(carry, t)
+        carry = flush_measurements(proto, cfg, carry, t)
         return carry, (jnp.sum(viol),
                        {k: jnp.sum(v) for k, v in counts.items()})
 
@@ -280,11 +362,14 @@ def make_recorded_run(proto: SimProtocol, cfg: SimConfig,
     the normal run consumed."""
     step1 = functools.partial(_group_step, proto, cfg, fuzz, record=True)
     if proto.batched:
-        body = step1
+        def body(carry, t):
+            carry, ys = step1(carry, t)
+            return flush_measurements(proto, cfg, carry, t), ys
     else:
         def body(carry, t):
             carry, (viol, counts, sched) = jax.vmap(
                 step1, in_axes=(0, None))(carry, t)
+            carry = flush_measurements(proto, cfg, carry, t)
             return carry, (viol,
                            {k: jnp.sum(v) for k, v in counts.items()},
                            sched)
@@ -323,12 +408,14 @@ def make_pinned_run(proto: SimProtocol, cfg: SimConfig,
                                              sched_t=sched_t, pin_on=group)
             viol_g = proto.invariants(jax.tree.map(sl, old_state),
                                       jax.tree.map(sl, carry[0]), cfg)
+            carry = flush_measurements(proto, cfg, carry, t)
             return carry, (viol_g, counts)
         gidx = jnp.arange(jax.tree_util.tree_leaves(old_state)[0].shape[0])
         carry, (viol, counts) = jax.vmap(
             lambda cg, on: _group_step(proto, cfg, fuzz, cg, t,
                                        sched_t=sched_t, pin_on=on),
             in_axes=(0, 0))(carry, gidx == group)
+        carry = flush_measurements(proto, cfg, carry, t)
         return carry, (viol[group],
                        {k: jnp.sum(v) for k, v in counts.items()})
 

@@ -79,6 +79,17 @@ def capture(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
     gviols = viols[:, g]
     nz = np.nonzero(gviols)[0]
     from paxi_tpu.metrics.simcount import counters_of
+    extra = {}
+    from paxi_tpu.metrics import lathist
+    ghist = lathist.total_hist(gstate)
+    if ghist is not None:
+        # the traced group's on-device commit-latency histogram
+        # (pending deltas folded), stamped like capture_counters:
+        # excluded from the witness hash (it is an ``m_`` plane) but
+        # pinned by replay tests — measurement determinism alongside
+        # state/counter determinism.  Sparse {bucket: count},
+        # metrics/lathist layout.
+        extra["capture_lat_hist"] = lathist.to_sparse(ghist)
     meta = make_meta(
         proto_name or proto.name, cfg, fuzz, seed, n_groups, g,
         group_violations=int(gviols.sum()),
@@ -89,7 +100,7 @@ def capture(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
         # half of the determinism check (metrics/simcount.py)
         capture_counters={k: int(v)
                           for k, v in counters_of(metrics).items()},
-        shrunk=False)
+        shrunk=False, **extra)
     t = Trace(meta=meta, sched=gsched)
     # dedup identity (hunt corpus): stamped here so the in-memory trace
     # and its saved form carry identical meta

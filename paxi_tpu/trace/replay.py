@@ -38,6 +38,12 @@ class ReplayResult:
     viol_steps: np.ndarray    # per-step violation counts, shape (T,)
     state_hash: str           # fingerprint of the group's final state
     metrics: Dict[str, int]   # whole-batch metrics (context, not oracle)
+    # the traced group's on-device commit-latency histogram (sparse
+    # {bucket: count}, metrics/lathist layout) — None for kernels
+    # without the ``m_lat_hist`` plane.  An unedited capture's replay
+    # must reproduce the trace's ``capture_lat_hist`` meta exactly
+    # (measurement determinism; the plane is excluded from state_hash)
+    lat_hist: Optional[Dict[str, int]] = None
 
     @property
     def violated(self) -> bool:
@@ -133,11 +139,15 @@ def replay(trace: Trace, proto: Optional[SimProtocol] = None,
         jr.PRNGKey(trace.seed), trace.n_groups, sched)
     jax.block_until_ready(total)
     gstate = jax.tree.map(lambda x: x[trace.group], state)
+    from paxi_tpu.metrics import lathist
+    ghist = lathist.total_hist(gstate)
+    lat_hist = None if ghist is None else lathist.to_sparse(ghist)
     return ReplayResult(
         violations=int(total),
         viol_steps=np.asarray(viols).reshape(-1),
         state_hash=state_hash(gstate),
-        metrics={k: int(v) for k, v in metrics.items()})
+        metrics={k: int(v) for k, v in metrics.items()},
+        lat_hist=lat_hist)
 
 
 def check_determinism(trace: Trace,

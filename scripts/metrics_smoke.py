@@ -2,7 +2,12 @@
 against a 3-node chan-transport paxos cluster, then assert the node's
 ``GET /metrics`` scrape parses as Prometheus text and is non-empty
 (message counters + at least one latency histogram), and that the JSON
-variant carries the same registry.  Exit nonzero on any miss."""
+variant carries the same registry.  A second section runs a tiny sim
+and asserts the on-device observability schema: a nonzero in-kernel
+commit-latency sample count, a clean in-scan linearizability verdict,
+and a sim histogram snapshot that bucket-merges with the live host
+scrape through the one registry code path.  Exit nonzero on any
+miss."""
 
 from __future__ import annotations
 
@@ -59,10 +64,40 @@ async def main() -> int:
         assert snap["histograms"], "JSON snapshot has no histograms"
 
         log.metrics_dump(bench.metrics, header="bench")
+
+        # ---- sim section: on-device observability schema -------------
+        # (tiny shape; compiles in seconds on CPU)
+        from paxi_tpu.metrics import merge_snapshots, pretty
+        from paxi_tpu.metrics.lathist import N_BUCKETS
+        from paxi_tpu.metrics.registry import HIST_SCHEME
+        from paxi_tpu.protocols import sim_protocol
+        from paxi_tpu.sim import SimConfig, simulate
+        res = simulate(sim_protocol("paxos_pg"),
+                       SimConfig(n_replicas=3, n_slots=16), 8, 60)
+        hist = res.latency_hist
+        assert hist is not None and hist.shape == (N_BUCKETS,), hist
+        assert int(hist.sum()) > 0, "no commit-latency samples"
+        assert res.inscan_violations == 0, res.inscan_violations
+        lat = res.latency_summary()
+        assert lat["n"] == int(hist.sum()) and lat["p50_rounds"] > 0, lat
+        sim_snap = res.latency_snapshot(source="sim")
+        assert sim_snap["scheme"] == HIST_SCHEME, sim_snap["scheme"]
+        assert sim_snap["count"] == lat["n"], sim_snap
+        # one code path: the sim snapshot merges with the live host
+        # registry scrape and renders through registry.pretty
+        merged = merge_snapshots([snap, {"histograms": [sim_snap]}])
+        assert any(h["name"] == "paxi_sim_commit_latency_seconds"
+                   for h in merged["histograms"]), merged["histograms"]
+        assert "paxi_sim_commit_latency_seconds" in pretty(merged)
+
         print(json.dumps({"ok": True, "ops": stats.ops,
                           "scrape_samples": len(samples),
                           "throughput_ops_s":
-                          stats.summary()["throughput_ops_s"]}))
+                          stats.summary()["throughput_ops_s"],
+                          "sim_commit_lat_n": lat["n"],
+                          "sim_lat_p50_rounds": lat["p50_rounds"],
+                          "sim_inscan_violations":
+                          res.inscan_violations}))
         return 0
     finally:
         await c.stop()

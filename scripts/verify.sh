@@ -88,14 +88,24 @@ import json, os
 r = json.loads(os.environ["BENCH_LINE"])
 required = ("metric", "value", "unit", "committed_slots", "wall_s",
             "compile_s", "warmup_s", "invariant_violations", "groups",
-            "steps", "kernel", "mesh", "device")
+            "steps", "kernel", "mesh", "device",
+            "inscan_violations", "commit_latency", "sim_metrics")
 missing = [k for k in required if k not in r]
 assert not missing, f"bench artifact missing keys: {missing}"
 assert r["committed_slots"] > 0, r
 assert r["invariant_violations"] == 0, r
+assert r["inscan_violations"] == 0, r["inscan_violations"]
+lat = r["commit_latency"]
+assert lat["n"] > 0 and lat["p50_rounds"] > 0, lat
+assert r["latency_p99_rounds"] >= r["latency_p50_rounds"], lat
+hs = r["sim_metrics"]["histograms"][0]
+assert hs["scheme"].startswith("log6:"), hs
+assert hs["count"] == lat["n"], (hs["count"], lat["n"])
 assert r["mesh"] == 8, r
 print(f"bench smoke OK: {r['committed_slots']} slots in "
-      f"{r['wall_s']}s on mesh={r['mesh']}")
+      f"{r['wall_s']}s on mesh={r['mesh']}, lat p50="
+      f"{lat['p50_rounds']} p99={lat['p99_rounds']} rounds "
+      f"({lat['n']} samples), inscan_violations=0")
 PYEOF
     echo "== bench smoke (bpaxos compartmentalized grid) =="
     # the 11th protocol's bench_all config at a toy shape: grid-quorum
@@ -109,9 +119,12 @@ res = simulate(sim_protocol("bpaxos"),
 slots = int(res.metrics["committed_slots"])
 cmds = int(res.metrics["committed_cmds"])
 assert int(res.violations) == 0, int(res.violations)
+assert res.inscan_violations == 0, res.inscan_violations
+assert int(res.latency_hist.sum()) > 0, "no commit-latency samples"
 assert slots > 0 and cmds > slots, (slots, cmds)
 print(f"bpaxos bench smoke OK: {slots} slots / {cmds} cmds "
-      f"({cmds / slots:.2f}x amortization), violations=0")
+      f"({cmds / slots:.2f}x amortization), violations=0, "
+      f"inscan_violations=0, lat samples={int(res.latency_hist.sum())}")
 PYEOF
   elif [ "$1" = "--hunt" ]; then
     shift
@@ -161,7 +174,8 @@ assert r["checked_files"] > 0, r["checked_files"]
 for v in r["violations"] + r["suppressed"]:
     for k in ("rule", "code", "path", "line", "col", "message"):
         assert k in v, (k, v)
-known = ("PXK", "PXH", "PXT", "PXC", "PXQ", "PXB", "PXS", "PXF", "PXA")
+known = ("PXK", "PXH", "PXT", "PXC", "PXQ", "PXB", "PXS", "PXF", "PXA",
+         "PXM")
 for s in r["suppressed"]:
     assert s["code"].startswith(known), s["code"]
     assert s.get("suppressed_by"), s

@@ -1,0 +1,44 @@
+"""Seeded violations for the measurement-isolation family (PXM10x).
+
+A miniature kernel-shaped module: ``step`` reads ``m_``-prefixed
+measurement planes and leaks them into protocol state, an outbox
+plane, and a bare return — each a seeded mutant the rule must catch —
+while ``clean_step`` does everything the real kernels do with their
+planes (stamp, shift, accumulate, store back under ``m_`` keys) and
+must stay green.  Never imported; driven via
+``measure.check(root, files=[...])`` in tests/test_lint.py.
+"""
+
+import jax.numpy as jnp
+
+
+def step(state, inbox, ctx):
+    m_prop = state["m_prop_t"]                  # taint source
+    dt = jnp.clip(ctx.t - m_prop, 0, None)      # tainted
+    # MUTANT 1 (PXM101): a measurement value steering protocol state
+    ballot = jnp.where(dt > 4, state["ballot"] + 1, state["ballot"])
+    # MUTANT 2 (PXM101): a measurement value leaking onto the wire
+    outbox = {"p2a": {"valid": inbox["p2a"]["valid"],
+                      "bal": dt}}
+    new_state = dict(state, ballot=ballot, m_prop_t=m_prop)
+    return new_state, outbox
+
+
+def _step(state, inbox, ctx):
+    # MUTANT 3 (PXM102): a measurement plane escaping through return
+    hist = state["m_lat_hist"] + 1
+    return hist
+
+
+def clean_step(state, inbox, ctx):
+    # the sanctioned pattern: read m_ planes, accumulate, store back
+    # under m_ keys only — everything the instrumented kernels do
+    m_prop = state["m_prop_t"]
+    dt = jnp.clip(ctx.t - m_prop, 0, None)
+    newly = inbox["p2b"]["valid"]
+    m_sum = state["m_lat_sum"] + jnp.sum(jnp.where(newly, dt, 0))
+    m_prop = jnp.where(newly, 0, m_prop)
+    ballot = state["ballot"] + 1                # untainted protocol flow
+    outbox = {"p2a": {"valid": newly, "bal": ballot}}
+    return dict(state, ballot=ballot, m_prop_t=m_prop,
+                m_lat_sum=m_sum), outbox

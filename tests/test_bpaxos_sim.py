@@ -83,6 +83,24 @@ def test_noread_twin_violates():
     assert int(res.violations) > 0
 
 
+def test_inscan_parity_with_posthoc_oracle():
+    """The in-scan linearizability spot-checker (sim/inscan, PR 11)
+    agrees with the per-step protocol oracle on both halves, at zero
+    extra compile cost (cached runs): clean fuzzed runs report zero
+    in-scan violations, and the seeded noread twin — whose blind
+    recovery overwrites chosen values — trips BOTH oracles.  The
+    on-device commit-latency histogram samples on every run."""
+    clean = run(fuzz=DROP, groups=8, steps=100, seed=1)
+    assert int(clean.violations) == 0
+    assert clean.inscan_violations == 0
+    assert int(clean.latency_hist.sum()) > 0
+    assert clean.latency_summary()["p50_rounds"] > 0
+    seeded = run(name="bpaxos_noread", fuzz=DROP, groups=8, steps=80,
+                 seed=0)
+    assert int(seeded.violations) > 0
+    assert seeded.inscan_violations > 0
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("fuzz,steps", [(DUP, 150), (PART, 140)])
 def test_fuzzed_safety_heavy(fuzz, steps):

@@ -17,7 +17,8 @@ import pytest
 
 from paxi_tpu import analysis
 from paxi_tpu.analysis import (asyncflow, ballots, concurrency, crossflow,
-                               handlers, parity, purity, quorum, tracemap)
+                               handlers, measure, parity, purity, quorum,
+                               tracemap)
 from paxi_tpu.analysis.model import (Suppression, Violation,
                                      apply_suppressions, inline_disables,
                                      load_baseline)
@@ -424,6 +425,32 @@ def test_inline_disables_parser():
 
 
 # ---- CLI -----------------------------------------------------------------
+def test_measure_fixture_catches_each_mutant():
+    """PXM10x: the three seeded leaks (state write, outbox plane, bare
+    return) all fire; the clean control (``clean_step``, which stamps /
+    shifts / accumulates its m_ planes exactly like the real kernels)
+    stays green."""
+    vs = measure.check(ROOT, files=[FIX / "fixture_measure.py"])
+    assert codes(vs) == ["PXM101", "PXM102"]
+    src = (FIX / "fixture_measure.py").read_text().splitlines()
+    clean_start = next(i for i, l in enumerate(src, 1)
+                       if l.startswith("def clean_step"))
+    assert all(v.line < clean_start for v in vs), \
+        "the sanctioned m_-quarantine pattern must not be flagged"
+    # mutant 1 (protocol-state write) and mutant 2 (outbox plane) are
+    # distinct PXM101 sites; mutant 3 is the PXM102 return escape
+    assert len({v.line for v in vs if v.code == "PXM101"}) >= 2
+    assert any(v.code == "PXM102" for v in vs)
+
+
+def test_measure_repo_tree_is_clean():
+    """Every instrumented kernel (paxos/paxos_pg/wpaxos/wankeeper/
+    bpaxos + the PR-10 zone planes) respects measurement isolation —
+    m_ planes accumulate but never feed protocol logic (tier-1, no
+    baseline)."""
+    assert measure.check(ROOT) == []
+
+
 def test_cli_lint_json_on_fixture(capsys):
     from paxi_tpu.cli import main
     rc = main(["lint", str(FIX / "fixture_host.py"),

@@ -1,0 +1,187 @@
+"""On-device verification & latency observability (PR 11).
+
+Unit-tests the histogram layer (metrics/lathist: bucket edges,
+percentiles, host-format conversion with exact bucket-merge) and the
+in-scan spot-checker (sim/inscan) against hand-built planes, then pins
+the witness-hash exclusion of ``m_`` planes.  The capture->replay
+byte-identity of the on-device histogram (``capture_lat_hist`` meta)
+piggybacks on test_parallel's existing capture/replay compiles, and
+the in-scan vs post-hoc parity on REAL kernels lives beside the
+kernels' own tests
+(tests/test_bpaxos_sim.py reuses its cached runs; every kernel test
+asserting ``violations == 0`` now implicitly covers the clean half via
+the metrics the kernels export).
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paxi_tpu.metrics import Histogram, lathist, merge_snapshots, pretty
+from paxi_tpu.metrics.registry import HIST_SCHEME
+from paxi_tpu.sim import inscan
+
+
+# ---- lathist: bucket layout ---------------------------------------------
+def test_bucket_edges():
+    """Bucket 0 holds dt <= 1, bucket i holds (2^(i-1), 2^i], the last
+    bucket overflows — checked at every boundary."""
+    dts = [0, 1, 2, 3, 4, 5, 1024, 1025, 10 ** 6]
+    hist = lathist.hist_update(
+        lathist.empty_hist(), jnp.asarray(dts, jnp.int32),
+        jnp.ones(len(dts), bool))
+    h = np.asarray(hist)
+    assert h.sum() == len(dts)
+    assert h[0] == 2                    # dt 0, 1
+    assert h[1] == 1                    # dt 2
+    assert h[2] == 2                    # dt 3, 4 in (2, 4]
+    assert h[3] == 1                    # dt 5 in (4, 8]
+    assert h[lathist.N_BUCKETS - 2] == 1    # dt 1024 = top bound
+    assert h[lathist.N_BUCKETS - 1] == 2    # 1025, 1e6 overflow
+
+
+def test_hist_update_masks_and_group_axis():
+    dt = jnp.asarray([[2, 9], [100, 3]], jnp.int32)       # (R, G)
+    mask = jnp.asarray([[True, True], [False, True]])
+    hist = lathist.hist_update(lathist.empty_hist(2), dt, mask)
+    h = np.asarray(hist)                                  # (NB, G)
+    assert h.shape == (lathist.N_BUCKETS, 2)
+    assert h[:, 0].sum() == 1 and h[1, 0] == 1            # dt=2 only
+    assert h[:, 1].sum() == 2 and h[4, 1] == 1 and h[2, 1] == 1
+
+
+def test_percentiles_and_summary():
+    counts = np.zeros(lathist.N_BUCKETS, np.int32)
+    counts[1] = 90                                        # dt = 2
+    counts[4] = 10                                        # dt in (8, 16]
+    assert lathist.percentile_steps(counts, 50) == pytest.approx(
+        math.sqrt(2))
+    assert lathist.percentile_steps(counts, 99) == pytest.approx(
+        math.sqrt(8 * 16))
+    s = lathist.summarize(counts, sum_steps=90 * 2 + 10 * 12)
+    assert s["n"] == 100 and s["p99_rounds"] > s["p50_rounds"]
+    assert s["buckets"] == {"1": 90, "4": 10}
+    assert lathist.percentile_steps(np.zeros(lathist.N_BUCKETS), 50) == 0
+
+
+# ---- lathist <-> host registry: bucket-merge equivalence ----------------
+def test_host_snapshot_bucket_merge_equivalence():
+    """Converting a sim bucket vector lands each bucket's count exactly
+    where a host Histogram observing that bucket's midpoint would, so
+    sim->host conversion + merge is exact bucket addition and both
+    render through the one registry code path."""
+    counts = np.zeros(lathist.N_BUCKETS, np.int32)
+    counts[0], counts[2], counts[7] = 5, 3, 2
+    sum_steps = 5 * 1 + 3 * 3 + 2 * 100
+    snap = lathist.to_host_snapshot(counts, sum_steps)
+    assert snap["scheme"] == HIST_SCHEME
+    ref = Histogram()
+    for i, c in enumerate(counts):
+        for _ in range(int(c)):
+            ref.observe(lathist._midpoint_steps(i))
+    assert snap["count"] == ref.count == 10
+    assert snap["buckets"] == ref.to_snapshot()["buckets"]
+    # exact merge with a live host histogram (shared bounds)
+    host = Histogram()
+    host.observe(0.002)
+    merged = merge_snapshots([
+        {"histograms": [{"name": "lat", "labels": {}, **snap}]},
+        {"histograms": [{"name": "lat", "labels": {},
+                         **host.to_snapshot()}]}])
+    m = Histogram.from_snapshot(merged["histograms"][0])
+    assert m.count == 11
+    assert "lat" in pretty(merged)
+    # p50 through the REGISTRY percentile: within one bucket of the
+    # sim-side p50 (both land in the bucket holding midpoint 1.0s)
+    p50 = m.percentile(50)
+    assert 0.5 <= p50 <= 2.0
+
+
+def test_host_snapshot_scheme_gate():
+    snap = lathist.to_host_snapshot(np.zeros(lathist.N_BUCKETS), 0)
+    snap["scheme"] = "log2:steps"
+    with pytest.raises(ValueError):
+        Histogram.from_snapshot(snap)
+
+
+def test_step_seconds_scaling():
+    counts = np.zeros(lathist.N_BUCKETS, np.int32)
+    counts[1] = 1                                          # dt = 2 steps
+    a = lathist.to_host_snapshot(counts, 2, step_seconds=1.0)
+    b = lathist.to_host_snapshot(counts, 2, step_seconds=0.001)
+    assert a["sum"] == 2.0 and b["sum"] == pytest.approx(0.002)
+    assert a["buckets"] != b["buckets"]    # different host bucket
+
+
+# ---- sim/inscan: the spot-checker on hand-built planes ------------------
+def _planes(G=1):
+    """A clean 2-replica, 4-slot lane-major toy: replica frames aligned,
+    slots 0..1 committed with agreeing values, frontier at 2."""
+    base = jnp.zeros((2, G), jnp.int32)
+    sidx = jnp.arange(4, dtype=jnp.int32)
+    abs_ = base[:, None, :] + sidx[None, :, None]
+    cmd = jnp.broadcast_to(
+        jnp.asarray([7, 8, -1, -1], jnp.int32)[None, :, None], (2, 4, G))
+    commit = jnp.broadcast_to(
+        jnp.asarray([True, True, False, False])[None, :, None], (2, 4, G))
+    execute = jnp.full((2, G), 2, jnp.int32)
+    kv = jnp.broadcast_to(jnp.asarray([8], jnp.int32)[None, :, None],
+                          (2, 1, G))
+    return dict(execute=execute, base=base, abs=abs_, cmd=cmd,
+                commit=commit, kv=kv)
+
+
+def _check(old, new, **kw):
+    return int(np.asarray(inscan.spot_check(
+        old["execute"], new["execute"], old["base"], new["base"],
+        old["abs"], new["abs"], old["cmd"], new["cmd"],
+        old["commit"], new["commit"], **kw).sum()))
+
+
+def test_spot_check_clean_is_zero():
+    p = _planes()
+    assert _check(p, p, kv=p["kv"], lane_major=True) == 0
+    # per-group layout (no trailing G): same planes squeezed
+    q = {k: jnp.squeeze(v, -1) for k, v in p.items()}
+    assert _check(q, q, kv=q["kv"], lane_major=False) == 0
+
+
+def test_spot_check_catches_frontier_regression():
+    p = _planes()
+    new = dict(p, execute=p["execute"] - 1)
+    assert _check(p, new, lane_major=True) == 2     # both lanes regress
+
+
+def test_spot_check_catches_stability_break():
+    p = _planes()
+    new = dict(p, cmd=p["cmd"].at[0, 1].set(99))    # committed cmd flips
+    # stability (old vs new) + agreement (lane 0 vs 1 disagree on slot 1)
+    assert _check(p, new, lane_major=True) == 2
+    uncommit = dict(p, commit=p["commit"].at[0, 1].set(False))
+    assert _check(p, uncommit, lane_major=True) == 1
+
+
+def test_spot_check_catches_register_mismatch():
+    p = _planes()
+    bad_kv = p["kv"].at[1, 0].set(123)              # same frontier, diff kv
+    assert _check(p, p, kv=bad_kv, lane_major=True) == 1
+    # different frontiers: no register claim, no violation
+    ahead = dict(p, execute=p["execute"].at[1].set(3))
+    assert _check(p, ahead, kv=bad_kv, lane_major=True) == 0
+
+
+# ---- end-to-end: witness hash exclusion + histogram determinism ---------
+def test_state_hash_excludes_m_planes():
+    """The witness-hash half of the acceptance pin (the capture->
+    replay byte-identity of ``capture_lat_hist`` rides the existing
+    compiles in tests/test_parallel.py::
+    test_sharded_pinned_replay_reproduces_capture)."""
+    from paxi_tpu.trace import state_hash
+    plain = {"log": np.arange(6).reshape(2, 3), "execute": np.ones(2)}
+    with_m = dict(plain, m_lat_hist=np.full(12, 9),
+                  m_inscan_viol=np.asarray(0))
+    assert state_hash(with_m) == state_hash(plain)
+    assert state_hash(dict(plain, execute=np.zeros(2))) != \
+        state_hash(plain)

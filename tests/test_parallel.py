@@ -111,7 +111,13 @@ def test_indivisible_groups_pad_and_subtract():
 def test_sharded_pinned_replay_reproduces_capture():
     """The carried-forward ROADMAP item: a captured trace replays
     inside a sharded batch with the state-hash + counter check intact
-    (the prerequisite for trusting sharded bench numbers)."""
+    (the prerequisite for trusting sharded bench numbers).  Doubles as
+    the PR-11 observability acceptance pin, on the same two compiles:
+    the witness hash is bit-identical with the ``m_`` measurement
+    planes excluded, and the traced group's on-device commit-latency
+    histogram (``capture_lat_hist`` meta, deferred-flush layout)
+    reproduces byte-identically on both the single-device and the
+    sharded replay."""
     from paxi_tpu import trace as tr
     from paxi_tpu.trace.capture import capture
 
@@ -127,6 +133,9 @@ def test_sharded_pinned_replay_reproduces_capture():
     assert sharded.counters == single.counters \
         == t.meta["capture_counters"]
     assert sharded.violations == single.violations
+    assert t.meta["capture_lat_hist"], "no on-device samples captured"
+    assert sharded.lat_hist == single.lat_hist \
+        == t.meta["capture_lat_hist"]
 
 
 def test_sharded_pinned_replay_rejects_lane_major():

@@ -63,6 +63,11 @@ def test_grid_3x3_q2():
     assert int(res.metrics["committed_slots"]) > 0
 
 
+@pytest.mark.slow  # tier-1 budget (PR 11): second wpaxos geometry
+# compile (n_slots=16/locality=1 shape); the recycling axis stays in
+# tier-1 via the paxos_pg/wankeeper long-horizon tests and this
+# kernel's own fuzzed/grid variants — demoted per the PR-7 precedent
+# after the observability planes' compile growth
 def test_long_horizon_ring():
     # per-(replica, object) sliding windows: a horizon ~10x the ring
     # runs with zero violations (SURVEY §7 slot recycling).  locality=1
