@@ -128,6 +128,18 @@ def _cfgs():
          SimConfig(n_replicas=9, n_zones=3, n_objects=6, n_slots=16,
                    locality=0.8), GEO_WAN3Z,
          64 * s, 100, "committed_slots", "writes/s"),
+        # 11. the in-fabric consensus tier (paxi_tpu/switchnet): the
+        #     SAME geometry/shape/scenario as the paxos baseline row
+        #     right below, so the commit-latency histograms quantify —
+        #     in rounds — how many message delays in-network acceptance
+        #     removes (the headline: switch-accepted p50 vs the
+        #     software P2a->P2b round trip over the wan3z matrix)
+        ("paxos_wan3z_base", "paxos",
+         SimConfig(n_replicas=3, n_slots=32), GEO_WAN3Z,
+         64 * s, 100, "committed_slots", "slots/s"),
+        ("switchpaxos_wan3z", "switchpaxos",
+         SimConfig(n_replicas=3, n_slots=32), GEO_WAN3Z,
+         64 * s, 100, "committed_slots", "slots/s"),
     ]
 
 
@@ -172,6 +184,11 @@ def main() -> int:
         # lock-step rounds — propose->commit inside the owner's zone
         # vs across the WAN matrix
         line.update(scn.latency_split(metrics))
+        # switchnet accounting (the in-fabric tier's rows): fast-path
+        # commits vs gap-agreement and register-overflow fall-backs
+        for k in ("fast_commits", "gap_events", "sw_overflows"):
+            if k in metrics:
+                line[k] = int(metrics[k])
         # on-device observability (instrumented kernels): commit-latency
         # distribution (p50/p99/p999 in lock-step rounds, from the
         # in-kernel m_lat_hist plane) + the in-scan linearizability

@@ -107,15 +107,35 @@ def _node_attrs(root: Path) -> Set[str]:
 
 
 def host_attrs(host_path: Path, root: Path,
-               tree: Optional[ast.Module] = None) -> Set[str]:
+               tree: Optional[ast.Module] = None,
+               _seen: Optional[Set[Path]] = None) -> Set[str]:
     """Self-attributes and dataclass fields of every class in the host
-    module, plus the Node base surface (db, socket, metrics...)."""
+    module, plus the Node base surface (db, socket, metrics...) —
+    and, for classes whose base is imported from another in-repo host
+    module (``SwitchPaxosReplica(PaxosReplica)``), that module's
+    surface too: replica state inherited across a module boundary is
+    still host state the sim map may point at."""
     if tree is None:
         tree, _ = astutil.parse_file(host_path)
     model = flow.ModuleModel(tree)
     out: Set[str] = set(_node_attrs(root))
     for ci in model.classes.values():
         out |= ci.attrs
+    seen = _seen if _seen is not None else {host_path.resolve()}
+    imported: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                imported[a.asname or a.name] = node.module
+    base_mods = {imported[b] for ci in model.classes.values()
+                 for b in ci.bases if b in imported}
+    for mod in sorted(base_mods):
+        if not mod.startswith("paxi_tpu."):
+            continue
+        p = (root / (mod.replace(".", "/") + ".py")).resolve()
+        if p.exists() and p not in seen:
+            seen.add(p)
+            out |= host_attrs(p, root, _seen=seen)
     return out
 
 

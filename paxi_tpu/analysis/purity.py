@@ -59,6 +59,7 @@ TARGETS = (
     "paxi_tpu/ops/*.py",
     "paxi_tpu/parallel/*.py",
     "paxi_tpu/metrics/simcount.py",
+    "paxi_tpu/switchnet/plane.py",
     "paxi_tpu/trace/demo.py",
 )
 
@@ -72,6 +73,8 @@ KERNEL_LIB_MODULES = frozenset({
     "paxi_tpu/ops/closure.py",
     "paxi_tpu/ops/hashing.py",
     "paxi_tpu/metrics/simcount.py",
+    # the switchnet sim mirror: every helper runs inside a kernel step
+    "paxi_tpu/switchnet/plane.py",
 })
 
 # call targets that make their function arguments traced code

@@ -43,6 +43,21 @@ Checks:
 - **PXQ503** a sim-kernel quorum threshold pair (``cfg.majority`` /
   ``cfg.fast_size`` aliases, zone-grid thresholds) can fail to
   intersect
+- **PXQ505** the switchnet in-fabric tier's recovery obligation
+  (paxi_tpu/switchnet): a module that commits on the in-network vote
+  (calls ``apply_fast_commits``/``fast_commit_mask``, or — host form
+  — registers a ``SwitchVote`` handler) runs a write quorum of
+  {switch register}; the ONLY recovery quorum intersecting it is one
+  that reads the register file, so the module must also consult it
+  (sim: a ``recovery_fold`` call on the phase-1 win path; host: a
+  registered ``SwitchSnap`` handler).  Skipping the read is the
+  lost-fast-commit bug: a value whose only durable copy is the
+  bounded register file vanishes across a leader failover.  The
+  replica fall-back quorum (``cfg.majority`` aliases) x recovery
+  majority pairs are enumerated for all n by the PXQ503 machinery as
+  usual — together the two cover every write-path x recovery pair of
+  the tier.
+
 - **PXQ504** a rectangular-grid (rowcol) read x write pair can fail
   to intersect — the BPaxos quorum system, and the first non-majority
   system this rule proves.  The grid is also the *thrifty* variant
@@ -839,6 +854,55 @@ def sim_sites(tree: ast.Module,
 # ---------------------------------------------------------------------------
 
 
+# switchnet structural obligation (PXQ505): fast-path commit sites and
+# the register reads that keep them recoverable, by callable name
+_SWITCH_FAST = frozenset({"apply_fast_commits", "fast_commit_mask"})
+_SWITCH_RECOVER = "recovery_fold"
+
+
+def check_switchnet(tree: ast.Module, relpath: str,
+                    is_sim: bool) -> List[Violation]:
+    """The in-network vote register x recovery quorum intersection
+    (module docstring, PXQ505): presence of the fast path obliges
+    presence of the register read on the recovery path."""
+    called: Set[str] = set()
+    registered: Set[str] = set()
+    lines: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = (astutil.dotted_name(node.func) or "").split(".")[-1]
+        called.add(name)
+        lines.setdefault(name, node.lineno)
+        if name == "register" and node.args:
+            arg0 = astutil.dotted_name(node.args[0])
+            if arg0:
+                registered.add(arg0.split(".")[-1])
+    out: List[Violation] = []
+    if is_sim:
+        fast = sorted(called & _SWITCH_FAST)
+        if fast and _SWITCH_RECOVER not in called:
+            out.append(Violation(
+                rule=RULE, code="PXQ505", path=relpath,
+                line=lines[fast[0]], col=0,
+                message=(
+                    f"in-network fast-path commit (`{fast[0]}`) without "
+                    f"a `{_SWITCH_RECOVER}` register read on the "
+                    "phase-1 win path — the {switch} write quorum "
+                    "intersects no recovery quorum, so a vote-only "
+                    "commit is lost across leader failover")))
+    elif "SwitchVote" in registered and "SwitchSnap" not in registered:
+        out.append(Violation(
+            rule=RULE, code="PXQ505", path=relpath,
+            line=lines.get("register", 1), col=0,
+            message=(
+                "host replica commits on SwitchVote but registers no "
+                "SwitchSnap handler — recovery never reads the switch "
+                "register file, so a vote-only commit is lost across "
+                "leader failover")))
+    return out
+
+
 def _is_sim_module(tree: ast.Module) -> bool:
     """Sim kernels all export a top-level ``mailbox_spec``; host
     modules never do — steadier than filename matching (fixtures)."""
@@ -853,6 +917,7 @@ def check_file(path: Path, root: Path, preds: Predicates,
     tree, _ = astutil.parse_file(path)
     resolver = Resolver(tree)
     out: List[Violation] = []
+    out.extend(check_switchnet(tree, relpath, _is_sim_module(tree)))
     if not _is_sim_module(tree):
         sites = host_sites(tree, preds, resolver)
         for s in sites:

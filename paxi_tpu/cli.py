@@ -579,6 +579,10 @@ def cmd_scenario(args) -> int:
     cfg = SimConfig(n_replicas=args.replicas, n_slots=args.slots,
                     n_keys=args.keys, n_zones=args.zones,
                     n_objects=args.objects, locality=args.locality)
+    # switchnet events (SwitchChurn) compile into the static sim knobs
+    # — and ride into the host replay's scfg, where the protocol's
+    # HUNT_FABRIC_SETUP hook builds the matching switch tier
+    cfg = scn.apply_switch(cfg, scenario)
 
     if args.host:
         # host runtime: the Scenario compiles into the virtual-clock

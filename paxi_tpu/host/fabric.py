@@ -84,6 +84,14 @@ class VirtualClockFabric:
         self._deliver: Dict[str, Callable[[Any], None]] = {}
         self._occ: Dict[Tuple[str, str, str], int] = {}
         self._on_step: List[Callable[[int], None]] = []
+        # in-fabric consensus tier (paxi_tpu/switchnet): when installed,
+        # every submission passes the switch BEFORE any fault check —
+        # mirroring the sim, where the kernel's switch planes observe
+        # the raw outbox and masking happens downstream at the delay
+        # wheel — and the tier's injections (votes, register reads)
+        # ride the fabric's own return half-path: one logical step,
+        # never subject to the schedule's edge faults
+        self.switch = None
         # consecutive no-new-submission loop yields that count as
         # quiescence; >1 tolerates multi-hop wakeup chains (put_nowait
         # -> getter wakes -> handler awaits -> resumes)
@@ -105,6 +113,11 @@ class VirtualClockFabric:
         the settle — the fabric's analog of the sim's workload draw)."""
         self._on_step.append(fn)
 
+    def install_switch(self, tier) -> None:
+        """Interpose a switchnet ``SwitchTier`` on the wire (see
+        ``__init__``; paxi_tpu/switchnet/switch.py)."""
+        self.switch = tier
+
     # ---- the send path --------------------------------------------------
     def submit(self, src: str, dst: str, msg: Any) -> None:
         """Route one send through the virtual clock (Socket.send's
@@ -113,6 +126,15 @@ class VirtualClockFabric:
         src, dst = str(src), str(dst)
         self.stats["submitted"] += 1
         t = self.step
+        if self.switch is not None:
+            # the switch sees the frame mid-flight (before any fault
+            # masking — the sim's kernel-side switch observes the raw
+            # outbox the same way) and may stamp it in place; its
+            # injections deliver one step out on the return half-path
+            for idst, imsg in self.switch.on_send(t, src, dst, msg):
+                self._seq += 1
+                heapq.heappush(self._heap,
+                               (t + 1, self._seq, "switch", idst, imsg))
         extra = 0
         if self.sched is not None:
             # the sim masks crashed ENDPOINTS and severed edges at the

@@ -92,6 +92,16 @@ CASES: List[Case] = [
     # the grid's column-read path — to keep the stripe progressing
     ("bpaxos", SimConfig(n_replicas=7, n_slots=16),
      [DROP, DUP, PART, KILL], 32, 140, "committed_slots"),
+    # in-fabric consensus tier (paxi_tpu/switchnet): drops force the
+    # gap-agreement slow path, KILL the register-read recovery; the
+    # seqchurn schedule rides INSIDE the SimConfig (apply_switch) so
+    # sequencer failovers + session bumps run under drops too
+    ("switchpaxos", SimConfig(n_replicas=5, n_slots=32),
+     [DROP, PART, KILL], 32, 140, "committed_slots"),
+    ("switchpaxos",
+     scn.apply_switch(SimConfig(n_replicas=5, n_slots=32),
+                      scn.SEQ_CHURN),
+     [DROP], 32, 140, "committed_slots"),
 ]
 
 # the seeded-bug demo case (fuzz_soak --seed-bug): EXPECTED to violate —
@@ -129,6 +139,12 @@ DEMO_CASES: List[Case] = [
                                 n_slots=16, steal_threshold=2,
                                 locality=0.3),
      [GEO3Z], 16, 100, "committed_slots"),
+    # switchnet drop-the-gap-agreement twin (switchpaxos/nogap.py):
+    # both runtimes NOOP-commit the holes a stamp gap reveals, so a
+    # drop witness must classify REPRODUCED through the fabric + the
+    # replayed switch tier — the in-fabric tier's end-to-end control
+    ("switchpaxos_nogap", SimConfig(n_replicas=5, n_slots=32),
+     [DROP], 16, 80, "committed_slots"),
 ]
 
 

@@ -231,9 +231,15 @@ async def replay_schedule(algorithm: str, scfg, sched, *, cfg=None,
         cfg = chan_config(scfg.n_replicas, zones=scfg.n_zones,
                           tag="hunt")
     fabric = VirtualClockFabric(sched)
+    host_mod = importlib.import_module(_HOST_MODULES[algorithm])
+    # fabric-tier hook (the switchnet protocols): interpose whatever
+    # in-network tier the protocol speaks through BEFORE the replicas
+    # attach, so their constructors see it on the wire
+    fab_setup = getattr(host_mod, "HUNT_FABRIC_SETUP", None)
+    if fab_setup is not None:
+        fab_setup(fabric, scfg)
     cluster = Cluster(algorithm, cfg=cfg, http=False, fabric=fabric)
     await cluster.start()
-    host_mod = importlib.import_module(_HOST_MODULES[algorithm])
     if tail_steps is None:
         tail_steps = getattr(host_mod, "HUNT_TAIL_STEPS", 10)
     out = HostOutcome(steps=sched.n_steps)

@@ -26,6 +26,9 @@ _SIM_MODULES = {
     "wankeeper": "paxi_tpu.protocols.wankeeper.sim",
     "blockchain": "paxi_tpu.protocols.blockchain.sim",
     "bpaxos": "paxi_tpu.protocols.bpaxos.sim",
+    # the in-fabric consensus tier (paxi_tpu/switchnet): switch
+    # acceptors + ordered multicast — a protocol CLASS, not a peer
+    "switchpaxos": "paxi_tpu.protocols.switchpaxos.sim",
     # trace-subsystem plumbing (NOT correctness cases — all violate by
     # design): the fragile demo kernel and the seeded bug twins.
     # ":ATTR" selects a non-default protocol symbol in the module.
@@ -44,6 +47,12 @@ _SIM_MODULES = {
     # capturable agreement witnesses (sim-only, like wankeeper_nofloor)
     "relay_churn": "paxi_tpu.scenarios.demo",
     "wpaxos_thinq1": "paxi_tpu.protocols.wpaxos.sim:PROTOCOL_THINQ1",
+    # switchnet seeded twin WITH a matching host twin (nogap.py): gap
+    # agreement replaced by unilateral NOOP-commits on BOTH runtimes,
+    # so its drop witnesses are the in-fabric tier's end-to-end
+    # REPRODUCED control
+    "switchpaxos_nogap":
+        "paxi_tpu.protocols.switchpaxos.sim:PROTOCOL_NOGAP",
 }
 
 _HOST_MODULES = {
@@ -62,6 +71,8 @@ _HOST_MODULES = {
     "blockchain": "paxi_tpu.protocols.blockchain.host",
     "bpaxos": "paxi_tpu.protocols.bpaxos.host",
     "bpaxos_noread": "paxi_tpu.protocols.bpaxos.noread",
+    "switchpaxos": "paxi_tpu.protocols.switchpaxos.host",
+    "switchpaxos_nogap": "paxi_tpu.protocols.switchpaxos.nogap",
     # host twin of the scenario engine's churn-sensitive demo kernel
     "relay_churn": "paxi_tpu.scenarios.demo_host",
 }

@@ -131,6 +131,17 @@ class Entry:
 
 
 class PaxosReplica(Node):
+    # message-class hooks: every wire frame is built and registered
+    # through these, so a subclass can swap in extended frames (the
+    # switchnet tier's sequencer-stamped classes in
+    # protocols/switchpaxos/host.py) without re-implementing the
+    # phase logic — Node dispatch is keyed on the exact type
+    P1A_CLS = P1a
+    P1B_CLS = P1b
+    P2A_CLS = P2a
+    P2B_CLS = P2b
+    P3_CLS = P3
+
     def __init__(self, id: ID, cfg: Config):
         super().__init__(id, cfg)
         self.ballot = 0
@@ -230,7 +241,7 @@ class PaxosReplica(Node):
         self.p1_quorum.ack(self.id)
         self.p1b_logs = {self.id: self._log_payload()}
         self.p1b_meta = {self.id: (self.execute, {}, {})}  # own db is local
-        self.socket.broadcast(P1a(self.ballot, self.execute))
+        self.socket.broadcast(self.P1A_CLS(self.ballot, self.execute))
 
     def _log_payload(self) -> Dict[int, list]:
         return {s: [e.ballot, _wire_cmds(e.cmds), e.commit]
@@ -320,7 +331,7 @@ class PaxosReplica(Node):
         q.ack(self.id)
         self.log[slot] = Entry(self.ballot, cmds, requests=reqs, quorum=q,
                                timestamp=time.time())
-        self.socket.broadcast(P2a(self.ballot, slot, _wire_cmds(cmds)))
+        self.socket.broadcast(self._make_p2a(slot, cmds))
         if q.majority():  # single-replica cluster
             self._commit(slot)
 
@@ -349,7 +360,7 @@ class PaxosReplica(Node):
         ctab = ({c: [i, v] for c, (i, v) in self.ctab.items()}
                 if ahead else {})  # stale candidates discard the P1b anyway
         self.socket.send(ballot_id(m.ballot),
-                         P1b(self.ballot, str(self.id), self._log_payload(),
+                         self.P1B_CLS(self.ballot, str(self.id), self._log_payload(),
                              self.execute, snap, ctab))
 
     def _repend_inflight(self) -> None:
@@ -384,8 +395,15 @@ class PaxosReplica(Node):
         self.p1_quorum.ack(ID(m.id))
         self.p1b_logs[ID(m.id)] = m.log
         self.p1b_meta[ID(m.id)] = (m.execute, m.snap, m.ctab)
-        if self.p1_quorum.majority() and ballot_id(self.ballot) == self.id:
+        if self._p1_complete():
             self._become_leader()
+
+    def _p1_complete(self) -> bool:
+        """Is my phase-1 round won and still mine?  Shared with the
+        switchnet subclass, whose election can also complete from the
+        register-read arrival (handle_switch_snap)."""
+        return self.p1_quorum.majority() \
+            and ballot_id(self.ballot) == self.id
 
     def _become_leader(self) -> None:
         """Merge P1b logs: per slot adopt the highest-ballot batch, keep
@@ -480,8 +498,17 @@ class PaxosReplica(Node):
                 self.log[m.slot] = Entry(m.ballot, _cmds_from_wire(m.cmds),
                                          requests=reqs)
             self.slot = max(self.slot, m.slot)
-        self.socket.send(ballot_id(m.ballot),
-                         P2b(self.ballot, m.slot, str(self.id)))
+        self.socket.send(ballot_id(m.ballot), self._make_p2b(m.slot))
+
+    def _make_p2a(self, slot: int, cmds):
+        """P2a factory — the switchnet subclass rides its frontier
+        gossip on this frame (register-eviction input)."""
+        return self.P2A_CLS(self.ballot, slot, _wire_cmds(cmds))
+
+    def _make_p2b(self, slot: int):
+        """P2b factory — the switchnet subclass rides its frontier
+        gossip on this frame (register-eviction input)."""
+        return self.P2B_CLS(self.ballot, slot, str(self.id))
 
     def handle_p2b(self, m: P2b) -> None:
         if m.ballot > self.ballot:  # rejected: someone has a newer ballot
@@ -500,7 +527,7 @@ class PaxosReplica(Node):
         e = self.log[slot]
         e.commit = True
         self._renew_lease(e.timestamp)   # quorum round started then
-        self.socket.broadcast(P3(self.ballot, slot, _wire_cmds(e.cmds)))
+        self.socket.broadcast(self.P3_CLS(self.ballot, slot, _wire_cmds(e.cmds)))
         self._exec()
 
     # ---- commit + execution -------------------------------------------

@@ -589,4 +589,12 @@ SIM_STATE_MAP = {
     "proposed":   "",  # own-ballot OAccept mask: implied by OEntry
     "timer":      "",  # election step-timer: host elections are wall-clock
     "stuck":      "",  # frontier-stall retry counter (kernel-only)
+    # on-device observability (PR 11) — measurement planes, excluded
+    # from the trace witness hash; the host twins are the registry's
+    # live latency histograms and the post-hoc linearizability checker
+    "m_prop_t":      "",
+    "m_commit_dt":   "",   # pending deltas for the deferred flush
+    "m_lat_hist":    "",
+    "m_lat_sum":     "",
+    "m_inscan_viol": "",
 }

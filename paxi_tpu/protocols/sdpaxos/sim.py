@@ -63,8 +63,10 @@ from typing import Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from paxi_tpu.metrics import lathist
 from paxi_tpu.ops.hashing import fib_key
 from paxi_tpu.sim import ballot_ring as br
+from paxi_tpu.sim import inscan
 from paxi_tpu.sim.ballot_ring import NO_CMD
 from paxi_tpu.sim.ring import diag2, dst_major
 from paxi_tpu.sim.ring import pick_src as _pick_src
@@ -139,6 +141,19 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
             (jnp.arange(R, dtype=i32) * cfg.election_timeout)[:, None],
             (R, G)),
         stuck=jnp.zeros((R, G), i32),
+        # on-device observability (PR-11 template: m_ measurement
+        # planes, witness-hash-excluded, never read by protocol logic
+        # — PXM10x): m_prop_t records each O-slot's FIRST propose step
+        # at the sequencer; commits store their propose->commit delta
+        # in the position-free m_commit_dt pending plane and the
+        # runner's deferred flush log2-bins it (metrics/lathist);
+        # m_inscan_viol accumulates the in-scan linearizability
+        # spot-check (sim/inscan)
+        m_prop_t=jnp.zeros((R, S, G), i32),
+        m_commit_dt=jnp.zeros((R, S, G), i32),
+        m_lat_hist=lathist.empty_hist(G),
+        m_lat_sum=jnp.zeros((G,), i32),
+        m_inscan_viol=jnp.zeros((G,), i32),
     )
 
 
@@ -153,6 +168,12 @@ def step(state, inbox, ctx: StepCtx):
     own_diag = ridx[:, None, None] == ridx[None, :, None]   # (R, R, 1)
 
     st = {k: state[k] for k in BR_KEYS}
+    # measurement planes (never passed into ballot_ring: the helpers
+    # shift the log planes by base deltas, so m_prop_t is re-aligned
+    # here by the SAME delta after every base-moving call)
+    m_prop_t = state["m_prop_t"]
+    m_lat_hist = state["m_lat_hist"]
+    m_lat_sum = state["m_lat_sum"]
     c_next = state["c_next"]
     c_stored = state["c_stored"]
     c_ack = state["c_ack"]
@@ -236,10 +257,16 @@ def step(state, inbox, ctx: StepCtx):
     # ============ O-log: shared Multi-Paxos core over owner tokens ======
     st, out_p1b, promote = br.promise_p1a(st, inbox["p1a"])
     st, p1_win, amask = br.tally_p1b(st, inbox["p1b"], MAJ, STRIDE)
+    b0 = st["base"]
     st, ex = br.adopt_best_acker(st, amask, p1_win,
                                  {"kv": kv, "exec_c": exec_c})
     kv, exec_c = ex["kv"], ex["exec_c"]
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
     st = br.merge_acker_logs(st, amask, p1_win)
+    # a takeover restarts the adopted slots' latency clocks (re-owned
+    # re-proposals measure from the takeover, like the paxos kernel)
+    m_prop_t = jnp.where(p1_win[:, None, :] & st["proposed"]
+                         & (m_prop_t == 0), ctx.t, m_prop_t)
 
     # ---------------- phase-1 win: rebuild per-owner token counts -------
     # tokens ordered for owner o = tokens executed (exec_c) + o's tokens
@@ -257,9 +284,18 @@ def step(state, inbox, ctx: StepCtx):
 
     st, out_p2b, acc_ok, _ = br.accept_p2a(st, inbox["p2a"])
     st, newly = br.tally_p2b(st, inbox["p2b"], MAJ, STRIDE)
+    # in-kernel commit latency: every newly committed (seqr, slot)
+    # stores its propose->commit step delta in the pending plane; the
+    # runner's deferred flush log2-bins it (see init_state)
+    dt = jnp.clip(ctx.t - m_prop_t, 0, None)
+    m_commit_dt = jnp.where(newly, dt, state["m_commit_dt"])
+    m_lat_sum = m_lat_sum + jnp.sum(jnp.where(newly, dt, 0),
+                                    axis=(0, 1), dtype=jnp.int32)
+    b0 = st["base"]
     st, ex, c_has, c_bal = br.apply_p3(st, inbox["p3"],
                                        {"kv": kv, "exec_c": exec_c})
     kv, exec_c = ex["kv"], ex["exec_c"]
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
 
     # ---------------- sequencer proposes (backlog or re-proposal) -------
     # ordering queue: deepest-backlog owner's token (replaces the paxos
@@ -273,6 +309,10 @@ def step(state, inbox, ctx: StepCtx):
     is_new = ~has_re & can_new & has_bl
     prop_cmd = jnp.where(is_new, pick_o, re_cmd)
     do = is_leader & (has_re | is_new)
+    # latency clock: a slot's FIRST propose starts it (re-proposals
+    # and go-back-N retries keep the original start)
+    m_prop_t = jnp.where(do[:, None, :] & oh_p & ~st["proposed"]
+                         & (m_prop_t == 0), ctx.t, m_prop_t)
     st, out_p2a = br.propose_write(st, do, is_new, prop_cmd, prop_slot,
                                    oh_p)
     enq_bump = (is_new & do)[:, None, :] \
@@ -326,10 +366,25 @@ def step(state, inbox, ctx: StepCtx):
     st = br.retry_stuck(st, new_execute, is_leader, cfg.retry_timeout)
     heard = promote | acc_ok | (c_has & (c_bal >= st["ballot"]))
     st, out_p1a = br.election_tick(st, heard, ctx.rng, cfg)
+    b0 = st["base"]
     st = br.slide_window(st, new_execute, RETAIN)
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
+
+    # in-scan linearizability spot-check (sim/inscan): an independent
+    # oracle beside invariants(), accumulated on device per group
+    m_inscan_viol = state["m_inscan_viol"] + inscan.spot_check(
+        state["execute"], st["execute"], state["base"], st["base"],
+        state["base"][:, None, :] + sidx[None, :, None],
+        st["base"][:, None, :] + sidx[None, :, None],
+        state["log_cmd"], st["log_cmd"],
+        state["log_commit"], st["log_commit"],
+        kv=kv, lane_major=True)
 
     new_state = dict(st, c_next=c_next, c_stored=c_stored, c_ack=c_ack,
-                     o_seen=o_seen, o_enq=o_enq, exec_c=exec_c, kv=kv)
+                     o_seen=o_seen, o_enq=o_enq, exec_c=exec_c, kv=kv,
+                     m_prop_t=m_prop_t, m_commit_dt=m_commit_dt,
+                     m_lat_hist=m_lat_hist, m_lat_sum=m_lat_sum,
+                     m_inscan_viol=m_inscan_viol)
     outbox = {"ca": out_ca, "cack": out_cack, "oreq": out_oreq,
               "cneed": out_cneed, "cr": out_cr,
               "p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
@@ -344,6 +399,13 @@ def metrics(state, cfg: SimConfig):
         "commands_proposed": jnp.sum(state["c_next"]),
         "has_sequencer": jnp.sum(jnp.any(state["active"], axis=0)
                                  .astype(jnp.int32)),
+        # on-device observability scalars (PR-11 contract; the
+        # histogram itself rides in state as m_lat_hist)
+        "commit_lat_sum": jnp.sum(state["m_lat_sum"]),
+        "commit_lat_n": (jnp.sum(state["m_lat_hist"])
+                         + jnp.sum((state["m_commit_dt"] > 0)
+                                   .astype(jnp.int32))),
+        "inscan_violations": jnp.sum(state["m_inscan_viol"]),
     }
 
 

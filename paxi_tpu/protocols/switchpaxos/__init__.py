@@ -1,0 +1,3 @@
+"""SwitchPaxos: Multi-Paxos through the in-fabric consensus tier
+(paxi_tpu/switchnet) — switch-accepted commits + NOPaxos-style
+ordered multicast, on both runtimes (sim.py / host.py)."""

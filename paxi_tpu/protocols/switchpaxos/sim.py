@@ -1,0 +1,476 @@
+"""SwitchPaxos: Multi-Paxos through the in-fabric consensus tier
+(lane-major TPU kernel; host twin in host.py, tier in
+paxi_tpu/switchnet/).
+
+"Paxos Made Switch-y" + NOPaxos (PAPERS.md): the network fabric
+itself runs acceptor and sequencer logic, removing one full message
+round from every commit.  The sim mirrors the switch as **planes
+threaded through the scan carry** (switchnet/plane.py): a frame
+passes the switch at the step its outbox is built, and the vote /
+sequence stamp the switch produces becomes visible one step later —
+exactly one fabric delivery where the classic P2a->P2b path costs
+two (and 2x the WAN edge latency under a zone matrix).
+
+On top of the shared ballot-ring core (sim/ballot_ring.py, same as
+the paxos kernel) this kernel adds:
+
+- **in-network vote plane**: the switch registers (ballot, value) per
+  slot in a bounded ``cfg.sw_window`` file; the leader fast-commits
+  any slot whose register carries a vote at its own ballot
+  (``fast_commit_mask``) — the classic majority-P2b tally still runs
+  underneath and is the fall-back for register overflow and switch
+  down windows.
+- **sequencer plane**: frames are stamped with monotone
+  (session, sequence) pairs; replicas track ``expect`` and DETECT
+  drops from stamp gaps (NOPaxos's replica contract), triggering the
+  gap-agreement slow path: a ``gapreq`` to the leader, which
+  retransmits the missing frame immediately (committed -> targeted
+  P3; in flight -> re-proposal carrying its ORIGINAL stamp) instead
+  of waiting out ``retry_timeout``.
+- **recovery through the switch**: a phase-1 winner folds the
+  register file into its log before the P1b merge
+  (``recovery_fold``) — the {switch} x recovery quorum intersection
+  paxi-lint's PXQ505 enforces statically.
+- **sequencer churn** (scenario ``SwitchChurn`` -> static
+  ``cfg.sw_down_*``): down windows pause votes and stamps (registers
+  and the promise persist), window ends bump the session epoch and
+  replicas resync ``expect`` on the first stamp of a new session.
+
+The seeded twin ``PROTOCOL_NOGAP`` (hunt's cross-runtime REPRODUCED
+control, host twin in nogap.py) replaces gap agreement with the
+classic ordered-multicast mistake: on a detected gap the replica
+unilaterally NOOP-commits its empty slots below the arriving frame —
+holes the leader meanwhile commits real commands into, so drops
+deterministically diverge committed values across replicas.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paxi_tpu.metrics import lathist
+from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim import ballot_ring as br
+from paxi_tpu.sim import inscan
+from paxi_tpu.sim.ballot_ring import NO_CMD
+from paxi_tpu.sim.ring import pick_src, require_packable
+from paxi_tpu.sim.ring import dst_major as T
+from paxi_tpu.sim.ring import shift_window as _shift
+from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+from paxi_tpu.switchnet import plane as swp
+from paxi_tpu.switchnet.plane import NO_SEQ
+
+BR_KEYS = br.KEYS
+GAP_SCAN = 4   # contiguous expect-advance hops per step (bounded state)
+BIG = jnp.int32(2 ** 30)
+
+
+def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
+    return {
+        "p1a": ("bal",),
+        "p1b": ("bal",),
+        # ordered-multicast frames: the switch stamps sess/seq in
+        # flight (outbox fields written from the carry planes)
+        "p2a": ("bal", "slot", "cmd", "sess", "seq"),
+        "p2b": ("bal", "slot"),
+        "p3": ("bal", "slot", "cmd", "upto", "sess", "seq"),
+        # gap agreement: replica -> leader, "retransmit sequence n"
+        "gapreq": ("n",),
+    }
+
+
+def encode_cmd(bal, slot):
+    return ((bal & 0x7FFF) << 16) | (slot & 0xFFFF)
+
+
+def cmd_key(cmd, n_keys):
+    return fib_key(cmd, n_keys)
+
+
+def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
+    R, S, K, G = cfg.n_replicas, cfg.n_slots, cfg.n_keys, n_groups
+    del rng
+    require_packable(R)
+    i32 = jnp.int32
+    return dict(
+        # ---- ballot-ring core (sim/ballot_ring.py) ----
+        ballot=jnp.zeros((R, G), i32),
+        active=jnp.zeros((R, G), bool),
+        p1_acks=jnp.zeros((R, G), i32),
+        base=jnp.zeros((R, G), i32),
+        log_bal=jnp.zeros((R, S, G), i32),
+        log_cmd=jnp.full((R, S, G), NO_CMD, i32),
+        log_commit=jnp.zeros((R, S, G), bool),
+        log_acks=jnp.zeros((R, S, G), i32),
+        proposed=jnp.zeros((R, S, G), bool),
+        next_slot=jnp.zeros((R, G), i32),
+        execute=jnp.zeros((R, G), i32),
+        kv=jnp.zeros((R, K, G), i32),
+        timer=jnp.broadcast_to(
+            (jnp.arange(R, dtype=i32) * cfg.election_timeout)[:, None],
+            (R, G)),
+        stuck=jnp.zeros((R, G), i32),
+        # ---- the in-fabric switch (switchnet/plane.py) ----
+        **swp.init_planes(cfg, G),
+        # ---- sequencer bookkeeping at the replicas ----
+        # the proposer's record of its frames' stamps (gap lookups, P3
+        # stamps); shifted with the ring like the log planes
+        seq_ring=jnp.full((R, S, G), NO_SEQ, i32),
+        # stamps of frames RECEIVED per ring slot (p2a or p3) — what
+        # the contiguous expect advance walks
+        slot_seq=jnp.full((R, S, G), NO_SEQ, i32),
+        expect=jnp.zeros((R, G), i32),   # next expected sequence
+        r_sess=jnp.zeros((R, G), i32),   # session last seen
+        # ---- on-device observability (PR-11 template: m_ planes,
+        # witness-hash-excluded, never read by protocol logic) ----
+        m_prop_t=jnp.zeros((R, S, G), i32),
+        m_commit_dt=jnp.zeros((R, S, G), i32),
+        m_lat_hist=lathist.empty_hist(G),
+        m_lat_sum=jnp.zeros((G,), i32),
+        m_inscan_viol=jnp.zeros((G,), i32),
+        # switchnet accounting: fast-path commits, detected gaps,
+        # register-file overflows (fall-backs)
+        m_fast_commits=jnp.zeros((G,), i32),
+        m_gap_events=jnp.zeros((G,), i32),
+        m_sw_overflow=jnp.zeros((G,), i32),
+    )
+
+
+def _step(state, inbox, ctx: StepCtx, nogap: bool):
+    cfg = ctx.cfg
+    R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
+    MAJ, STRIDE = cfg.majority, cfg.ballot_stride
+    RETAIN = max(S // 2, 1)
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    kidx = jnp.arange(K, dtype=jnp.int32)
+    ridx = jnp.arange(R, dtype=jnp.int32)
+
+    st = {k: state[k] for k in BR_KEYS}
+    sw = {k: state[k] for k in swp.KEYS}
+    G = state["ballot"].shape[-1]
+    kv = state["kv"]
+    seq_ring = state["seq_ring"]
+    slot_seq = state["slot_seq"]
+    expect = state["expect"]
+    r_sess = state["r_sess"]
+    m_prop_t = state["m_prop_t"]
+    m_lat_hist = state["m_lat_hist"]
+    m_lat_sum = state["m_lat_sum"]
+    m_fast = state["m_fast_commits"]
+    m_gap = state["m_gap_events"]
+    m_over = state["m_sw_overflow"]
+
+    def realign(b0):
+        """Re-align the ring-shaped extras after a base move."""
+        nonlocal m_prop_t, seq_ring, slot_seq
+        d = st["base"] - b0
+        m_prop_t = _shift(m_prop_t, d, 0)
+        seq_ring = _shift(seq_ring, d, NO_SEQ)
+        slot_seq = _shift(slot_seq, d, NO_SEQ)
+
+    # ---------- phase 1 + switch-assisted recovery ----------------
+    st, out_p1b, promote = br.promise_p1a(st, inbox["p1a"])
+    st, p1_win, amask = br.tally_p1b(st, inbox["p1b"], MAJ, STRIDE)
+    b0 = st["base"]
+    st, ex = br.adopt_best_acker(st, amask, p1_win, {"kv": kv})
+    kv = ex["kv"]
+    realign(b0)
+    # the {switch} x recovery intersection: fold the register file
+    # into the winner's log BEFORE the merge (PXQ505 obligation)
+    st = swp.recovery_fold(sw, st, p1_win, S)
+    st = br.merge_acker_logs(st, amask, p1_win)
+    m_prop_t = jnp.where(p1_win[:, None, :] & st["proposed"]
+                         & (m_prop_t == 0), ctx.t, m_prop_t)
+
+    # ---------- replicas accept frames (classic path) -------------
+    m2 = inbox["p2a"]
+    st, out_p2b, acc_ok, _ = br.accept_p2a(st, m2)
+    b2 = jnp.where(m2["valid"], m2["bal"], -1)
+    a_src = jnp.argmax(b2, axis=0).astype(jnp.int32)
+    a_slot = pick_src(m2["slot"], a_src)
+    f_seq = pick_src(m2["seq"], a_src)
+    f_sess = pick_src(m2["sess"], a_src)
+    stamped2 = acc_ok & (f_seq >= 0)
+
+    # ---------- leader commits: fast path + fall-back -------------
+    is_leader = st["active"] & br.own_bal_mask(st, STRIDE)
+    # in-network acceptance: votes the switch cast LAST step (the
+    # one-delivery visibility — the carry holds them)
+    st, newly_fast = swp.apply_fast_commits(sw, st, is_leader, S)
+    m_fast = m_fast + jnp.sum(newly_fast, axis=(0, 1),
+                              dtype=jnp.int32)
+    st, newly_cls = br.tally_p2b(st, inbox["p2b"], MAJ, STRIDE)
+    newly = newly_fast | newly_cls
+    dt = jnp.clip(ctx.t - m_prop_t, 0, None)
+    m_commit_dt = jnp.where(newly, dt, state["m_commit_dt"])
+    m_lat_sum = m_lat_sum + jnp.sum(jnp.where(newly, dt, 0),
+                                    axis=(0, 1), dtype=jnp.int32)
+
+    # ---------- P3 commit spread + snapshot catch-up --------------
+    m3 = inbox["p3"]
+    b0 = st["base"]
+    st, ex, c_has, c_bal = br.apply_p3(st, m3, {"kv": kv})
+    kv = ex["kv"]
+    realign(b0)
+    c3 = jnp.where(m3["valid"], m3["bal"], -1)
+    c_src = jnp.argmax(c3, axis=0).astype(jnp.int32)
+    c_slot = pick_src(m3["slot"], c_src)
+    p3_seq_in = pick_src(m3["seq"], c_src)
+    p3_sess_in = pick_src(m3["sess"], c_src)
+    stamped3 = c_has & (p3_seq_in >= 0)
+
+    # ---------- sequencer: session bumps, stamps, gap detect ------
+    s2 = jnp.where(stamped2, f_sess, -1)
+    s3 = jnp.where(stamped3, p3_sess_in, -1)
+    arr_sess = jnp.maximum(s2, s3)
+    newer = arr_sess > r_sess
+    cand = jnp.maximum(
+        jnp.where(stamped2 & (f_sess == arr_sess), f_seq, -1),
+        jnp.where(stamped3 & (p3_sess_in == arr_sess), p3_seq_in,
+                  -1))
+    # sequencer failover: resync past the first stamp of the new
+    # session (frames of the old session are healed by retry/P3).
+    # max(): a P3 retransmit carries the CURRENT session over its
+    # frame's ORIGINAL stamp, so a resync may only ever raise the
+    # cursor — never pull it back to an already-healed hole
+    expect = jnp.where(newer, jnp.maximum(expect, cand + 1), expect)
+    r_sess = jnp.maximum(r_sess, arr_sess)
+    gap = stamped2 & (f_sess == r_sess) & (f_seq > expect)
+    m_gap = m_gap + jnp.sum(gap, axis=0, dtype=jnp.int32)
+    # record received stamps at their slots, then advance expect
+    # over the contiguous known prefix (bounded walk)
+    oh2 = stamped2[:, None, :] \
+        & (sidx[None, :, None] == (a_slot - st["base"])[:, None, :])
+    slot_seq = jnp.where(oh2, f_seq[:, None, :], slot_seq)
+    oh3w = stamped3[:, None, :] \
+        & (sidx[None, :, None] == (c_slot - st["base"])[:, None, :])
+    slot_seq = jnp.where(oh3w, p3_seq_in[:, None, :], slot_seq)
+    for _ in range(GAP_SCAN):
+        hit = jnp.any(slot_seq == expect[:, None, :], axis=1)
+        expect = expect + hit
+
+    if nogap:
+        # the seeded twin (plane.noop_commit_holes docstring): gap
+        # agreement replaced by unilateral NOOP-commits — both
+        # oracles trip once the leader commits the real commands
+        st = swp.noop_commit_holes(st, gap, a_slot, sidx)
+        out_gapreq = {
+            "valid": jnp.zeros((R, R, G), bool),
+            "n": jnp.zeros((R, R, G), jnp.int32),
+        }
+    else:
+        # the real slow path: ask the frame's sender to retransmit
+        # the first missing sequence number
+        out_gapreq = {
+            "valid": gap[:, None, :]
+            & (ridx[None, :, None] == a_src[:, None, :]),
+            "n": jnp.broadcast_to(expect[:, None, :], (R, R, G)),
+        }
+
+    # ---------- leader answers gap requests -----------------------
+    mg = inbox["gapreq"]
+    gv = T(mg["valid"])                          # (me, src, G)
+    gn = T(mg["n"])
+    gr_n = jnp.min(jnp.where(gv, gn, BIG), axis=1)
+    has_gr = jnp.any(gv, axis=1) & is_leader & (gr_n < BIG)
+    oh_gr = (seq_ring == gr_n[:, None, :]) & (seq_ring >= 0) \
+        & has_gr[:, None, :]
+    com_gr = jnp.any(oh_gr & st["log_commit"], axis=1)
+    gap_rel = jnp.argmax(oh_gr, axis=1).astype(jnp.int32)
+    # an in-flight missing frame re-opens for immediate
+    # re-proposal (it keeps its original stamp: the register
+    # remembers) instead of waiting out retry_timeout
+    st = swp.gap_reopen(st, oh_gr)
+
+    # ---------- leader proposes (closed-loop client) --------------
+    has_re, can_new, prop_rel, prop_slot, oh_p, re_cmd = \
+        br.repropose_target(st)
+    is_new = ~has_re & can_new
+    prop_cmd = jnp.where(is_new, encode_cmd(st["ballot"], prop_slot),
+                         re_cmd)
+    do = is_leader & (has_re | can_new)
+    m_prop_t = jnp.where(do[:, None, :] & oh_p & ~st["proposed"]
+                         & (m_prop_t == 0), ctx.t, m_prop_t)
+    st, out_p2a = br.propose_write(st, do, is_new, prop_cmd,
+                                   prop_slot, oh_p)
+
+    # ---------- the switch observes the outgoing frames -----------
+    sw, stamp = swp.observe_p2a(sw, out_p2a, cfg, ctx.t)
+    out_p2a = dict(
+        out_p2a,
+        sess=jnp.broadcast_to(stamp["sess"][:, None, :], (R, R, G)),
+        seq=jnp.broadcast_to(stamp["seq"][:, None, :], (R, R, G)))
+    # the proposer learns its frame's stamp (gap lookups, P3
+    # stamps); in the fabric this is the vote's return leg
+    seq_ring = jnp.where((stamp["seq"] >= 0)[:, None, :] & oh_p,
+                         stamp["seq"][:, None, :], seq_ring)
+    m_over = m_over + stamp["overflow"].astype(jnp.int32)
+
+    # ---------- execute committed prefix, apply to KV -------------
+    execute = st["execute"]
+    advanced = jnp.zeros_like(execute)
+    running = jnp.ones_like(st["active"])
+    for e in range(cfg.exec_window):
+        rel = execute + e - st["base"]
+        oh_e = sidx[None, :, None] == rel[:, None, :]
+        com = jnp.any(oh_e & st["log_commit"], axis=1)
+        running = running & com
+        cmd_e = jnp.sum(jnp.where(oh_e, st["log_cmd"], 0), axis=1)
+        key_e = cmd_key(cmd_e, K)
+        wr = running & (cmd_e >= 0)
+        ohk = wr[:, None, :] & (kidx[None, :, None]
+                                == key_e[:, None, :])
+        kv = jnp.where(ohk, cmd_e[:, None, :], kv)
+        advanced = advanced + running
+    new_execute = execute + advanced
+
+    # ---------- stamped P3 out (gap-override target) --------------
+    low_new = jnp.argmin(jnp.where(newly, sidx[None, :, None], S),
+                         axis=1)
+    any_new = jnp.any(newly, axis=1)
+    span = jnp.maximum(new_execute - st["base"], 1)
+    rr = ctx.t % span
+    gap_p3 = has_gr & com_gr & ~any_new
+    p3_rel = jnp.where(any_new, low_new,
+                       jnp.where(gap_p3, gap_rel, rr))
+    p3_rel = jnp.clip(p3_rel, 0, S - 1).astype(jnp.int32)
+    oh_3 = sidx[None, :, None] == p3_rel[:, None, :]
+    p3_committed = jnp.any(oh_3 & st["log_commit"], axis=1)
+    p3_cmd = jnp.sum(jnp.where(oh_3, st["log_cmd"], 0), axis=1)
+    p3_seq = jnp.sum(jnp.where(oh_3, seq_ring, 0), axis=1)
+    p3_seq = jnp.where(
+        jnp.any(oh_3 & (seq_ring >= 0), axis=1), p3_seq, NO_SEQ)
+    p3_do = is_leader & p3_committed
+    sess_now = swp.session_t(cfg, ctx.t)
+    out_p3 = {
+        "valid": jnp.broadcast_to(p3_do[:, None, :], (R, R, G)),
+        "bal": jnp.broadcast_to(st["ballot"][:, None, :], (R, R, G)),
+        "slot": jnp.broadcast_to((st["base"] + p3_rel)[:, None, :],
+                                 (R, R, G)),
+        "cmd": jnp.broadcast_to(p3_cmd[:, None, :], (R, R, G)),
+        "upto": jnp.broadcast_to(new_execute[:, None, :], (R, R, G)),
+        "sess": jnp.broadcast_to(
+            jnp.where(p3_seq >= 0, sess_now, NO_SEQ)[:, None, :],
+            (R, R, G)),
+        "seq": jnp.broadcast_to(p3_seq[:, None, :], (R, R, G)),
+    }
+
+    # ---------- wrap-up: retry, election, slide, evict ------------
+    st = br.retry_stuck(st, new_execute, is_leader,
+                        cfg.retry_timeout)
+    heard = promote | acc_ok | (c_has & (c_bal >= st["ballot"]))
+    st, out_p1a = br.election_tick(st, heard, ctx.rng, cfg)
+    # phase-1 passes the switch too: the promise fence that stops
+    # stale leaders collecting votes after a recovery read
+    sw = swp.observe_p1a(sw, out_p1a)
+    b0 = st["base"]
+    st = br.slide_window(st, new_execute, RETAIN)
+    realign(b0)
+    sw = swp.evict(sw, st["execute"])
+
+    # ---------- in-scan spot-check --------------------------------
+    m_inscan_viol = state["m_inscan_viol"] + inscan.spot_check(
+        state["execute"], st["execute"], state["base"], st["base"],
+        state["base"][:, None, :] + sidx[None, :, None],
+        st["base"][:, None, :] + sidx[None, :, None],
+        state["log_cmd"], st["log_cmd"],
+        state["log_commit"], st["log_commit"],
+        kv=kv, lane_major=True)
+
+    new_state = dict(st, **sw, kv=kv, seq_ring=seq_ring,
+                     slot_seq=slot_seq, expect=expect, r_sess=r_sess,
+                     m_prop_t=m_prop_t, m_commit_dt=m_commit_dt,
+                     m_lat_hist=m_lat_hist, m_lat_sum=m_lat_sum,
+                     m_inscan_viol=m_inscan_viol,
+                     m_fast_commits=m_fast, m_gap_events=m_gap,
+                     m_sw_overflow=m_over)
+    outbox = {"p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
+              "p2b": out_p2b, "p3": out_p3, "gapreq": out_gapreq}
+    return new_state, outbox
+
+
+def step(state, inbox, ctx: StepCtx):
+    return _step(state, inbox, ctx, nogap=False)
+
+
+def step_nogap(state, inbox, ctx: StepCtx):
+    return _step(state, inbox, ctx, nogap=True)
+
+
+def metrics(state, cfg: SimConfig):
+    return {
+        "committed_slots": jnp.sum(jnp.max(state["execute"], axis=0)),
+        "min_execute": jnp.sum(jnp.min(state["execute"], axis=0)),
+        "has_leader": jnp.sum(jnp.any(state["active"], axis=0)
+                              .astype(jnp.int32)),
+        # switchnet accounting (m_ planes; see init_state)
+        "fast_commits": jnp.sum(state["m_fast_commits"]),
+        "gap_events": jnp.sum(state["m_gap_events"]),
+        "sw_overflows": jnp.sum(state["m_sw_overflow"]),
+        # on-device observability scalars (PR-11 contract)
+        "commit_lat_sum": jnp.sum(state["m_lat_sum"]),
+        "commit_lat_n": (jnp.sum(state["m_lat_hist"])
+                         + jnp.sum((state["m_commit_dt"] > 0)
+                                   .astype(jnp.int32))),
+        "inscan_violations": jnp.sum(state["m_inscan_viol"]),
+    }
+
+
+def invariants(old, new, cfg: SimConfig) -> jax.Array:
+    """The paxos oracle (agreement / stability / ballot monotonicity /
+    executed-prefix-committed) plus the sequencer plane's monotone
+    contract: ``expect`` and the seen-session never regress."""
+    S = cfg.n_slots
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    base, c, cmd = new["base"], new["log_commit"], new["log_cmd"]
+
+    align = jnp.max(base, axis=0)[None, :] - base
+    a_c = _shift(c, align, False)
+    a_cmd = _shift(cmd, align, NO_CMD)
+    mx = jnp.max(jnp.where(a_c, a_cmd, -BIG), axis=0)
+    mn = jnp.min(jnp.where(a_c, a_cmd, BIG), axis=0)
+    n_c = jnp.sum(a_c, axis=0)
+    v_agree = jnp.sum((n_c >= 1) & (mx != mn))
+
+    adv = base - old["base"]
+    o_c = _shift(old["log_commit"], adv, False)
+    o_cmd = _shift(old["log_cmd"], adv, NO_CMD)
+    v_stable = jnp.sum(o_c & (~c | (cmd != o_cmd)))
+    v_stable = v_stable + jnp.sum(new["execute"] < base)
+
+    v_bal = jnp.sum(new["ballot"] < old["ballot"])
+
+    abs_ = base[:, None, :] + sidx[None, :, None]
+    v_exec = jnp.sum((abs_ < new["execute"][:, None, :]) & ~c)
+
+    v_seq = jnp.sum(new["expect"] < old["expect"]) \
+        + jnp.sum(new["r_sess"] < old["r_sess"])
+
+    return (v_agree + v_stable + v_bal + v_exec
+            + v_seq).astype(jnp.int32)
+
+
+PROTOCOL = SimProtocol(
+    name="switchpaxos",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=step,
+    metrics=metrics,
+    invariants=invariants,
+    batched=True,
+)
+
+# the seeded drop-the-gap-agreement twin (module docstring): hunt's
+# cross-runtime REPRODUCED control for the in-fabric tier
+PROTOCOL_NOGAP = SimProtocol(
+    name="switchpaxos_nogap",
+    mailbox_spec=mailbox_spec,
+    init_state=init_state,
+    step=step_nogap,
+    metrics=metrics,
+    invariants=invariants,
+    batched=True,
+)

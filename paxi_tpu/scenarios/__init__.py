@@ -3,20 +3,23 @@
 Declarative ``Scenario`` specs (spec.py) compiled onto the two
 runtimes' existing schedule contracts: per-(src_zone, dst_zone)
 latency matrices, leader-churn kill/revive rotations, membership-
-reconfiguration epochs and zone-outage campaigns, all expressed as
-capturable schedule extensions — the sim records the materialized
-planes (trace replay/shrink and the hunt engine work unchanged) and
-the virtual-clock fabric consumes the same spec as per-edge standing
-delays + per-step crash sets (compile.py).  See README "Scenarios".
+reconfiguration epochs, zone-outage campaigns and switchnet
+sequencer-churn windows, all expressed as capturable schedule
+extensions — the sim records the materialized planes (trace
+replay/shrink and the hunt engine work unchanged) and the
+virtual-clock fabric consumes the same spec as per-edge standing
+delays + per-step crash sets + switch down/session planes
+(compile.py).  See README "Scenarios" and "In-network consensus".
 """
 
 from paxi_tpu.scenarios.spec import (LeaderChurn, Reconfig, Scenario,
-                                     ZoneLatency, ZoneOutage, zone_of)
-from paxi_tpu.scenarios.compile import (NAMED, describe, latency_split,
-                                        named_scenario, seq_schedule_of,
-                                        with_scenario)
+                                     SwitchChurn, ZoneLatency, ZoneOutage,
+                                     zone_of)
+from paxi_tpu.scenarios.compile import (NAMED, apply_switch, describe,
+                                        latency_split, named_scenario,
+                                        seq_schedule_of, with_scenario)
 
 __all__ = ["Scenario", "ZoneLatency", "LeaderChurn", "Reconfig",
-           "ZoneOutage", "zone_of", "NAMED", "named_scenario",
-           "describe", "latency_split", "seq_schedule_of",
-           "with_scenario"]
+           "ZoneOutage", "SwitchChurn", "zone_of", "NAMED",
+           "named_scenario", "describe", "latency_split",
+           "seq_schedule_of", "with_scenario", "apply_switch"]

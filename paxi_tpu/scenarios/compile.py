@@ -22,7 +22,7 @@ from typing import Dict, Sequence
 
 from paxi_tpu.scenarios import schedule as _sched
 from paxi_tpu.scenarios.spec import (LeaderChurn, Reconfig, Scenario,
-                                     ZoneLatency, ZoneOutage)
+                                     SwitchChurn, ZoneLatency, ZoneOutage)
 from paxi_tpu.sim.types import FuzzConfig
 
 
@@ -30,6 +30,20 @@ def with_scenario(fuzz: FuzzConfig, scn: Scenario) -> FuzzConfig:
     """The FuzzConfig that runs ``fuzz``'s randomized faults inside
     ``scn``'s environment."""
     return dataclasses.replace(fuzz, scenario=scn)
+
+
+def apply_switch(cfg, scn: Scenario):
+    """Fold a scenario's SwitchChurn into a SimConfig's static
+    ``sw_down_*`` knobs (the sim half of the switchnet event
+    compilation: the kernel evaluates the churn schedule from its
+    static config on the traced step index, so a trace's ``sim_cfg``
+    meta pins the churn schedule exactly like the geometry).  No-op
+    for scenarios without switch events."""
+    if scn.switch is None:
+        return cfg
+    sw = scn.switch
+    return cfg.with_(sw_down_start=sw.start, sw_down_period=sw.period,
+                     sw_down_for=sw.down_for)
 
 
 def seq_schedule_of(scn: Scenario, ids: Sequence, n_steps: int):
@@ -104,8 +118,25 @@ SHRINK_GROW5 = Scenario(
                               (40, (0, 1, 2)),
                               (90, (0, 1, 2, 3, 4)))))
 
+# switchnet sequencer churn: periodic failover windows (stamping and
+# in-network votes pause, session bumps at each window end) — the
+# in-fabric tier's ordered-multicast stress axis; and a single
+# switch failover mid-epoch under the wan3z matrix (the combined
+# "does the fall-back path carry across the handover" case)
+SEQ_CHURN = Scenario(
+    name="seqchurn",
+    switch=SwitchChurn(start=20, period=40, down_for=12))
+
+WAN3Z_SWITCH = Scenario(
+    name="wan3z_switch", n_zones=3,
+    zones=ZoneLatency(matrix=((1, 3, 5),
+                              (3, 1, 3),
+                              (5, 3, 1)), jitter=1),
+    switch=SwitchChurn(start=40, period=0, down_for=20))
+
 NAMED: Dict[str, Scenario] = {s.name: s for s in (
-    WAN3Z, WAN2Z, CHURN, WAN3Z_CHURN, ZONE_FLAP, SHRINK_GROW5)}
+    WAN3Z, WAN2Z, CHURN, WAN3Z_CHURN, ZONE_FLAP, SHRINK_GROW5,
+    SEQ_CHURN, WAN3Z_SWITCH)}
 
 
 def named_scenario(name: str) -> Scenario:
@@ -145,4 +176,6 @@ def describe(scn: Scenario) -> Dict:
                                       in scn.reconfig.epochs]}
     if scn.outages:
         out["outages"] = [dataclasses.asdict(o) for o in scn.outages]
+    if scn.switch is not None:
+        out["switch"] = dataclasses.asdict(scn.switch)
     return out

@@ -77,3 +77,30 @@ def crashed_plane(scn: Scenario, n: int, n_steps: int) -> np.ndarray:
     so both runtimes consume ONE kill schedule definition."""
     return np.stack([np.asarray(forced_crash(scn, t, n))
                      for t in range(n_steps)])
+
+
+# ---- switchnet sequencer-churn schedule ---------------------------------
+# One arithmetic definition for both runtimes: the host tier
+# (switchnet/switch.py) consumes these directly per logical step, the
+# sim kernel (protocols/switchpaxos/sim.py) evaluates the SAME
+# expressions on a traced step index via switchnet.plane — pinned
+# against each other by a cross-runtime test.
+
+def switch_down_at(start: int, period: int, down_for: int, t: int) -> bool:
+    """Is the switch's sequencer down at step ``t``?  ``period=0`` is a
+    single failover window [start, start + down_for)."""
+    if start < 0 or down_for < 1 or t < start:
+        return False
+    phase = (t - start) % period if period else (t - start)
+    return phase < down_for
+
+
+def switch_session_at(start: int, period: int, down_for: int,
+                      t: int) -> int:
+    """Ordered-multicast session epoch at step ``t``: bumps at each
+    down-window END (the failover completing on the standby)."""
+    if start < 0 or down_for < 1 or t < start + down_for:
+        return 0
+    if not period:
+        return 1
+    return 1 + (t - start - down_for) // period

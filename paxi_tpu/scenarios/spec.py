@@ -72,6 +72,23 @@ class ZoneOutage:
 
 
 @dataclass(frozen=True)
+class SwitchChurn:
+    """Sequencer churn / switch failover for the in-fabric consensus
+    tier (paxi_tpu/switchnet): the switch's sequencer is down during
+    steps ``[start + k*period, start + k*period + down_for)`` and each
+    window END bumps the ordered-multicast session epoch (the failover
+    completing on the standby).  ``period=0`` is a single mid-epoch
+    failover window.  Acceptor register state PERSISTS across failovers
+    (the controller migrates the bounded register file); only voting
+    and sequence stamping pause, so the protocol rides its replica
+    fall-back path through the window."""
+
+    start: int = 10
+    period: int = 0      # steps between window starts (0: one window)
+    down_for: int = 8    # steps each window lasts
+
+
+@dataclass(frozen=True)
 class Reconfig:
     """Membership epochs: ``epochs[k] = (step, live_replica_ids)`` —
     from ``step`` until the next epoch's step, replicas outside the
@@ -93,6 +110,9 @@ class Scenario:
     churn: Optional[LeaderChurn] = None
     reconfig: Optional[Reconfig] = None
     outages: Tuple[ZoneOutage, ...] = field(default_factory=tuple)
+    # in-fabric consensus tier events (only meaningful for protocols
+    # speaking through paxi_tpu/switchnet; others ignore it)
+    switch: Optional[SwitchChurn] = None
 
     # ---- static shape the sim needs ------------------------------------
     def max_latency(self) -> int:
@@ -152,6 +172,16 @@ class Scenario:
                     raise ValueError(
                         f"scenario {self.name!r}: epoch @{t} names a "
                         f"replica outside 0..{n_replicas - 1}")
+        if self.switch is not None:
+            sw = self.switch
+            if sw.start < 0 or sw.down_for < 1 or sw.period < 0:
+                raise ValueError(f"scenario {self.name!r}: switch churn "
+                                 "needs start >= 0, down_for >= 1 and "
+                                 "period >= 0")
+            if sw.period and sw.down_for > sw.period:
+                raise ValueError(f"scenario {self.name!r}: switch "
+                                 f"down_for={sw.down_for} must be <= "
+                                 f"period={sw.period}")
         for o in self.outages:
             if o.zone < 0 or o.zone >= Z:
                 raise ValueError(f"scenario {self.name!r}: outage zone "
@@ -180,10 +210,13 @@ class Scenario:
             for t, live in rc["epochs"])) if rc else None)
         outages = tuple(ZoneOutage(**{k: int(v) for k, v in o.items()})
                         for o in d.get("outages", ()))
+        sw = d.get("switch")
+        switch = SwitchChurn(**{k: int(v) for k, v in sw.items()}) \
+            if sw else None
         return Scenario(name=str(d.get("name", "scenario")),
                         n_zones=int(d.get("n_zones", 1)),
                         zones=zones, churn=churn, reconfig=reconfig,
-                        outages=outages)
+                        outages=outages, switch=switch)
 
 
 def zone_of(n_replicas: int, n_zones: int):

@@ -60,6 +60,21 @@ class SimConfig:
     grid_rows: int = 2
     grid_cols: int = 2
     batch_max: int = 4
+    # switchnet in-fabric consensus tier (paxi_tpu/switchnet): the
+    # switchpaxos kernel mirrors the programmable-switch acceptor +
+    # NOPaxos-style sequencer as carry planes.  ``sw_window`` is the
+    # switch's bounded per-slot register file (fixed size, no heap —
+    # slots outside it overflow to the replica fall-back path);
+    # ``sw_down_*`` is the sequencer-churn schedule compiled from a
+    # Scenario's SwitchChurn (scenarios/compile.apply_switch): during
+    # down windows the switch neither votes nor stamps (register state
+    # persists — the failover model migrates it), and each window end
+    # bumps the ordered-multicast session epoch.  Static, so the same
+    # trace meta that pins the geometry pins the churn schedule.
+    sw_window: int = 16
+    sw_down_start: int = -1    # first down window start (-1: never)
+    sw_down_period: int = 0    # steps between window starts (0: one-shot)
+    sw_down_for: int = 0       # steps each window lasts
 
     @property
     def majority(self) -> int:

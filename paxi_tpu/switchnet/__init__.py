@@ -1,0 +1,28 @@
+"""In-fabric consensus tier: switch acceptors + ordered multicast.
+
+A programmable in-network tier the virtual-clock fabric interposes on
+the wire ("Paxos Made Switch-y" / "Network Hardware-Accelerated
+Consensus" / NOPaxos, PAPERS.md): a ``SwitchAcceptor`` with bounded
+register state votes on P2a frames in flight — the leader commits
+after ONE fabric delivery instead of a round trip — and a ``Sequencer``
+stamps ordered-multicast frames with monotone (session, sequence)
+pairs so replicas only DETECT drops (gap-agreement slow path, session
+bump on sequencer failover).
+
+Two halves, one contract (pinned by hunt's cross-runtime check):
+
+- ``switchnet/switch.py`` — the host tier ``VirtualClockFabric``
+  installs via ``install_switch``;
+- ``switchnet/plane.py`` — the same register file as lane-major scan
+  carry planes for the ``protocols/switchpaxos`` sim kernel.
+
+See README "In-network consensus" for the commit-path diagrams and
+the failover taxonomy.
+"""
+
+from paxi_tpu.switchnet.switch import (Sequencer, SwitchAcceptor,
+                                       SwitchSnap, SwitchTier,
+                                       SwitchVote)
+
+__all__ = ["SwitchAcceptor", "Sequencer", "SwitchTier", "SwitchVote",
+           "SwitchSnap"]

@@ -1,0 +1,275 @@
+"""Sim mirror of the in-fabric consensus tier: switch-acceptor
+registers + NOPaxos-style sequencer as lane-major carry planes.
+
+The host runtime interposes a ``SwitchTier`` (switchnet/switch.py) on
+the virtual-clock fabric's wire; the sim runtime cannot interpose on
+its lock-step exchange, so the switch lives IN THE SCAN CARRY instead:
+a frame "passes through the switch" at the step its outbox is built
+(the switch sits mid-fabric, before the delay wheel), and the vote it
+casts becomes visible to the leader at the NEXT step — exactly one
+fabric delivery, where the classic P2a->P2b round trip costs two.
+That one-step visibility is free: a kernel step reads the PREVIOUS
+step's state planes by construction.
+
+Register-state contract (mirrored bit-for-bit by the host tier):
+
+- **bounded**: a fixed ``cfg.sw_window`` register file per group —
+  ``sw_vbal``/``sw_vcmd``/``sw_reg_seq`` over absolute slots
+  ``[sw_base, sw_base + W)`` plus the scalar promise ``sw_bal`` and
+  sequencer counter ``sw_seq``.  No heap, no per-slot maps.
+- **overflow -> replicas**: a frame whose slot falls outside the file
+  gets no vote and no stamp; the leader falls back to the classic
+  majority-P2b path (which always runs underneath).
+- **eviction is execution-gated**: ``sw_base`` advances only past
+  ``min_r execute`` — a register recycles only once EVERY replica has
+  executed (hence durably committed) past its slot, so a fast-path
+  commit can never be evicted into thin air.
+- **recovery reads the registers**: a phase-1 winner folds the
+  register file into its log before the P1b merge
+  (``recovery_fold``), so the in-network write quorum {switch}
+  intersects every recovery quorum by construction — the obligation
+  paxi-lint's PXQ505 pins statically.
+- **sequencer churn** (scenario ``SwitchChurn``, compiled into the
+  static ``cfg.sw_down_*`` knobs): during a down window the switch
+  neither votes nor stamps (register state and the ballot promise
+  persist — failover migrates the bounded file); each window end
+  bumps the session epoch.  ``down_t``/``session_t`` evaluate the
+  SAME arithmetic as ``scenarios.schedule.switch_down_at`` /
+  ``switch_session_at`` on a traced step index.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paxi_tpu.sim.ring import shift_row, shift_window
+from paxi_tpu.sim.types import SimConfig
+
+NO_CMD = -1   # empty value register (ballot_ring.NO_CMD)
+NO_SEQ = -1   # unstamped frame / empty sequence register
+
+# the switch-plane keys a switchnet kernel carries
+KEYS = ("sw_bal", "sw_base", "sw_vbal", "sw_vcmd", "sw_reg_seq",
+        "sw_seq")
+
+
+def init_planes(cfg: SimConfig, n_groups: int):
+    """Zeroed switch planes (lane-major, group axis last)."""
+    if cfg.sw_window > cfg.n_slots:
+        raise ValueError(
+            f"sw_window={cfg.sw_window} > n_slots={cfg.n_slots}: the "
+            "register file must fit the ring for recovery alignment")
+    W, G = cfg.sw_window, n_groups
+    i32 = jnp.int32
+    return dict(
+        sw_bal=jnp.zeros((G,), i32),          # switch ballot promise
+        sw_base=jnp.zeros((G,), i32),         # abs slot of register 0
+        sw_vbal=jnp.zeros((W, G), i32),       # vote registers: ballot
+        sw_vcmd=jnp.full((W, G), NO_CMD, i32),  # vote registers: value
+        sw_reg_seq=jnp.full((W, G), NO_SEQ, i32),  # stamped seq per slot
+        sw_seq=jnp.zeros((G,), i32),          # next sequence number
+    )
+
+
+# ---- sequencer-churn schedule (static cfg knobs x traced step) ----------
+def down_t(cfg: SimConfig, t):
+    """Traced twin of ``scenarios.schedule.switch_down_at`` on the
+    static ``cfg.sw_down_*`` knobs."""
+    start, period, for_ = (cfg.sw_down_start, cfg.sw_down_period,
+                           cfg.sw_down_for)
+    if start < 0 or for_ < 1:
+        return jnp.zeros((), bool)
+    phase = (t - start) % period if period else (t - start)
+    return (t >= start) & (phase < for_)
+
+
+def session_t(cfg: SimConfig, t):
+    """Traced twin of ``scenarios.schedule.switch_session_at``."""
+    start, period, for_ = (cfg.sw_down_start, cfg.sw_down_period,
+                           cfg.sw_down_for)
+    if start < 0 or for_ < 1:
+        return jnp.zeros((), jnp.int32)
+    ended = t >= start + for_
+    if not period:
+        return ended.astype(jnp.int32)
+    return jnp.where(ended,
+                     1 + (t - start - for_) // period,
+                     0).astype(jnp.int32)
+
+
+# ---- register-file <-> ring alignment -----------------------------------
+def align_to_ring(reg, sw_base, base, n_slots: int, fill):
+    """View a ``(W, G)`` register plane through each replica's ring:
+    ``out[r, i, g] = reg[i + base[r, g] - sw_base[g], g]`` (``fill``
+    outside the file).  Pure pad+shift — no gathers beyond the shared
+    ring primitive."""
+    W, G = reg.shape
+    pad = jnp.full((n_slots - W, G), fill, reg.dtype)
+    row = jnp.concatenate([reg, pad], axis=0)        # (S, G)
+    return shift_row(row, base - sw_base[None, :], fill)
+
+
+# ---- the switch observing the wire --------------------------------------
+def observe_p1a(sw, out_p1a):
+    """Phase-1 passes through the fabric: the switch PROMISES to the
+    highest ballot it carries (so a deposed leader's later frames get
+    no vote) — the prepare-through-the-switch fence.  Promises stay
+    active during down windows (control-plane path), mirroring the
+    host tier."""
+    hi = jnp.max(jnp.where(out_p1a["valid"], out_p1a["bal"], 0),
+                 axis=(0, 1))                          # (G,)
+    return dict(sw, sw_bal=jnp.maximum(sw["sw_bal"], hi))
+
+
+def observe_p2a(sw, out_p2a, cfg: SimConfig, t):
+    """The switch votes on P2a frames in flight and stamps them with
+    the ordered-multicast (session, sequence) pair.
+
+    Frames are broadcast-uniform over the dst axis (propose_write), so
+    the per-src scalars come from dst column 0.  Among simultaneous
+    proposers the switch serves the highest ballot >= its promise —
+    the others pass through unvoted/unstamped (they are stale).  A
+    re-sent frame (same ballot, slot already registered) keeps its
+    ORIGINAL stamp: the register remembers, which is what makes a
+    gap-agreement retransmit carry the sequence number the replicas
+    are waiting on.
+
+    Returns ``(sw', stamp)`` where ``stamp`` carries per-src
+    ``sess``/``seq`` planes ((R, G), ``NO_SEQ`` where unstamped) plus
+    the per-group ``voted`` and ``overflow`` masks."""
+    R = out_p2a["valid"].shape[0]
+    W = sw["sw_vbal"].shape[0]
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    widx = jnp.arange(W, dtype=jnp.int32)
+
+    valid = out_p2a["valid"][:, 0, :]                  # (R, G)
+    bal = out_p2a["bal"][:, 0, :]
+    slot = out_p2a["slot"][:, 0, :]
+    cmd = out_p2a["cmd"][:, 0, :]
+
+    b_in = jnp.where(valid, bal, -1)
+    src = jnp.argmax(b_in, axis=0).astype(jnp.int32)   # (G,)
+    p_bal = jnp.max(b_in, axis=0)
+    p_has = p_bal > 0
+    p_slot = jnp.zeros_like(p_bal)
+    p_cmd = jnp.full_like(p_bal, NO_CMD)
+    for r in range(R):
+        p_slot = jnp.where(src == r, slot[r], p_slot)
+        p_cmd = jnp.where(src == r, cmd[r], p_cmd)
+
+    down = down_t(cfg, t)
+    active = p_has & ~down & (p_bal >= sw["sw_bal"])
+    rel = p_slot - sw["sw_base"]
+    inw = (rel >= 0) & (rel < W)
+    overflow = active & ~inw
+
+    oh = (widx[:, None] == rel[None, :]) & (active & inw)[None, :]
+    upd = oh & (p_bal[None, :] >= sw["sw_vbal"])
+    fresh = upd & ((p_bal[None, :] > sw["sw_vbal"])
+                   | (sw["sw_reg_seq"] < 0))
+    sw_vbal = jnp.where(upd, p_bal[None, :], sw["sw_vbal"])
+    sw_vcmd = jnp.where(upd, p_cmd[None, :], sw["sw_vcmd"])
+    stamp_now = jnp.any(fresh, axis=0)                 # (G,)
+    sw_reg_seq = jnp.where(fresh, sw["sw_seq"][None, :],
+                           sw["sw_reg_seq"])
+    voted = jnp.any(upd, axis=0)                       # (G,)
+    frame_seq = jnp.sum(jnp.where(oh & upd, sw_reg_seq, 0), axis=0)
+    frame_seq = jnp.where(voted, frame_seq, NO_SEQ)
+
+    sess = session_t(cfg, t)
+    is_src = ridx[:, None] == src[None, :]             # (R, G)
+    stamp = {
+        "seq": jnp.where(is_src & voted[None, :], frame_seq[None, :],
+                         NO_SEQ),
+        "sess": jnp.where(is_src & voted[None, :], sess, NO_SEQ),
+        "voted": voted,
+        "overflow": overflow,
+    }
+    sw = dict(sw, sw_bal=jnp.where(active,
+                                   jnp.maximum(sw["sw_bal"], p_bal),
+                                   sw["sw_bal"]),
+              sw_vbal=sw_vbal, sw_vcmd=sw_vcmd, sw_reg_seq=sw_reg_seq,
+              sw_seq=sw["sw_seq"] + stamp_now)
+    return sw, stamp
+
+
+# ---- leader-side fast path + recovery -----------------------------------
+def fast_commit_mask(sw, st, is_leader, n_slots: int):
+    """In-network acceptance: slots whose register holds a vote at MY
+    ballot commit now — the vote was cast when the frame passed the
+    switch last step, so the leader commits after ONE fabric delivery
+    instead of the P2a->P2b round trip.  The value equality guard is
+    belt-and-braces (same ballot implies same proposer and binding)."""
+    al_vbal = align_to_ring(sw["sw_vbal"], sw["sw_base"], st["base"],
+                            n_slots, 0)
+    al_vcmd = align_to_ring(sw["sw_vcmd"], sw["sw_base"], st["base"],
+                            n_slots, NO_CMD)
+    return (is_leader[:, None, :] & st["proposed"] & ~st["log_commit"]
+            & (al_vbal > 0) & (al_vbal == st["ballot"][:, None, :])
+            & (al_vcmd == st["log_cmd"]) & (st["log_cmd"] != NO_CMD))
+
+
+def apply_fast_commits(sw, st, is_leader, n_slots: int):
+    """Apply the in-network acceptances to the leader's log (the
+    write half of ``fast_commit_mask`` — ring-plane writes live here
+    with the rest of the audited switch machinery).  Returns
+    ``(st', newly_fast)``."""
+    newly = fast_commit_mask(sw, st, is_leader, n_slots)
+    return {**st, "log_commit": st["log_commit"] | newly}, newly
+
+
+def gap_reopen(st, oh_gr):
+    """Gap agreement, leader half for in-flight frames: re-open the
+    requested slot for immediate re-proposal (it keeps its original
+    stamp — the register remembers) instead of waiting out
+    ``retry_timeout``."""
+    return {**st,
+            "proposed": st["proposed"] & ~(oh_gr & ~st["log_commit"])}
+
+
+def noop_commit_holes(st, gap, frame_slot, sidx):
+    """THE SEEDED BUG of the ``switchpaxos_nogap`` twin (host twin:
+    protocols/switchpaxos/nogap.py) — never called by the real
+    protocol: on a detected stamp gap, unilaterally NOOP-commit the
+    empty slots below the arriving frame ("the multicast is ordered,
+    so a gap must be a NOOP").  The leader commits real commands
+    there, so committed values diverge across replicas — the
+    classic drop-the-gap-agreement mistake the hunt pipeline must
+    classify REPRODUCED."""
+    NOOP = -2   # ballot_ring.NOOP
+    abs_ = st["base"][:, None, :] + sidx[None, :, None]
+    hole = (gap[:, None, :] & (abs_ < frame_slot[:, None, :])
+            & ~st["log_commit"] & (st["log_cmd"] == NO_CMD)
+            & (abs_ >= st["execute"][:, None, :]))
+    return {**st,
+            "log_cmd": jnp.where(hole, NOOP, st["log_cmd"]),
+            "log_commit": st["log_commit"] | hole}
+
+
+def recovery_fold(sw, st, p1_win, n_slots: int):
+    """Phase-1 win: fold the register file into the winner's own log
+    planes BEFORE the P1b merge, so a value committed via the
+    in-network vote alone (register is its only durable copy until
+    replicas execute past it) is visible to the merge at the switch's
+    ballot.  This is the {switch} x recovery quorum intersection —
+    skipping it is exactly the lost-fast-commit bug PXQ505 flags."""
+    al_vbal = align_to_ring(sw["sw_vbal"], sw["sw_base"], st["base"],
+                            n_slots, 0)
+    al_vcmd = align_to_ring(sw["sw_vcmd"], sw["sw_base"], st["base"],
+                            n_slots, NO_CMD)
+    upd = (p1_win[:, None, :] & (al_vbal > st["log_bal"])
+           & (al_vbal > 0) & ~st["log_commit"])
+    return {**st,
+            "log_bal": jnp.where(upd, al_vbal, st["log_bal"]),
+            "log_cmd": jnp.where(upd, al_vcmd, st["log_cmd"])}
+
+
+def evict(sw, execute):
+    """Slide the register file past the slowest replica's execute
+    frontier (the execution-gated eviction rule: module docstring)."""
+    min_exec = jnp.min(execute, axis=0)                # (G,)
+    adv = jnp.clip(min_exec - sw["sw_base"], 0, None)
+    return dict(sw, sw_base=sw["sw_base"] + adv,
+                sw_vbal=shift_window(sw["sw_vbal"], adv, 0),
+                sw_vcmd=shift_window(sw["sw_vcmd"], adv, NO_CMD),
+                sw_reg_seq=shift_window(sw["sw_reg_seq"], adv, NO_SEQ))

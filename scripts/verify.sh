@@ -13,9 +13,12 @@
 #                                # (5 s chan bench + /metrics scrape)
 #   scripts/verify.sh --hunt     # prepend the divergence-hunt smoke
 #                                # stage: a micro-campaign (paxos +
-#                                # abd + bpaxos + the fragile_counter
-#                                # positive control) that must end with
-#                                # zero UNCLASSIFIED outcomes
+#                                # abd + bpaxos + switchpaxos + the
+#                                # fragile_counter / relay_churn /
+#                                # switchpaxos_nogap positive controls)
+#                                # that must end with zero UNCLASSIFIED
+#                                # outcomes AND a REPRODUCED verdict
+#                                # for the switchnet nogap twin
 #   scripts/verify.sh --bench    # prepend the bench smoke stage: a
 #                                # tiny-shape CPU-mesh bench.py run
 #                                # (seconds) whose artifact line must
@@ -126,6 +129,36 @@ print(f"bpaxos bench smoke OK: {slots} slots / {cmds} cmds "
       f"({cmds / slots:.2f}x amortization), violations=0, "
       f"inscan_violations=0, lat samples={int(res.latency_hist.sum())}")
 PYEOF
+    echo "== bench smoke (switchpaxos in-fabric tier vs paxos, wan3z) =="
+    # the in-network acceptance claim at a toy shape: same geometry,
+    # same wan3z scenario, same seed — the switch-accepted commit-
+    # latency p50 must sit strictly below the software baseline (a
+    # full round below, in fact), with the oracle clean and the
+    # switchnet row schema intact
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'PYEOF' || exit $?
+from paxi_tpu.protocols import sim_protocol
+from paxi_tpu.scenarios import compile as scn
+from paxi_tpu.sim import FuzzConfig, SimConfig, simulate
+geo = scn.with_scenario(FuzzConfig(), scn.WAN3Z)
+cfg = SimConfig(n_replicas=3, n_slots=32)
+base = simulate(sim_protocol("paxos"), cfg, 16, 100, fuzz=geo, seed=0)
+fast = simulate(sim_protocol("switchpaxos"), cfg, 16, 100, fuzz=geo,
+                seed=0)
+assert int(fast.violations) == 0, int(fast.violations)
+assert fast.inscan_violations == 0, fast.inscan_violations
+for k in ("fast_commits", "gap_events", "sw_overflows",
+          "commit_lat_sum", "commit_lat_n"):
+    assert k in fast.metrics, k
+assert int(fast.metrics["fast_commits"]) > 0, "fast path never fired"
+lp, ls = base.latency_summary(), fast.latency_summary()
+assert ls["n"] > 0 and lp["n"] > 0, (ls, lp)
+assert ls["p50_rounds"] < lp["p50_rounds"], (ls, lp)
+assert ls["p50_rounds"] <= lp["p50_rounds"] - 1.0, (ls, lp)
+print(f"switchpaxos bench smoke OK: p50 {ls['p50_rounds']} vs paxos "
+      f"{lp['p50_rounds']} rounds under wan3z "
+      f"({int(fast.metrics['fast_commits'])} fast commits, "
+      "inscan_violations=0)")
+PYEOF
   elif [ "$1" = "--hunt" ]; then
     shift
     echo "== hunt micro-campaign (paxi_tpu/hunt/) =="
@@ -136,11 +169,27 @@ PYEOF
     # wan3z latency matrix on its second schedule) must produce
     # witnesses that classify — the churn twin shares its seeded bugs
     # across runtimes, so they land REPRODUCED
+    # switchpaxos + its nogap twin are the in-fabric tier's
+    # micro-campaign: the twin's drop witnesses MUST classify
+    # REPRODUCED through the fabric + replayed switch tier (asserted
+    # on the report below), the real protocol must stay quiet
     HUNT_DIR=$(mktemp -d /tmp/paxi_hunt_smoke.XXXXXX)
-    timeout -k 10 480 env JAX_PLATFORMS=cpu python -m paxi_tpu hunt run \
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m paxi_tpu hunt run \
       --budget 2 --quick \
-      --protocols paxos,abd,bpaxos,fragile_counter,relay_churn \
+      --protocols paxos,abd,bpaxos,fragile_counter,relay_churn,switchpaxos,switchpaxos_nogap \
       --dir "$HUNT_DIR" --traces-dir "$HUNT_DIR/noseed" || exit $?
+    HUNT_DIR="$HUNT_DIR" python - <<'PYEOF' || exit $?
+import json, os
+with open(os.path.join(os.environ["HUNT_DIR"], "HUNT_REPORT.json")) as f:
+    rep = json.load(f)
+per = rep["summary"]["protocols"]
+tw = per["switchpaxos_nogap"]
+assert tw["witnesses"] > 0, "nogap twin produced no witnesses"
+assert tw["reproduced"] > 0, f"nogap twin never REPRODUCED: {tw}"
+assert per["switchpaxos"]["violations"] == 0, per["switchpaxos"]
+print(f"switchpaxos micro-campaign OK: twin {tw['reproduced']} "
+      f"reproduced / {tw['witnesses']} witnesses, real protocol clean")
+PYEOF
     rm -rf "$HUNT_DIR"
   elif [ "$1" = "--lint" ]; then
     shift

@@ -1,0 +1,27 @@
+# Seeded switchnet-recovery (PXQ505) violation, host form, for
+# tests/test_lint.py.  Parsed only, never imported.  A replica that
+# commits on the switch's in-network vote (SwitchVote handler) but
+# never registers the SwitchSnap register read — its elections merge
+# P1b logs only, so a vote-only commit is lost across failover.
+
+from paxi_tpu.switchnet import SwitchSnap, SwitchVote  # noqa: F401
+
+
+class BlindReplica:
+    def __init__(self, id, cfg):
+        self.log = {}
+        self.ballot = 0
+        self.active = True
+        self.register(SwitchVote, self.handle_switch_vote)
+        # BUG: no self.register(SwitchSnap, ...) — recovery is blind
+        # to the register file
+
+    def register(self, cls, fn):
+        pass
+
+    def handle_switch_vote(self, m):
+        if m.ballot != self.ballot:
+            return
+        e = self.log.get(m.slot)
+        if e is not None and not e.commit:
+            e.commit = True

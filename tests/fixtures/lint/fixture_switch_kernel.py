@@ -1,0 +1,30 @@
+# Seeded switchnet-recovery (PXQ505) violation for tests/test_lint.py.
+# Parsed only, never imported.  A sim kernel that commits on the
+# in-network vote (apply_fast_commits) but never folds the register
+# file into recovery (no recovery_fold on the phase-1 win path) — the
+# lost-fast-commit bug: a value whose only durable copy is the bounded
+# register file vanishes across leader failover.  The MAJ alias keeps
+# the classic fall-back pair enumerable (PXQ503 machinery).
+
+from paxi_tpu.sim import ballot_ring as br
+from paxi_tpu.switchnet import plane as swp
+
+
+def mailbox_spec(cfg):
+    return {"p2a": ("bal", "slot", "cmd")}
+
+
+def step(state, inbox, ctx):
+    cfg = ctx.cfg
+    MAJ = cfg.majority
+    st = {k: state[k] for k in br.KEYS}
+    sw = {k: state[k] for k in swp.KEYS}
+    st, p1_win, amask = br.tally_p1b(st, inbox["p1b"], MAJ,
+                                     cfg.ballot_stride)
+    # BUG: no swp.recovery_fold(sw, st, p1_win, ...) before the merge
+    st = br.merge_acker_logs(st, amask, p1_win)
+    is_leader = st["active"]
+    st, newly_fast = swp.apply_fast_commits(sw, st, is_leader,
+                                            cfg.n_slots)
+    st, newly = br.tally_p2b(st, inbox["p2b"], MAJ, cfg.ballot_stride)
+    return dict(st, **sw), {}

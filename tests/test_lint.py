@@ -208,6 +208,25 @@ def test_quorum_strict_fractional_threshold(tmp_path):
     assert "n=2" in vs[0].message and "1+1 <= 2" in vs[0].message
 
 
+def test_quorum_switchnet_recovery_obligation():
+    """PXQ505 (the in-fabric tier, paxi_tpu/switchnet): a fast-path
+    commit without the register read on the recovery path — sim form
+    (apply_fast_commits without recovery_fold) and host form
+    (SwitchVote handler without a SwitchSnap handler) — is the
+    lost-fast-commit bug; both seeded mutants must fire, and the real
+    switchpaxos modules (which fold/read) must stay clean."""
+    vs = quorum.check(ROOT, files=[FIX / "fixture_switch_kernel.py"])
+    assert [v.code for v in vs] == ["PXQ505"]
+    assert "recovery_fold" in vs[0].message
+    vs = quorum.check(ROOT, files=[FIX / "fixture_switch_host.py"])
+    assert [v.code for v in vs] == ["PXQ505"]
+    assert "SwitchSnap" in vs[0].message
+    clean = quorum.check(ROOT, files=[
+        ROOT / "paxi_tpu/protocols/switchpaxos/sim.py",
+        ROOT / "paxi_tpu/protocols/switchpaxos/host.py"])
+    assert clean == []
+
+
 def test_quorum_repo_tree_is_clean():
     # every protocol's quorum pairs provably intersect (tier-1 pin)
     assert quorum.check(ROOT) == []
@@ -564,6 +583,7 @@ def test_crossflow_repo_clean_and_covers_all_five_kernels():
     assert set(br["consumers"]) == {
         "paxi_tpu/protocols/paxos/sim.py",
         "paxi_tpu/protocols/sdpaxos/sim.py",
+        "paxi_tpu/protocols/switchpaxos/sim.py",
         "paxi_tpu/protocols/wankeeper/sim.py",
     }
     # the cross-module proofs name all three importing kernels

@@ -1,0 +1,247 @@
+"""The in-fabric consensus tier on the host runtime: switchnet
+register/sequencer units, fabric interposition, and the switchpaxos
+replica's fast-commit / gap-agreement / recovery paths — all on the
+virtual-clock fabric, so every case is a deterministic logical-step
+replay (no wall clocks).
+
+The sequencer-contract satellites live here: two-replay byte-identical
+sequence stamps, gap agreement under a mid-epoch sequencer kill, and
+the register-overflow fall-back — each driven through capturable
+``SeqSchedule``s on the fabric."""
+
+import asyncio
+
+import pytest
+
+from paxi_tpu.core.command import Command, Request
+from paxi_tpu.host.fabric import VirtualClockFabric
+from paxi_tpu.host.history import History
+from paxi_tpu.host.simulation import Cluster, chan_config
+from paxi_tpu.scenarios.schedule import (switch_down_at,
+                                         switch_session_at)
+from paxi_tpu.scenarios.spec import SwitchChurn
+from paxi_tpu.switchnet import SwitchAcceptor, SwitchTier
+from paxi_tpu.trace.host import SeqFault, SeqSchedule
+
+pytestmark = pytest.mark.host
+
+
+# ---- switchnet units ----------------------------------------------------
+def test_acceptor_promise_vote_overflow_evict():
+    acc = SwitchAcceptor(window=4)
+    # vote in window, at/above the promise
+    r = acc.vote(10, 2, ["a"])
+    assert r is not None and r.vbal == 10 and r.vcmd == ["a"]
+    # stale ballot after a higher promise: no vote
+    acc.promise(20)
+    assert acc.vote(15, 3, ["b"]) is None
+    # overflow: outside [base, base+W) falls back to the replicas
+    assert acc.vote(30, 99, ["c"]) is None
+    assert acc.overflows == 1
+    # higher ballot overwrites the register and clears its stamp
+    r.seq = 7
+    r2 = acc.vote(30, 2, ["d"])
+    assert r2 is r and r2.vbal == 30 and r2.vcmd == ["d"]
+    assert r2.seq == -1
+    # execution-gated eviction slides the file and recycles registers
+    acc.evict(2)
+    assert acc.base == 2 and acc.reg_at(2).vbal == 30
+    acc.evict(10)   # a jump past the whole file
+    assert acc.base == 10 and acc.snapshot() == {}
+
+
+def test_tier_stamps_once_and_dedups_broadcast_copies():
+    tier = SwitchTier(window=8)
+
+    class Frame:
+        switchnet_role = "p2a"
+
+        def __init__(self, ballot, slot):
+            self.ballot, self.slot = ballot, slot
+            self.cmds = [["k", b"v", "c", 1]]
+            self.sess = self.seq = -1
+
+    f = Frame(5, 0)
+    inj = tier.on_send(0, "1.1", "1.2", f)       # first copy: vote
+    assert len(inj) == 1 and inj[0][0] == "1.1"
+    assert (f.sess, f.seq) == (0, 0)
+    assert tier.on_send(0, "1.1", "1.3", f) == []  # same frame: dedup
+    # a later retransmit keeps its ORIGINAL stamp, no second vote
+    f2 = Frame(5, 0)
+    assert tier.on_send(3, "1.1", "1.2", f2) == []
+    assert f2.seq == 0
+    # the next frame gets the next sequence number
+    g = Frame(5, 1)
+    tier.on_send(1, "1.1", "1.2", g)
+    assert g.seq == 1
+    assert [s[2] for s in tier.stamp_log] == [0, 1]
+
+
+def test_tier_down_windows_and_session_bumps():
+    churn = SwitchChurn(start=4, period=10, down_for=3)
+    tier = SwitchTier(window=8, churn=churn)
+    assert not tier.down(3) and tier.down(4) and tier.down(6)
+    assert not tier.down(7)
+    assert tier.session(6) == 0 and tier.session(7) == 1
+    assert tier.session(17) == 2
+
+    class Frame:
+        switchnet_role = "p2a"
+
+        def __init__(self, slot):
+            self.ballot, self.slot = 5, slot
+            self.cmds = []
+            self.sess = self.seq = -1
+
+    f = Frame(0)
+    assert tier.on_send(5, "1.1", "1.2", f) == []   # down: pass through
+    assert f.seq == -1 and tier.stats["passed_down"] == 1
+    g = Frame(0)
+    inj = tier.on_send(8, "1.1", "1.2", g)          # back up: session 1
+    assert len(inj) == 1 and g.sess == 1 and g.seq == 0
+
+
+def test_switch_schedule_python_arms_match():
+    """The two churn-arithmetic definitions (host tier / validation
+    edge cases): single-window (period=0) and periodic forms."""
+    for t in range(30):
+        assert switch_down_at(5, 0, 4, t) == (5 <= t < 9)
+        assert switch_session_at(5, 0, 4, t) == (1 if t >= 9 else 0)
+    assert switch_session_at(-1, 10, 4, 50) == 0
+    assert not switch_down_at(-1, 10, 4, 5)
+
+
+# ---- the switchpaxos replica on the fabric ------------------------------
+def run_cluster(sched, *, tier=None, n=3, ops_every=2, n_steps=30,
+                protocol="switchpaxos"):
+    """Boot a switchpaxos cluster on the virtual-clock fabric with the
+    tier interposed, drive a deterministic KV workload, return
+    (cluster stats, tier, history anomalies, fabric)."""
+    async def main():
+        fab = VirtualClockFabric(sched)
+        t = tier if tier is not None else SwitchTier(window=16,
+                                                     n_replicas=n)
+        fab.install_switch(t)
+        cfg = chan_config(n, tag="swx")
+        c = Cluster(protocol, cfg=cfg, http=False, fabric=fab)
+        await c.start()
+        history = History()
+        ids = sorted(c.ids)
+        ops = []
+
+        async def one_op(replica, key, value, i):
+            fut = asyncio.get_running_loop().create_future()
+            c[replica].handle_client_request(Request(
+                command=Command(key, value, "t", i), reply_to=fut))
+            try:
+                rep = await asyncio.wait_for(fut, 5.0)
+            except asyncio.TimeoutError:
+                return
+            if rep.err is None and value:
+                history.add(key, value, None, i, i + 0.5)
+
+        def issue(t_):
+            if t_ % ops_every:
+                return
+            i = t_ // ops_every
+            replica = ids[i % len(ids)]
+            ops.append(asyncio.ensure_future(
+                one_op(replica, i % 4, b"w%d" % t_, i)))
+
+        fab.on_step(issue)
+        await fab.run(n_steps, drain=True)
+        fab.sched = None
+        await fab.run(10, drain=True)
+        if ops:
+            await asyncio.wait(ops, timeout=5.0)
+        from paxi_tpu.protocols.switchpaxos.host import HUNT_ORACLE
+        out = {
+            "anomalies": history.linearizable(),
+            "oracle": HUNT_ORACLE(c),
+            "fast_commits": {str(i): c[i].fast_commits for i in c.ids},
+            "gap_events": sum(c[i].gap_events for i in c.ids),
+            "commits": max(c[i].execute for i in c.ids),
+        }
+        await c.stop()
+        return out, t, list(fab.delivery_log)
+    return asyncio.run(main())
+
+
+def test_fast_path_commits_through_switch_votes():
+    out, tier, _ = run_cluster(SeqSchedule(n_steps=30))
+    assert out["anomalies"] == 0 and out["oracle"] == 0
+    assert out["commits"] > 0
+    assert tier.stats["votes"] > 0
+    # the leader commits on votes, not on the P2b round trip
+    assert sum(out["fast_commits"].values()) > 0
+    assert out["gap_events"] == 0
+
+
+def test_ordered_multicast_two_replays_byte_identical_stamps():
+    """The sequencer determinism contract: two replays of one schedule
+    produce byte-identical stamp logs and delivery logs."""
+    runs = []
+    for _ in range(2):
+        sched = SeqSchedule(n_steps=24, faults=[
+            SeqFault("1.1", "1.2", "OmP2a", occurrence=2,
+                     action="delay", delay_steps=2)])
+        runs.append(run_cluster(sched))
+    (out_a, tier_a, log_a), (out_b, tier_b, log_b) = runs
+    assert tier_a.stamp_log == tier_b.stamp_log
+    assert len(tier_a.stamp_log) > 0
+    assert log_a == log_b                      # matching commit order
+    assert out_a["anomalies"] == out_b["anomalies"] == 0
+
+
+def test_gap_agreement_heals_dropped_frames():
+    """Drop ordered-multicast frames to one replica: the stamp gap
+    triggers GapReq -> retransmit, and the run stays safe."""
+    # drop the frames AND their commit spreads: without the P3s the
+    # replica's only drop signal is the stamp gap
+    sched = SeqSchedule(n_steps=40, faults=[
+        SeqFault("1.1", "1.2", mt, occurrence=k, action="drop")
+        for k in range(2, 5) for mt in ("OmP2a", "OmP3")])
+    out, tier, _ = run_cluster(sched, n_steps=40)
+    assert out["gap_events"] > 0
+    assert out["anomalies"] == 0 and out["oracle"] == 0
+    assert out["commits"] > 0
+
+
+def test_gap_agreement_under_mid_epoch_sequencer_kill():
+    """The satellite case: a sequencer failover mid-epoch (down window
+    + session bump) while frames are also dropping — the fall-back
+    path carries the down window, the session bump resyncs expect,
+    and the oracles stay clean."""
+    tier = SwitchTier(window=16, n_replicas=3,
+                      churn=SwitchChurn(start=10, period=0, down_for=8))
+    sched = SeqSchedule(n_steps=50, faults=[
+        SeqFault("1.1", "1.3", "OmP2a", occurrence=k, action="drop")
+        for k in range(1, 4)])
+    out, tier, _ = run_cluster(sched, tier=tier, n_steps=50)
+    assert out["anomalies"] == 0 and out["oracle"] == 0
+    assert out["commits"] > 0
+    assert tier.stats["passed_down"] > 0       # the window really hit
+    assert any(s[1] == 1 for s in tier.stamp_log), \
+        "no frame stamped in the post-failover session"
+
+
+def test_register_overflow_falls_back_to_majority():
+    """A one-slot register file: almost every frame overflows, yet the
+    classic majority path keeps committing (the bounded-register
+    contract's fall-back half)."""
+    tier = SwitchTier(window=1, n_replicas=3)
+    out, tier, _ = run_cluster(SeqSchedule(n_steps=30), tier=tier)
+    assert out["anomalies"] == 0 and out["oracle"] == 0
+    assert out["commits"] > 0
+    assert tier.acceptor.overflows > 0
+
+
+def test_nogap_twin_diverges_on_host_too():
+    """The seeded twin's host half: the same drop schedule that is
+    safe on the real replica diverges committed slots on the twin."""
+    sched = SeqSchedule(n_steps=40, faults=[
+        SeqFault("1.1", "1.2", mt, occurrence=k, action="drop")
+        for k in range(2, 5) for mt in ("OmP2a", "OmP3")])
+    out, _, _ = run_cluster(sched, n_steps=40,
+                            protocol="switchpaxos_nogap")
+    assert out["oracle"] > 0
