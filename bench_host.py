@@ -35,6 +35,11 @@ CONFIGS = [
     ("wankeeper", 6, 2, True),
     ("dynamo", 3, 1, False),
     ("blockchain", 3, 1, False),
+    # the in-fabric consensus tier's host replica (PR 12): with no
+    # switch on the wire it serves as classic paxos over the same
+    # frames — this row is the software-path control for the
+    # switchpaxos open-loop ramp in BENCH_HOST_SATURATION.json
+    ("switchpaxos", 3, 1, True),
 ]
 
 

@@ -89,13 +89,36 @@ def cmd_bench_host(args) -> int:
     multi-core box.
     """
     import os
-    import socket as pysocket
     import subprocess
     import tempfile
-    import time as _time
 
     from paxi_tpu.core.config import local_config
-    from paxi_tpu.host.transport import parse_addr
+    from paxi_tpu.host.transport import wait_listening
+
+    if args.shards:
+        # sharded multi-group serving (paxi_tpu/shard/): G groups of
+        # fleet/G replicas behind the router, the open-loop ramp in
+        # both key-range phases + the 2PC atomicity burst
+        from paxi_tpu.shard.bench import shard_ramp
+        rates = [float(r) for r in args.rates.split(",") if r]
+        out = asyncio.run(shard_ramp(
+            algorithm=args.algorithm, shards=args.shards,
+            fleet=args.shard_fleet, workers=args.shard_workers,
+            rates=rates, step_s=args.step_s, K=args.K, W=args.W,
+            seed=args.seed, base_port=args.base_port,
+            txns=args.txns, lin=not args.no_lin, conns=args.conns,
+            proc=args.cluster_proc))
+        print(json.dumps({k: v for k, v in out.items()
+                          if k != "phases"}))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+        txn = out.get("txn") or {}
+        bad = ((out["anomalies"] or 0) > 0
+               or txn.get("atomicity_violations", 0) > 0
+               or all(s["completed"] == 0
+                      for p in out["phases"] for s in p["steps"]))
+        return 1 if bad else 0
 
     cfg = _load_config(args)
     if not args.config:
@@ -142,15 +165,7 @@ def cmd_bench_host(args) -> int:
             conn.close()
 
     def wait_http(url, timeout_s=20.0):
-        _, host, port = parse_addr(url)
-        t0 = _time.time()
-        while _time.time() - t0 < timeout_s:
-            try:
-                pysocket.create_connection((host, port), 0.5).close()
-                return True
-            except OSError:
-                _time.sleep(0.1)
-        return False
+        return asyncio.run(wait_listening(url, timeout_s=timeout_s))
 
     report = {"protocol": args.algorithm, "replicas": cfg.n,
               "zones": len(cfg.zones()),
@@ -886,6 +901,22 @@ def main(argv=None) -> int:
                     type=int, default=0, help="key-range offset")
     bh.add_argument("-client_tag", "--client-tag", dest="client_tag",
                     default="ol", help="client-id prefix")
+    bh.add_argument("-shards", "--shards", type=int, default=0,
+                    help="sharded mode: run G consensus groups of "
+                         "shard_fleet/G replicas behind the shard "
+                         "router and ramp the open loop against the "
+                         "router endpoint (paxi_tpu/shard/)")
+    bh.add_argument("-shard_fleet", "--shard-fleet",
+                    dest="shard_fleet", type=int, default=12,
+                    help="total replicas partitioned over --shards "
+                         "groups")
+    bh.add_argument("-shard_workers", "--shard-workers",
+                    dest="shard_workers", type=int, default=4,
+                    help="parallel open-loop generator workers "
+                         "(disjoint-then-crossing key ranges)")
+    bh.add_argument("-txns", "--txns", type=int, default=8,
+                    help="cross-shard 2PC transactions fired after "
+                         "the ramp (atomicity oracle)")
     bh.set_defaults(fn=cmd_bench_host)
 
     r = sub.add_parser("cmd", help="admin REPL")

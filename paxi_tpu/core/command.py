@@ -92,6 +92,55 @@ class Reply:
 
 
 TXN_MAGIC = b"\x00txn:"
+# cross-shard 2PC records (paxi_tpu/shard/txn.py): prepare / decide /
+# commit / abort ride the normal replication path as opaque command
+# values, so the PARTICIPANT LOG of a distributed transaction *is*
+# whatever consensus protocol the group runs — one ordered command per
+# 2PC state transition, interpreted by Database._execute_tpc.
+TPC_MAGIC = b"\x002pc:"
+# every value prefix the KV surface must refuse from external clients
+# (a client value carrying either magic would be reinterpreted by the
+# state machine at execute time on every replica)
+RESERVED_PREFIXES = (TXN_MAGIC, TPC_MAGIC)
+
+
+def pack_tpc(kind: str, txid: str, ops=None, outcome: str = "") -> Value:
+    """Encode one 2PC record as an opaque command value.
+
+    ``kind``: ``prepare`` (stage ``ops`` = [(key, value), ...]; empty
+    value = read), ``decide`` (durably fix ``outcome`` in {"c", "a"} —
+    FIRST write wins, the reply reports the winner), ``commit`` /
+    ``abort`` (apply / drop the stage).  The record replicates and
+    totally orders like any write of the group it is sent to."""
+    import json
+    doc = {"kind": kind, "txid": txid}
+    if ops is not None:
+        doc["ops"] = [[int(k), v.decode("latin1")] for k, v in ops]
+    if outcome:
+        doc["outcome"] = outcome
+    return TPC_MAGIC + json.dumps(doc).encode()
+
+
+def unpack_tpc(value: Value):
+    """The 2PC record back out of a packed value, or None for plain
+    values.  Malformed payloads are None (poison-command safety, same
+    contract as unpack_transaction)."""
+    import json
+    if not value.startswith(TPC_MAGIC):
+        return None
+    try:
+        doc = json.loads(value[len(TPC_MAGIC):].decode())
+        kind, txid = doc["kind"], doc["txid"]
+        if kind not in ("prepare", "decide", "commit", "abort") \
+                or not isinstance(txid, str):
+            return None
+        if "ops" in doc:
+            doc["ops"] = [(int(k), v.encode("latin1"))
+                          for k, v in doc["ops"]]
+        return doc
+    except (ValueError, TypeError, KeyError, AttributeError,
+            UnicodeDecodeError):
+        return None
 
 
 def pack_transaction(commands) -> Value:

@@ -12,8 +12,9 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
-from paxi_tpu.core.command import (TXN_MAGIC, Command, Key, Value,
-                                   pack_values, unpack_transaction)
+from paxi_tpu.core.command import (TPC_MAGIC, TXN_MAGIC, Command, Key,
+                                   Value, pack_values, unpack_tpc,
+                                   unpack_transaction)
 
 
 class Database:
@@ -25,6 +26,15 @@ class Database:
         self._multi_version = multi_version
         self._lock = threading.RLock()
         self._version = 0
+        # cross-shard 2PC participant state (paxi_tpu/shard/txn.py):
+        # every replica of a group executes the same ordered prepare/
+        # decide/commit/abort records, so these dicts evolve
+        # deterministically across the group — the participant log IS
+        # the group's consensus log.  ``_staged``: txid -> staged ops;
+        # ``_decided``: txid -> "c"|"a", FIRST decide record wins (the
+        # coordinator-recovery tiebreak rides on log order).
+        self._staged: Dict[str, list] = {}
+        self._decided: Dict[str, str] = {}
 
     def execute(self, cmd: Command) -> Value:
         """Apply a command; returns the PREVIOUS value (read for gets,
@@ -35,6 +45,10 @@ class Database:
         the packed previous values — this is how transactions replicate:
         as one ordered command through whatever protocol runs."""
         with self._lock:
+            if cmd.value.startswith(TPC_MAGIC):
+                rec = unpack_tpc(cmd.value)
+                if rec is not None:
+                    return self._execute_tpc(rec)
             batch = unpack_transaction(cmd.value) if cmd.value else None
             if batch is not None:
                 return pack_values(self.execute_transaction(batch))
@@ -67,7 +81,7 @@ class Database:
                     if last is not None and cmd.command_id <= last[0]:
                         continue   # duplicate: already executed
                 v = cmd.value
-                if self._multi_version:
+                if self._multi_version or v.startswith(TPC_MAGIC):
                     out = self.execute(cmd)
                 elif v.startswith(TXN_MAGIC):
                     batch = unpack_transaction(v)
@@ -99,7 +113,8 @@ class Database:
             out = []
             for c in commands:
                 v = c.value
-                if self._multi_version or v.startswith(TXN_MAGIC):
+                if self._multi_version or v.startswith(TXN_MAGIC) \
+                        or v.startswith(TPC_MAGIC):
                     out.append(self.execute(c))
                     continue
                 prev = data.get(c.key, b"")
@@ -108,6 +123,71 @@ class Database:
                     self._version += 1
                 out.append(prev)
             return out
+
+    def _execute_tpc(self, rec: dict) -> Value:
+        """Apply one cross-shard 2PC record (shard/txn.py taxonomy);
+        caller holds the lock.  Deterministic and idempotent per kind,
+        so duplicate records (retries, leader-change re-proposals)
+        converge at every replica:
+
+        - ``prepare``: stage the ops unless a key is staged by another
+          in-flight txn (vote NO — the conflict-abort that gives 2PC
+          its txn-txn isolation).  Reply ``yes:`` + packed
+          prepare-point previous values, or ``no``.
+        - ``decide``: record the outcome ONCE; the reply is the
+          winning outcome, so a racing coordinator/recovery learns the
+          truth from its own (ordered) decide record.
+        - ``commit``: apply the staged writes atomically, drop the
+          stage.  ``abort``: drop the stage.
+
+        The RLock re-enters for free under execute()'s hold; taking
+        it here keeps the method safe for any caller.
+        """
+        with self._lock:
+            kind, txid = rec["kind"], rec["txid"]
+            if kind == "prepare":
+                ops = rec.get("ops") or []
+                if txid not in self._staged:
+                    for other, oops in self._staged.items():
+                        if other == txid:
+                            continue
+                        held = {k for k, _ in oops}
+                        if any(k in held for k, _ in ops):
+                            return b"no"
+                    if self._decided.get(txid):
+                        # late duplicate of a finished txn: never
+                        # re-stage
+                        return b"no"
+                    self._staged[txid] = ops
+                prev = [self._data.get(k, b"")
+                        for k, _ in self._staged[txid]]
+                return b"yes:" + pack_values(prev)
+            if kind == "decide":
+                out = self._decided.setdefault(txid,
+                                               rec.get("outcome", "a"))
+                return out.encode()
+            # commit / abort
+            ops = self._staged.pop(txid, None)
+            self._decided.setdefault(
+                txid, "c" if kind == "commit" else "a")
+            if kind == "commit" and ops is not None:
+                for k, v in ops:
+                    if v:
+                        self._data[k] = v
+                        self._version += 1
+                        if self._multi_version:
+                            self._history.setdefault(k, []).append(v)
+            return b"done"
+
+    def staged_txns(self) -> List[str]:
+        """In-doubt txids (prepared, no commit/abort executed yet) —
+        the coordinator-recovery scan surface."""
+        with self._lock:
+            return sorted(self._staged)
+
+    def decided(self, txid: str) -> Optional[str]:
+        with self._lock:
+            return self._decided.get(txid)
 
     def get(self, key: Key) -> Optional[Value]:
         with self._lock:

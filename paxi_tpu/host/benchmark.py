@@ -251,7 +251,7 @@ class OpenLoopBenchmark:
                  drain_s: float = 5.0,
                  linearizability_check: bool = True,
                  key_base: int = 0, client_tag: str = "ol",
-                 ops_per_req: int = 1):
+                 ops_per_req: int = 1, key_map=None):
         self.cfg = cfg
         self.rates = list(rates)
         self.step_s = step_s
@@ -264,6 +264,12 @@ class OpenLoopBenchmark:
         # workers, so each checks its own slice and the verdicts sum
         self.key_base = int(key_base)
         self.client_tag = client_tag
+        # optional key-shape hook (shard ramp: disjoint-then-crossing
+        # ranges over a ShardMap): draws j in [0, K) map to
+        # ``key_map(j)`` instead of ``key_base + j``.  The map must be
+        # injective so per-worker key slices stay disjoint and the
+        # per-key linearizability verdicts still compose.
+        self.key_map = key_map
         self.max_inflight = max_inflight
         self.drain_s = drain_s
         self.lin = linearizability_check
@@ -347,6 +353,7 @@ class OpenLoopBenchmark:
         n_conns = self.n_conns
         K, W, lin = self.K, self.W, self.lin
         key_base = self.key_base
+        key_map = self.key_map
         history_add = self.history.add
         observe = hist.observe
         randrange, random_, expovariate = (rng.randrange, rng.random,
@@ -382,7 +389,8 @@ class OpenLoopBenchmark:
             parts = []
             ops_meta = []
             for j in range(B):
-                key = key_base + randrange(K)
+                key = (key_map(randrange(K)) if key_map is not None
+                       else key_base + randrange(K))
                 if random_() < W:
                     v = "%d:%d:%d" % (ci, wid, j)
                     parts.append('{"key":%d,"value":"%s"}' % (key, v))
@@ -432,7 +440,8 @@ class OpenLoopBenchmark:
             conn = conns[ci]
             cmd_ids[ci] += 1
             cmd_id = cmd_ids[ci]
-            key = key_base + randrange(K)
+            key = (key_map(randrange(K)) if key_map is not None
+                   else key_base + randrange(K))
             write = random_() < W
             # unique value per write: read-from edges in the checker
             # are unambiguous, and the per-conn (client, command_id)

@@ -43,7 +43,8 @@ import time
 from typing import TYPE_CHECKING, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
-from paxi_tpu.core.command import TXN_MAGIC, Command, Request
+from paxi_tpu.core.command import (RESERVED_PREFIXES, Command, Request,
+                                   pack_tpc)
 
 if TYPE_CHECKING:
     from paxi_tpu.host.node import Node
@@ -227,7 +228,7 @@ class HTTPServer:
         except ValueError:
             return None   # /local/3, /metrics, ...
         value = body if method != b"GET" else b""
-        if value.startswith(TXN_MAGIC):
+        if value.startswith(RESERVED_PREFIXES):
             return _response(400, b"", {"Err": "reserved value prefix"})
         return self._enqueue_kv(key, value,
                                 lines[2][10:].strip().decode(), cmd_id)
@@ -303,7 +304,7 @@ class HTTPServer:
         except ValueError:
             return None
         value = body if method in ("PUT", "POST") else b""
-        if value.startswith(TXN_MAGIC):
+        if value.startswith(RESERVED_PREFIXES):
             return _response(400, b"", {"Err": "reserved value prefix"})
         props = {}
         for k in headers:
@@ -414,6 +415,15 @@ class HTTPServer:
             if method != "POST":
                 return _response(405, b"", {"Err": "POST only"})
             return await self._transaction(headers, body)
+        if parts and parts[0] == "tpc":
+            # cross-shard 2PC record injection (shard router only; see
+            # paxi_tpu/shard/txn.py).  The record is packed SERVER-side
+            # from JSON, so the TPC_MAGIC encoding never crosses the
+            # client surface — external KV values carrying it stay
+            # rejected above.
+            if method != "POST":
+                return _response(405, b"", {"Err": "POST only"})
+            return await self._tpc(body)
         if len(parts) != 1:
             return _response(404)
         try:
@@ -422,10 +432,11 @@ class HTTPServer:
             return _response(400, b"", {"Err": "key must be an int"})
 
         value = body if method in ("PUT", "POST") else b""
-        if value.startswith(TXN_MAGIC):
-            # the packed-transaction encoding is internal; a client value
-            # carrying the magic prefix would be reinterpreted as a batch
-            # at execute time on every replica
+        if value.startswith(RESERVED_PREFIXES):
+            # the packed-transaction / 2PC-record encodings are
+            # internal; a client value carrying either magic prefix
+            # would be reinterpreted by the state machine at execute
+            # time on every replica
             return _response(400, b"", {"Err": "reserved value prefix"})
         cmd = Command(key, value,
                       client_id=headers.get("client-id", ""),
@@ -485,6 +496,43 @@ class HTTPServer:
         values = unpack_values(rep.value) if rep.value else []
         out = {"ok": True, "values": [v.decode("latin1") for v in values]}
         return _response(200, json.dumps(out).encode())
+
+    async def _tpc(self, body: bytes) -> bytes:
+        """One 2PC record through the group's ordinary Request path:
+        ``{"kind", "txid", "key", "ops"?, "outcome"?}`` packs into a
+        TPC-record command on ``key`` (the group-local ordering
+        anchor), replicates like any write, and the state machine's
+        reply (vote / winning outcome / done) returns as the body."""
+        try:
+            doc = json.loads(body.decode() or "{}")
+            if doc.get("kind") not in ("prepare", "decide", "commit",
+                                       "abort") \
+                    or not isinstance(doc.get("txid"), str):
+                # a record unpack_tpc would reject at execute time
+                # falls through to a plain write of a reserved-prefix
+                # value — reject every such shape here instead
+                raise ValueError(
+                    f"bad 2pc record: kind={doc.get('kind')!r} "
+                    f"txid={doc.get('txid')!r}")
+            value = pack_tpc(
+                doc["kind"], doc["txid"],
+                ops=[(int(k), v.encode("latin1"))
+                     for k, v in doc["ops"]] if "ops" in doc else None,
+                outcome=doc.get("outcome", ""))
+            key = int(doc.get("key", 0))
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
+            return _response(400, b"", {"Err": repr(e)})
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.node.handle_client_request(Request(
+            command=Command(key, value), timestamp=time.time(),
+            node_id=self._node_id, reply_to=fut))
+        try:
+            rep = await asyncio.wait_for(fut, timeout=10.0)
+        except asyncio.TimeoutError:
+            return _response(500, b"", {"Err": "2pc record timed out"})
+        if rep.err:
+            return _response(500, b"", {"Err": str(rep.err)})
+        return _response(200, rep.value or b"")
 
     def _admin(self, method: str, parts, q) -> bytes:
         """Fault injection + introspection (AdminClient endpoints)."""

@@ -48,6 +48,23 @@ def parse_addr(url: str) -> Tuple[str, str, int]:
     return u.scheme, u.hostname or "127.0.0.1", u.port or 0
 
 
+async def wait_listening(url: str, timeout_s: float = 30.0) -> bool:
+    """Poll until something accepts TCP connections at ``url`` (a
+    subprocess cluster's HTTP server coming up) or the timeout
+    passes — the ONE readiness probe behind ``bench-host
+    --cluster-proc`` and the sharded cluster's subprocess mode."""
+    _, host, port = parse_addr(url)
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout_s
+    while loop.time() < deadline:
+        try:
+            pysocket.create_connection((host, port), 0.5).close()
+            return True
+        except OSError:
+            await asyncio.sleep(0.1)
+    return False
+
+
 class Transport:
     """One peer link.  Subclasses: ChanTransport, TCPTransport, UDPTransport."""
 

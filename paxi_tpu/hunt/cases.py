@@ -148,6 +148,33 @@ DEMO_CASES: List[Case] = [
 ]
 
 
+# ---- shard-router tier fault matrix (host-only) -------------------------
+# The shard tier (paxi_tpu/shard/) has no sim kernel, so its
+# adversarial matrix lives here as the canonical coordinator-kill grid
+# instead of a (protocol, FuzzConfig) row: each case kills the 2PC
+# coordinator at a scripted point mid-transaction, replays the groups'
+# consensus deliveries on ONE shared virtual-clock fabric (group ids
+# are zone-disjoint — see shard/cluster.py), runs coordinator
+# recovery, and the 2PC atomicity oracle must hold.  Consumed by
+# tests/test_shard_txn.py (the fabric replay); scripts/verify.sh
+# --shard covers the live-ramp half (router + 2PC burst oracle).
+# (kill_point, n_groups, replicas_per_group, seeds)
+ShardCase = Tuple[str, int, int, Tuple[int, ...]]
+SHARD_ROUTER_CASES: List[ShardCase] = [
+    # died with only the home group staged: recovery must abort the
+    # stragglers (presumed abort wins the decide race)
+    ("mid_prepare", 2, 3, (0, 1)),
+    # every group staged, no decision: recovery's decide(abort) wins
+    ("after_prepare", 2, 3, (0, 1)),
+    # decision durable in the home log, fan-out never started:
+    # recovery's decide(abort) LOSES and must complete the commit
+    ("after_decide", 2, 3, (0, 1)),
+    # partial commit fan-out: the home group applied, the rest must
+    # too (recovery completes, never re-aborts)
+    ("mid_commit", 2, 3, (0,)),
+]
+
+
 def sched_name(fuzz: FuzzConfig) -> str:
     """STRUCTURAL schedule name — a pure function of the config's
     contents (the old ``id()``-keyed name table broke for any

@@ -817,4 +817,12 @@ SIM_STATE_MAP = {
     "xcount":    "",  # execution counter (metrics)
     "kcount":    "",  # per-key execution oracle (invariant bookkeeping)
     "khash":     "",
+    # on-device observability (PR 11) — measurement planes, excluded
+    # from the trace witness hash; the host twins are the registry's
+    # live latency histograms and the post-hoc linearizability checker
+    "m_prop_t":      "",
+    "m_commit_dt":   "",   # pending deltas for the deferred flush
+    "m_lat_hist":    "",
+    "m_lat_sum":     "",
+    "m_inscan_viol": "",
 }

@@ -77,8 +77,10 @@ from typing import Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from paxi_tpu.metrics import lathist
 from paxi_tpu.ops.closure import transitive_closure
 from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim import inscan
 from paxi_tpu.sim.ring import (diag2, dst_major, require_packable,
                                shift_deps, shift_window)
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
@@ -207,6 +209,19 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
         # per-key execution oracle: count + order-sensitive hash chain
         kcount=jnp.zeros((R, K, G), i32),
         khash=jnp.zeros((R, K, G), i32),
+        # on-device observability (PR-11 template: m_ measurement
+        # planes, witness-hash-excluded, never read by protocol logic
+        # — PXM10x): m_prop_t records the step a cell was FIRST
+        # recorded at each replica; a cell's commit stores the
+        # record->commit step delta in the position-free m_commit_dt
+        # pending plane and the runner's deferred flush log2-bins it
+        # (metrics/lathist); m_inscan_viol accumulates the in-scan
+        # linearizability spot-check (sim/inscan)
+        m_prop_t=jnp.zeros((R, R, I, G), i32),
+        m_commit_dt=jnp.zeros((R, R, I, G), i32),
+        m_lat_hist=lathist.empty_hist(G),
+        m_lat_sum=jnp.zeros((G,), i32),
+        m_inscan_viol=jnp.zeros((G,), i32),
     )
 
 
@@ -962,9 +977,22 @@ def step(state, inbox, ctx: StepCtx):
     }
 
     # ---------------- cumulative counters (pre-slide layouts align) -----
-    ccount = ccount + jnp.sum((status == ST_COMMIT)
-                              & (status_in < ST_COMMIT), axis=(1, 2))
+    newly_c = (status == ST_COMMIT) & (status_in < ST_COMMIT)
+    ccount = ccount + jnp.sum(newly_c, axis=(1, 2))
     xcount = xcount + jnp.sum(new_exec & ~exec_f, axis=1)
+
+    # in-kernel commit latency (PR-11 template): a cell's clock starts
+    # at its FIRST record here (own proposal or pa/acc/cmt delivery —
+    # retransmits keep the original start via the ==0 guard); a newly
+    # committed cell stores its record->commit step delta in the
+    # pending plane for the runner's deferred flush
+    m_prop_t = state["m_prop_t"]
+    m_prop_t = jnp.where((status >= ST_PRE) & (status_in == ST_NONE)
+                         & (m_prop_t == 0), ctx.t, m_prop_t)
+    dt = jnp.clip(ctx.t - m_prop_t, 0, None)
+    m_commit_dt = jnp.where(newly_c, dt, state["m_commit_dt"])
+    m_lat_sum = state["m_lat_sum"] + jnp.sum(
+        jnp.where(newly_c, dt, 0), axis=(0, 1, 2), dtype=jnp.int32)
 
     # ---------------- GC gossip + slide the instance rings --------------
     # my contiguous executed frontier per owner column (absolute)
@@ -1008,6 +1036,21 @@ def step(state, inbox, ctx: StepCtx):
     abal = shift_window(abal, adv, 0)
     age = shift_window(age, adv, 0)
     deps = shift_deps(deps, adv)
+    m_prop_t = shift_window(m_prop_t, adv, 0)
+
+    # in-scan linearizability spot-check (sim/inscan): an independent
+    # oracle beside invariants(), accumulated on device per group.
+    # Frontier plane = the per-key execution counters (monotone by
+    # construction), register plane = the per-key hash chains — equal
+    # counts must mean equal chains, the in-scan slice of invariant 4.
+    abs_in = (state["base"][:, :, None, :]
+              + iidx[None, None, :, None])
+    abs_out = base[:, :, None, :] + iidx[None, None, :, None]
+    m_inscan_viol = state["m_inscan_viol"] + inscan.spot_check(
+        state["kcount"], kcount, state["base"], base,
+        abs_in, abs_out, state["cmd"], cmd,
+        state["status"] == ST_COMMIT, status == ST_COMMIT,
+        kv=khash, lane_major=True)
 
     new_state = dict(
         base=base, cmd=cmd, seq=seq, deps=deps, status=status,
@@ -1020,6 +1063,9 @@ def step(state, inbox, ctx: StepCtx):
         rdcmd=rdcmd, rdseq=rdseq, rddeps=rddeps, aacks=aacks,
         recovered=recovered, gfront=gfront, ccount=ccount,
         xcount=xcount, kcount=kcount, khash=khash,
+        m_prop_t=m_prop_t, m_commit_dt=m_commit_dt,
+        m_lat_hist=state["m_lat_hist"], m_lat_sum=m_lat_sum,
+        m_inscan_viol=m_inscan_viol,
     )
     outbox = {"pa": out_pa, "par": out_par, "acc": out_acc,
               "accr": out_accr, "cmt": out_cmt, "prep": out_prep,
@@ -1035,6 +1081,13 @@ def metrics(state, cfg: SimConfig):
         "committed_slots": jnp.sum(jnp.max(state["ccount"], axis=0)),
         "executed": jnp.sum(jnp.max(state["xcount"], axis=0)),
         "recovered": jnp.sum(state["recovered"]),
+        # on-device observability scalars (PR-11 contract; the
+        # histogram itself rides in state as m_lat_hist)
+        "commit_lat_sum": jnp.sum(state["m_lat_sum"]),
+        "commit_lat_n": (jnp.sum(state["m_lat_hist"])
+                         + jnp.sum((state["m_commit_dt"] > 0)
+                                   .astype(jnp.int32))),
+        "inscan_violations": jnp.sum(state["m_inscan_viol"]),
     }
 
 

@@ -1,0 +1,223 @@
+"""Open-loop shard ramp: aggregate cmds/s vs shard count.
+
+The compartmentalization claim ("Bipartisan Paxos", "HT-Paxos",
+PAPERS.md) made measurable end-to-end: a FIXED fleet of replicas is
+partitioned into G independent consensus groups behind the shard
+router, and the same Poisson open-loop ramp (host/benchmark.py) is
+offered to the one router endpoint for G in {1, 2, 4}.  Aggregate
+throughput rises with G because the bottleneck role — the group
+leader, whose per-command replication work fans out to n-1 followers
+— is replicated while each instance's fan-in shrinks (fleet/G - 1
+followers per leader); past that the bottleneck visibly MOVES to the
+shared router/serving tier, which is the papers' point.
+
+Workers get **disjoint-then-crossing key ranges**: phase A pins each
+worker's range inside one group (traffic partitions perfectly — the
+scaling ceiling), phase B re-points every worker at a range STRIDING
+all G groups (every worker hits every group through the same router
+conns — the realistic mixed case).  Ranges stay disjoint across
+workers in both phases, so each worker's per-key linearizability
+verdict composes and the run-level anomaly count is their sum.
+
+Every run ends with a burst of cross-shard transactions through the
+router's 2PC path and an **atomicity oracle** sweep: for each txn,
+linearizable readback of every op key must show the txn's writes
+everywhere or nowhere (shard/txn.atomic_check).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Dict, List, Optional
+
+from paxi_tpu.core.config import Config
+from paxi_tpu.core.ident import ID
+from paxi_tpu.host.benchmark import OpenLoopBenchmark
+from paxi_tpu.host.client import _Conn
+from paxi_tpu.shard.cluster import ShardedCluster
+from paxi_tpu.shard.txn import atomic_check
+
+
+def _router_cfg(url: str) -> Config:
+    """A one-entry Config so OpenLoopBenchmark can target the router
+    like any node."""
+    cfg = Config()
+    cfg.addrs[ID("1.1")] = url
+    cfg.http_addrs[ID("1.1")] = url
+    return cfg
+
+
+def worker_key_maps(shard_map, G: int, workers: int, K: int):
+    """Per-worker injective key maps for both phases.
+
+    disjoint: worker w draws from a K-key block inside group
+    ``w % G``'s range.  crossing: worker w's j-th key lands in group
+    ``j % G`` (upper half of each group's range, clear of the
+    disjoint blocks), so every worker drives every group."""
+    span = shard_map.span
+    gsize = span // G
+    maps = []
+    kc = K // G + 1
+    for w in range(workers):
+        lo = (w % G) * gsize + (w // G) * K
+        half = gsize // 2
+        maps.append({
+            "disjoint": (lambda j, _lo=lo: _lo + j),
+            "crossing": (lambda j, _w=w, _g=G, _gs=gsize, _h=half,
+                         _kc=kc: (j % _g) * _gs + _h + _w * _kc
+                         + j // _g),
+        })
+    return maps
+
+
+async def _txn_shots(router_url: str, shard_map, G: int, n_txns: int
+                     ) -> Dict:
+    """Cross-shard 2PC burst + atomicity oracle readback."""
+    conn = _Conn(router_url)
+    span, gsize = shard_map.span, shard_map.span // G
+    committed = aborted = errors = 0
+    shots = []
+    try:
+        for t in range(n_txns):
+            # one fresh key per group, top slice of each range
+            ops = [{"key": g * gsize + gsize - 512 + t,
+                    "value": f"txn{t}:g{g}"} for g in range(G)]
+            try:
+                status, _, payload = await conn.request(
+                    "POST", "/transaction",
+                    {"Client-Id": "tpcshot",
+                     "Command-Id": str(t + 1)},
+                    json.dumps(ops).encode())
+            except (IOError, OSError):
+                errors += 1
+                continue
+            if status == 200:
+                committed += 1
+            else:
+                aborted += 1
+            shots.append(ops)
+        atomic = violations = 0
+        chk_cmd = 0
+        for ops in shots:
+            pairs: Dict[int, list] = {}
+            for o in ops:
+                # unique Command-Id per readback: a reused id would hit
+                # the groups' at-most-once tables and replay the FIRST
+                # readback's value, silently blinding the oracle
+                chk_cmd += 1
+                try:
+                    st, _, obs = await conn.request(
+                        "GET", f"/{o['key']}",
+                        {"Client-Id": "tpcchk",
+                         "Command-Id": str(chk_cmd)},
+                        b"")
+                except (IOError, OSError):
+                    st, obs = 0, b""
+                g = shard_map.group_of(o["key"])
+                pairs.setdefault(g, []).append(
+                    (o["value"].encode(), obs if st == 200 else b""))
+            if atomic_check(pairs):
+                atomic += 1
+            else:
+                violations += 1
+    finally:
+        conn.close()
+    return {"txns": len(shots), "committed": committed,
+            "aborted": aborted, "errors": errors, "atomic": atomic,
+            "atomicity_violations": violations}
+
+
+async def shard_ramp(algorithm: str = "paxos", shards: int = 2,
+                     fleet: int = 12, workers: int = 4,
+                     rates: Optional[List[float]] = None,
+                     step_s: float = 3.0, K: int = 256, W: float = 0.5,
+                     seed: int = 0, base_port: int = 18300,
+                     txns: int = 8, lin: bool = True,
+                     proc: bool = False, conns: int = 2,
+                     drain_s: float = 4.0) -> Dict:
+    """One G-point of the curve: ramp both phases, fire the 2PC burst,
+    return the artifact row."""
+    G = shards
+    if fleet % G:
+        raise ValueError(f"fleet {fleet} not divisible into {G} groups")
+    n = fleet // G
+    rates = rates or [2000.0, 5000.0, 10000.0]
+    sc = ShardedCluster(algorithm, groups=G, n=n, base_port=base_port,
+                        router_port=base_port + 98, proc=proc)
+    await sc.start()
+    try:
+        rcfg = _router_cfg(sc.router_url)
+        maps = worker_key_maps(sc.map, G, workers, K)
+
+        async def phase(name: str) -> List[Dict]:
+            outs = await asyncio.gather(*[
+                OpenLoopBenchmark(
+                    rcfg, rates=[r / workers for r in rates],
+                    step_s=step_s, seed=seed + 101 * w, conns=conns,
+                    W=W, K=K, client_tag=f"{name[:1]}{w}w",
+                    linearizability_check=lin, drain_s=drain_s,
+                    key_map=maps[w][name]).run()
+                for w in range(workers)])
+            steps = []
+            for i, r in enumerate(rates):
+                steps.append({
+                    "offered_ops_s": r,
+                    "achieved_ops_s": round(sum(
+                        o["steps"][i]["achieved_ops_s"]
+                        for o in outs), 1),
+                    "completed": sum(o["steps"][i]["completed"]
+                                     for o in outs),
+                    "errors": sum(o["steps"][i]["errors"]
+                                  for o in outs),
+                    "shed": sum(o["steps"][i]["shed"] for o in outs),
+                    "latency_p50_ms": round(max(
+                        o["steps"][i]["latency_ms"]["p50"]
+                        for o in outs), 3),
+                    "latency_p99_ms": round(max(
+                        o["steps"][i]["latency_ms"]["p99"]
+                        for o in outs), 3),
+                })
+            return [{"phase": name, "steps": steps,
+                     "anomalies": (sum(o["anomalies"] or 0
+                                       for o in outs) if lin else None),
+                     "peak_ops_s": max(s["achieved_ops_s"]
+                                       for s in steps)}]
+
+        phases = await phase("disjoint") + await phase("crossing")
+        # G == 1 exercises the single-group packed-transaction path
+        # (same surface, single-log atomicity); G > 1 runs real 2PC
+        txn_report = await _txn_shots(sc.router_url, sc.map, G, txns) \
+            if txns > 0 else None
+        router_metrics = await sc.router.metrics_snapshot()
+        peak = max(p["peak_ops_s"] for p in phases)
+        return {
+            "mode": "shard-ramp",
+            "algorithm": algorithm,
+            "shards": G,
+            "fleet": fleet,
+            "replicas_per_group": n,
+            "workers": workers,
+            "W": W, "K": K,
+            "cluster_proc": proc,
+            "phases": phases,
+            "aggregate_peak_ops_s": peak,
+            "anomalies": (sum(p["anomalies"] or 0 for p in phases)
+                          if lin else None),
+            "txn": txn_report,
+            "router": {
+                "forwards": _counter(router_metrics,
+                                     "paxi_router_forwards_total"),
+                "stale_reroutes": _counter(
+                    router_metrics, "paxi_router_stale_reroutes_total"),
+                "map_swaps": _counter(router_metrics,
+                                      "paxi_router_map_swaps_total"),
+            },
+        }
+    finally:
+        await sc.stop()
+
+
+def _counter(snap: Dict, name: str) -> int:
+    return sum(c["value"] for c in snap.get("counters", [])
+               if c["name"] == name)

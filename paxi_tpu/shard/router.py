@@ -1,0 +1,459 @@
+"""The shard router tier: one serving surface over G consensus groups.
+
+Clients speak the ordinary KV REST dialect to the router; the router
+resolves each key through the versioned ``ShardMap`` and forwards the
+request over pipelined connections (host/client._Conn) to the owning
+group's entry node — so the whole existing serving stack (pipelined
+HTTP, batch-per-slot commit pipeline, per-command reply fan-out) sits
+unchanged BEHIND the partition, and aggregate throughput scales with
+independent group instances instead of one leader pipeline.
+
+Routing-table swap discipline (the PXC-checked shape): ``_map`` and
+the per-group pending queues are guarded by one ``threading.Lock``;
+``install_map`` swaps the immutable ShardMap reference under it and
+every request path reads one snapshot.  Forwarding is two-phase like
+the batch buffer: requests enqueue (under the lock) onto the owning
+group's pending list stamped with the map version they resolved
+under; a scheduled flush swaps the lists out under the lock and ships
+them outside it.  The flush RE-RESOLVES any op whose stamp predates
+the current map version — an op whose key moved groups mid-pipeline
+is rerouted to its new owner (counted as
+``paxi_router_stale_reroutes_total``) instead of executing against a
+group that no longer owns the key: the stale-epoch reject + retry
+path, internal to the router so clients never see a misrouted reply.
+
+Surfaces:
+- ``GET|PUT|POST /{key}``          routed KV (Client-Id/Command-Id pass
+                                   through, so at-most-once filtering
+                                   and linearizability hold end-to-end)
+- ``POST /transaction``            single-group txns forward as packed
+                                   transactions; cross-group txns run
+                                   2PC (shard/txn.py)
+- ``GET /shardmap``                the live map (version, ranges)
+- ``POST /shardmap/move?lo&hi&group``  key-stealing control plane:
+                                   swap in ``map.move_range(...)``
+- ``GET /metrics``                 router registry + every group's
+                                   node registries, each group's
+                                   series labeled ``group=<g>``,
+                                   merged through the ONE registry
+                                   code path (metrics/registry.py)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from paxi_tpu.core.command import RESERVED_PREFIXES
+from paxi_tpu.host.client import _Conn
+from paxi_tpu.host.http import _OK_TMPL, _response, read_request
+from paxi_tpu.metrics import Registry, merge_snapshots
+from paxi_tpu.metrics.registry import render_prometheus
+from paxi_tpu.shard.shardmap import ShardMap
+from paxi_tpu.shard.txn import ShardCoordinator, TxnOutcome, partition_ops
+
+
+class _RoutedOp:
+    """One forwarded KV request: the backend frame, the response slot,
+    and the map epoch it was routed under."""
+
+    __slots__ = ("key", "frame", "slot", "epoch")
+
+    def __init__(self, key: int, frame: bytes, slot, epoch: int):
+        self.key = key
+        self.frame = frame
+        self.slot = slot
+        self.epoch = epoch
+
+
+class ShardRouter:
+    """Routing core: map snapshot/swaps, per-group pipes, 2PC."""
+
+    def __init__(self, shard_map: ShardMap, group_urls: List[str],
+                 lease_s: float = 0.2,
+                 metrics: Optional[Registry] = None,
+                 group_scrape=None):
+        if shard_map.n_groups > len(group_urls):
+            raise ValueError(
+                f"map names group {shard_map.n_groups - 1} but only "
+                f"{len(group_urls)} group urls given")
+        self._lock = threading.Lock()
+        self._map = shard_map
+        self._pending: List[List[_RoutedOp]] = [[] for _ in group_urls]
+        self._flush_scheduled = False
+        self._conns = [_Conn(u) for u in group_urls]
+        self._tpc_conns = [_Conn(u) for u in group_urls]
+        self.metrics = metrics if metrics is not None \
+            else Registry(tier="router")
+        # async callable returning per-group registry snapshots for
+        # /metrics aggregation (injected by ShardedCluster: in-proc
+        # reads replica registries, subprocess mode scrapes HTTP)
+        self._group_scrape = group_scrape
+        self._fwd_total = self.metrics.counter(
+            "paxi_router_forwards_total")
+        self._stale_total = self.metrics.counter(
+            "paxi_router_stale_reroutes_total")
+        self._map_swaps = self.metrics.counter(
+            "paxi_router_map_swaps_total")
+        self.coord = ShardCoordinator(self._tpc_submit, lease_s=lease_s,
+                                      metrics=self.metrics)
+
+    # ---- map snapshot / swap (the lockset-checked pair) ----------------
+    @property
+    def shard_map(self) -> ShardMap:
+        with self._lock:
+            return self._map
+
+    def install_map(self, new_map: ShardMap) -> None:
+        """Swap the routing table (version must advance).  Pending ops
+        re-resolve at the next flush — nothing here touches in-flight
+        state beyond the one reference swap."""
+        new_map.validate()
+        if new_map.n_groups > len(self._conns):
+            raise ValueError(
+                f"map names group {new_map.n_groups - 1} but the "
+                f"router has {len(self._conns)} groups")
+        with self._lock:
+            if new_map.version <= self._map.version:
+                raise ValueError(
+                    f"stale map: version {new_map.version} <= "
+                    f"installed {self._map.version}")
+            self._map = new_map
+        self._map_swaps.inc()
+
+    # ---- KV forwarding --------------------------------------------------
+    def route_kv(self, key: int, frame: bytes, loop) -> asyncio.Future:
+        """Enqueue one KV request for its owning group; the returned
+        future resolves to response BYTES for the router's client."""
+        slot: asyncio.Future = loop.create_future()
+        self._fwd_total.inc()
+        with self._lock:
+            m = self._map
+            g = m.group_of(key)
+            self._pending[g].append(_RoutedOp(key, frame, slot,
+                                              m.version))
+        return slot
+
+    async def flush(self) -> None:
+        """Ship every pending op: swap the queues out under the lock,
+        re-resolve stale-epoch ops against the CURRENT map (rerouting
+        moved keys to their new owner), then write each group's burst
+        over its pipelined connection."""
+        with self._lock:
+            m = self._map
+            batches = self._pending
+            self._pending = [[] for _ in self._conns]
+        moved: List[_RoutedOp] = []
+        for g, ops in enumerate(batches):
+            if not ops:
+                continue
+            keep: List[_RoutedOp] = []
+            for op in ops:
+                if op.epoch != m.version and m.group_of(op.key) != g:
+                    op.epoch = m.version
+                    moved.append(op)
+                else:
+                    keep.append(op)
+            batches[g] = keep
+        for op in moved:
+            self._stale_total.inc()
+            batches[m.group_of(op.key)].append(op)
+        await asyncio.gather(*[
+            self._ship(g, ops) for g, ops in enumerate(batches) if ops])
+
+    async def _ship(self, g: int, ops: List[_RoutedOp]) -> None:
+        conn = self._conns[g]
+        try:
+            await conn.ensure()
+        except OSError as e:
+            for op in ops:
+                self._fail_slot(op.slot, e)
+            return
+        for op in ops:
+            conn.submit_raw(op.frame, self._make_done(op.slot))
+        try:
+            await conn.flush()
+        except (ConnectionError, OSError):
+            pass   # the dead reader task fails the waiters; next
+            # flush re-dials via ensure()
+
+    @staticmethod
+    def _fail_slot(slot: asyncio.Future, exc: Exception) -> None:
+        if not slot.done():
+            slot.set_result(_response(
+                500, b"", {"Err": f"group unreachable: {exc!r}"}))
+
+    @staticmethod
+    def _make_done(slot: asyncio.Future):
+        def done(status, headers, payload, exc, _slot=slot):
+            if _slot.done():
+                return
+            if exc is not None:
+                ShardRouter._fail_slot(_slot, exc)
+            elif status == 200:
+                _slot.set_result(_OK_TMPL % len(payload) + payload)
+            else:
+                _slot.set_result(_response(
+                    status, b"", {"Err": headers.get("err", "")}))
+        return done
+
+    # ---- 2PC transport --------------------------------------------------
+    async def _tpc_submit(self, group: int, key: int, rec: dict):
+        """ShardCoordinator transport: one 2PC record as POST /tpc to
+        the group (dedicated conns — records must not queue behind a
+        KV burst in the shared pipeline); the server packs the
+        TPC_MAGIC form, so the record is encoded once per hop."""
+        doc: Dict = {"kind": rec["kind"], "txid": rec["txid"],
+                     "key": int(key)}
+        if "ops" in rec:
+            doc["ops"] = [[k, v.decode("latin1")] for k, v in rec["ops"]]
+        if rec.get("outcome"):
+            doc["outcome"] = rec["outcome"]
+        body = json.dumps(doc).encode()
+        conn = self._tpc_conns[group]
+        try:
+            status, _, payload = await conn.request(
+                "POST", "/tpc", {}, body)
+            return status == 200, payload
+        except (IOError, OSError) as e:
+            return False, repr(e).encode()
+
+    async def run_transaction(self, ops, client_id: str,
+                              command_id: int) -> bytes:
+        """POST /transaction: partition by the current map; one group
+        -> forward the packed transaction unchanged (single-log
+        atomicity); several -> 2PC."""
+        m = self.shard_map
+        parts = partition_ops(m, ops)
+        if len(parts) == 1:
+            ((g, gops),) = parts.items()
+            body = json.dumps([
+                {"key": k, "value": v.decode("latin1")}
+                for k, v in gops]).encode()
+            conn = self._tpc_conns[g]
+            try:
+                status, headers, payload = await conn.request(
+                    "POST", "/transaction",
+                    {"Client-Id": client_id,
+                     "Command-Id": str(command_id)}, body)
+            except (IOError, OSError) as e:
+                return _response(500, b"", {"Err": repr(e)})
+            if status != 200:
+                return _response(status, b"",
+                                 {"Err": headers.get("err", "")})
+            return _OK_TMPL % len(payload) + payload
+        try:
+            out: TxnOutcome = await self.coord.run_txn(parts)
+        except (IOError, OSError) as e:
+            # decide unreachable: the outcome is UNKNOWN (participants
+            # may hold stages until a recover() pass) — answer 500
+            # rather than letting the exception tear the client
+            # connection down with its pipeline
+            return _response(500, b"",
+                             {"Err": f"2pc outcome unknown: {e}"})
+        if not out.committed:
+            return _response(500, b"", {"Err": out.err or "aborted"})
+        # re-assemble prepare-point previous values into op order
+        cursor = {g: iter(vals) for g, vals in out.values.items()}
+        values = [next(cursor[m.group_of(k)]) for k, _ in ops]
+        payload = json.dumps(
+            {"ok": True, "txid": out.txid,
+             "values": [v.decode("latin1") for v in values]}).encode()
+        return _OK_TMPL % len(payload) + payload
+
+    # ---- metrics aggregation -------------------------------------------
+    async def metrics_snapshot(self) -> Dict:
+        snaps = [self.metrics.snapshot()]
+        if self._group_scrape is not None:
+            per_group = await self._group_scrape()
+            for g, gsnaps in enumerate(per_group):
+                for s in gsnaps:
+                    snaps.append(label_group(s, g))
+        return merge_snapshots(snaps)
+
+    def close(self) -> None:
+        for c in self._conns + self._tpc_conns:
+            c.close()
+
+
+def label_group(snap: Dict, group: int) -> Dict:
+    """Stamp ``group=<g>`` into every series of a registry snapshot —
+    the ONE aggregation convention for per-group observability."""
+    g = str(group)
+    return {
+        "counters": [dict(c, labels={**c.get("labels", {}), "group": g})
+                     for c in snap.get("counters", [])],
+        "histograms": [dict(h, labels={**h.get("labels", {}),
+                                       "group": g})
+                       for h in snap.get("histograms", [])],
+    }
+
+
+class RouterServer:
+    """The router's client-facing HTTP endpoint: a pipelined reader/
+    writeback pair (host/http.py's split, sized down) whose KV hot
+    path enqueues onto the routing core and flushes once per parsed
+    burst."""
+
+    PIPELINE_DEPTH = 1024
+    REQUEST_TIMEOUT = 10.0
+
+    def __init__(self, router: ShardRouter, addr: str):
+        import uuid
+        self.router = router
+        self.addr = addr
+        self._server = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._txn_seq = 0
+        # fallback client identity for transactions sent WITHOUT a
+        # Client-Id header: unique per router instance, so a router
+        # restart (which resets _txn_seq) can never collide with a
+        # long-lived group's at-most-once table entries for the old
+        # instance's identity
+        self._txn_cid = f"router-{uuid.uuid4().hex[:10]}"
+
+    async def start(self) -> None:
+        from paxi_tpu.host.transport import parse_addr
+        self._loop = asyncio.get_running_loop()
+        _, host, port = parse_addr(self.addr)
+        self._server = await asyncio.start_server(self._serve, host,
+                                                  port)
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+        self.router.close()
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        pending: asyncio.Queue = asyncio.Queue(
+            maxsize=self.PIPELINE_DEPTH)
+        wtask = asyncio.create_task(self._writeback(pending, writer))
+        try:
+            while True:
+                method, path, headers, body = await read_request(reader)
+                slot = await self._route(method, path, headers, body)
+                await pending.put(slot)
+                if getattr(reader, "_buffer", b""):
+                    continue   # more pipelined requests already
+                    # buffered: parse them into the same flush
+                await self.router.flush()
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            try:
+                await self.router.flush()
+            except (ConnectionError, OSError):
+                pass
+            await pending.put(None)
+            await wtask
+            writer.close()
+
+    async def _writeback(self, pending: asyncio.Queue,
+                         writer: asyncio.StreamWriter) -> None:
+        out: List[bytes] = []
+        broken = False
+        while True:
+            slot = await pending.get()
+            if slot is None:
+                break
+            if not isinstance(slot, bytes):
+                try:
+                    slot = await asyncio.wait_for(
+                        slot, timeout=self.REQUEST_TIMEOUT)
+                except asyncio.TimeoutError:
+                    slot = _response(500, b"",
+                                     {"Err": "request timed out"})
+            out.append(slot)
+            if pending.empty() and out and not broken:
+                data = b"".join(out)
+                out.clear()
+                try:
+                    writer.write(data)
+                    await writer.drain()
+                except (ConnectionError, OSError):
+                    broken = True
+
+    async def _route(self, method: str, path: str,
+                     headers: Dict[str, str], body: bytes):
+        url = urlparse(path)
+        parts = [p for p in url.path.split("/") if p]
+        # the KV hot shape first
+        if len(parts) == 1 and method in ("GET", "PUT", "POST"):
+            try:
+                key = int(parts[0])
+            except ValueError:
+                return await self._route_slow(method, url, parts,
+                                              headers, body)
+            value = body if method in ("PUT", "POST") else b""
+            if value.startswith(RESERVED_PREFIXES):
+                return _response(400, b"",
+                                 {"Err": "reserved value prefix"})
+            head = [f"{method} /{key} HTTP/1.1",
+                    f"Content-Length: {len(value)}",
+                    f"Client-Id: {headers.get('client-id', '')}",
+                    f"Command-Id: {headers.get('command-id', '0')}"]
+            frame = ("\r\n".join(head) + "\r\n\r\n").encode() + value
+            return self.router.route_kv(key, frame, self._loop)
+        return await self._route_slow(method, url, parts, headers, body)
+
+    async def _route_slow(self, method: str, url, parts,
+                          headers: Dict[str, str], body: bytes):
+        r = self.router
+        # per-session ordering: KV ops this connection pipelined ahead
+        # of a slow request (e.g. a transaction touching the same key)
+        # must reach their groups BEFORE the slow path runs — a
+        # transaction completing first would be overwritten by the
+        # earlier op's late flush
+        await r.flush()
+        if parts and parts[0] == "transaction":
+            if method != "POST":
+                return _response(405, b"", {"Err": "POST only"})
+            self._txn_seq += 1
+            try:
+                ops = [(int(o["key"]),
+                        o.get("value", "").encode("latin1"))
+                       for o in json.loads(body.decode() or "[]")]
+                if not ops:
+                    raise ValueError("empty transaction")
+                cmd_id = int(headers.get("command-id",
+                                         str(self._txn_seq)))
+            except (ValueError, KeyError, TypeError,
+                    AttributeError) as e:
+                return _response(400, b"", {"Err": repr(e)})
+            return await r.run_transaction(
+                ops, headers.get("client-id", self._txn_cid), cmd_id)
+        if parts and parts[0] == "shardmap":
+            if len(parts) == 1 and method == "GET":
+                return _response(
+                    200, json.dumps(r.shard_map.to_json()).encode(),
+                    {"Content-Type": "application/json"})
+            if len(parts) == 2 and parts[1] == "move" \
+                    and method == "POST":
+                q = parse_qs(url.query)
+                try:
+                    new = r.shard_map.move_range(
+                        int(q["lo"][0]), int(q["hi"][0]),
+                        int(q["group"][0]))
+                    r.install_map(new)
+                except (KeyError, ValueError, IndexError) as e:
+                    return _response(400, b"", {"Err": repr(e)})
+                return _response(
+                    200, json.dumps(new.to_json()).encode(),
+                    {"Content-Type": "application/json"})
+            return _response(404)
+        if parts and parts[0] == "metrics":
+            if method != "GET":
+                return _response(405, b"", {"Err": "GET only"})
+            snap = await r.metrics_snapshot()
+            if parse_qs(url.query).get("format", [""])[0] == "json":
+                return _response(200, json.dumps(snap).encode(),
+                                 {"Content-Type": "application/json"})
+            return _response(
+                200, render_prometheus(snap).encode(),
+                {"Content-Type":
+                 "text/plain; version=0.0.4; charset=utf-8"})
+        return _response(404)

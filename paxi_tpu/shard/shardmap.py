@@ -1,0 +1,110 @@
+"""Versioned key-range -> consensus-group mapping (the routing table).
+
+Paxi's multi-leader layouts partition the key space statically per
+deployment; the compartmentalization papers scale aggregate throughput
+by adding independent instances of the bottleneck role behind such a
+partition.  ``ShardMap`` is that partition as a VALUE: an immutable
+list of contiguous ranges over a fixed key-space modulus, stamped with
+a monotonically increasing ``version``.  Mutation (``move_range`` —
+the control-plane half of wpaxos-style key stealing; data migration is
+a follow-up) returns a NEW map with ``version + 1``; the router swaps
+the reference under its lock (shard/router.py), so every routing
+decision reads one consistent snapshot and a mid-pipeline bump is
+detectable by epoch comparison (the stale-epoch reroute path).
+
+Keys outside ``[0, span)`` fold in by modulo, so the unbounded int key
+surface of the KV API routes deterministically.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+from dataclasses import dataclass
+from typing import List, Tuple
+
+DEFAULT_SPAN = 1 << 20
+
+
+@dataclass(frozen=True)
+class ShardMap:
+    """``starts[i]`` begins the i-th range (``starts[0] == 0``); range
+    i covers ``[starts[i], starts[i+1])`` (the last runs to ``span``)
+    and is owned by ``groups[i]``."""
+
+    version: int
+    span: int
+    starts: Tuple[int, ...]
+    groups: Tuple[int, ...]
+
+    @staticmethod
+    def static(n_groups: int, span: int = DEFAULT_SPAN) -> "ShardMap":
+        """The Paxi-style static layout: ``n_groups`` equal ranges."""
+        if n_groups < 1 or span < n_groups:
+            raise ValueError(f"bad shard layout: {n_groups} groups "
+                             f"over span {span}")
+        starts = tuple((span * g) // n_groups for g in range(n_groups))
+        return ShardMap(version=1, span=span, starts=starts,
+                        groups=tuple(range(n_groups)))
+
+    @property
+    def n_groups(self) -> int:
+        return max(self.groups) + 1
+
+    def group_of(self, key: int) -> int:
+        """The owning group of ``key`` (modulo-folded into the span)."""
+        k = int(key) % self.span
+        return self.groups[bisect.bisect_right(self.starts, k) - 1]
+
+    def ranges_of(self, group: int) -> List[Tuple[int, int]]:
+        """The [lo, hi) ranges a group owns (diagnostics/migration)."""
+        out = []
+        for i, g in enumerate(self.groups):
+            if g == group:
+                hi = self.starts[i + 1] if i + 1 < len(self.starts) \
+                    else self.span
+                out.append((self.starts[i], hi))
+        return out
+
+    def move_range(self, lo: int, hi: int, group: int) -> "ShardMap":
+        """A new map (version + 1) with ``[lo, hi)`` owned by
+        ``group`` — the key-stealing control-plane primitive."""
+        if not (0 <= lo < hi <= self.span):
+            raise ValueError(f"bad range [{lo}, {hi}) over span "
+                             f"{self.span}")
+        if group < 0:
+            raise ValueError(f"bad group {group}")
+        points = sorted({*self.starts, lo, hi} - {self.span})
+        starts: List[int] = []
+        groups: List[int] = []
+        for p in points:
+            g = group if lo <= p < hi else self.group_of(p)
+            if groups and groups[-1] == g:
+                continue          # coalesce adjacent equal ranges
+            starts.append(p)
+            groups.append(g)
+        return ShardMap(version=self.version + 1, span=self.span,
+                        starts=tuple(starts), groups=tuple(groups))
+
+    # ---- (de)serialization (the /shardmap wire form) -------------------
+    def to_json(self) -> dict:
+        return {"version": self.version, "span": self.span,
+                "starts": list(self.starts), "groups": list(self.groups)}
+
+    @staticmethod
+    def from_json(d) -> "ShardMap":
+        if isinstance(d, (str, bytes)):
+            d = json.loads(d)
+        m = ShardMap(version=int(d["version"]), span=int(d["span"]),
+                     starts=tuple(int(s) for s in d["starts"]),
+                     groups=tuple(int(g) for g in d["groups"]))
+        m.validate()
+        return m
+
+    def validate(self) -> None:
+        if not self.starts or self.starts[0] != 0 \
+                or list(self.starts) != sorted(set(self.starts)) \
+                or len(self.starts) != len(self.groups) \
+                or self.starts[-1] >= self.span \
+                or any(g < 0 for g in self.groups):
+            raise ValueError(f"inconsistent ShardMap: {self.to_json()}")

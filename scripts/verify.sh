@@ -32,14 +32,55 @@
 #                                # schema, committed ops > 0, a clean
 #                                # linearizability verdict and a
 #                                # nonzero batch-flush counter
+#   scripts/verify.sh --shard    # prepend the sharded-serving smoke:
+#                                # a tiny G=2 ramp through the shard
+#                                # router (paxi_tpu/shard/) asserting
+#                                # the artifact schema, committed > 0,
+#                                # anomalies == 0 and a clean
+#                                # cross-shard 2PC atomicity verdict
 # Stage flags stack: `verify.sh --lint --metrics --hunt` runs all.
 set -o pipefail
 cd "$(dirname "$0")/.."
 
 while [ "${1:-}" = "--lint" ] || [ "${1:-}" = "--metrics" ] \
     || [ "${1:-}" = "--hunt" ] || [ "${1:-}" = "--bench" ] \
-    || [ "${1:-}" = "--host-bench" ]; do
-  if [ "$1" = "--host-bench" ]; then
+    || [ "${1:-}" = "--host-bench" ] || [ "${1:-}" = "--shard" ]; do
+  if [ "$1" = "--shard" ]; then
+    shift
+    echo "== shard smoke (G=2 ramp through the router + 2PC) =="
+    # the sharded serving tier end-to-end at a toy rate: router ->
+    # 2 consensus groups -> per-worker linearizability verdicts, plus
+    # the cross-shard 2PC burst whose atomicity oracle must be clean
+    SH_OUT=$(mktemp /tmp/paxi_shard.XXXXXX.json)
+    timeout -k 10 240 env JAX_PLATFORMS=cpu python -m paxi_tpu \
+      bench-host --shards 2 -shard_fleet 6 -shard_workers 2 \
+      -rates 300,800 -step_s 1.5 -K 64 -txns 4 -base_port 18200 \
+      -out "$SH_OUT" >/dev/null || exit $?
+    SH_OUT="$SH_OUT" python - <<'PYEOF' || exit $?
+import json, os
+with open(os.environ["SH_OUT"]) as f:
+    r = json.load(f)
+required = ("mode", "algorithm", "shards", "fleet",
+            "replicas_per_group", "workers", "phases",
+            "aggregate_peak_ops_s", "anomalies", "txn", "router")
+missing = [k for k in required if k not in r]
+assert not missing, f"shard artifact missing keys: {missing}"
+assert r["mode"] == "shard-ramp" and r["shards"] == 2, r
+names = [p["phase"] for p in r["phases"]]
+assert names == ["disjoint", "crossing"], names
+for p in r["phases"]:
+    assert sum(s["completed"] for s in p["steps"]) > 0, p
+assert (r["anomalies"] or 0) == 0, f"linearizability: {r['anomalies']}"
+t = r["txn"]
+assert t["txns"] > 0 and t["committed"] > 0, t
+assert t["atomicity_violations"] == 0, t
+assert r["router"]["forwards"] > 0, r["router"]
+print(f"shard smoke OK: peak {r['aggregate_peak_ops_s']} cmds/s over "
+      f"{r['shards']} groups, {t['committed']}/{t['txns']} 2PC "
+      f"committed, atomicity clean, anomalies={r['anomalies']}")
+PYEOF
+    rm -f "$SH_OUT"
+  elif [ "$1" = "--host-bench" ]; then
     shift
     echo "== host-bench smoke (open-loop batched commit path) =="
     # the serving stack end-to-end at a toy rate: pipelined HTTP ->

@@ -106,6 +106,45 @@ class BatchLike:
         items.clear()                 # PXC452: ...cleared outside it
 
 
+class RouterLike:
+    """The shard-router routing-table shape (shard/router.py
+    ShardRouter): an immutable map reference swapped under the lock,
+    per-group pending queues swapped out whole at flush, shipping
+    outside the lock — plus the two ways to get the swap wrong."""
+
+    def __init__(self, shard_map, ship_fn):
+        self._lock = threading.Lock()
+        self._map = shard_map
+        self._pending = [[], []]
+        self._ship_fn = ship_fn
+
+    def install_ok(self, new_map):
+        with self._lock:
+            self._map = new_map       # reference swap under the lock
+
+    def route_ok(self, key, op):
+        with self._lock:
+            g = self._map.group_of(key)
+            self._pending[g].append(op)
+
+    def flush_ok(self):
+        with self._lock:
+            batches, self._pending = self._pending, [[], []]
+        for ops in batches:
+            self._ship_fn(ops)        # shipping runs OUTSIDE the lock
+
+    def install_racy(self, new_map):
+        self._map = new_map           # PXC401: unlocked table swap —
+        # a concurrent route_ok can read a half-installed reference
+
+    def flush_racy(self):
+        batches = self._pending       # alias taken...
+        with self._lock:
+            self._map = self._map
+        batches.clear()               # PXC452: ...cleared outside it —
+        # routes enqueued since the alias vanish unshipped
+
+
 class Unlocked:
     """Negative control: no lock attribute — never checked."""
 

@@ -22,6 +22,14 @@ def test_progress_and_safety():
     assert int(res.metrics["committed_slots"]) > 20
     # executed tracks committed (execution not starved by dependencies)
     assert int(res.metrics["executed"]) > 10
+    # the PR-11 measurement planes, threaded through this kernel: the
+    # in-kernel commit-latency histogram carries every commit event
+    # and the in-scan linearizability spot-check stays clean
+    lat = res.latency_summary()
+    assert lat is not None and lat["n"] > 0
+    assert int(res.metrics["commit_lat_n"]) == lat["n"]
+    assert lat["p50_rounds"] >= 1.0
+    assert res.inscan_violations == 0
 
 
 def test_committed_instances_agree():
@@ -73,6 +81,7 @@ def test_fuzzed_safety(fuzz):
     res, _ = run(groups=4, steps=80, fuzz=fuzz, seed=5, n_keys=2)
     assert int(res.violations) == 0
     assert int(res.metrics["committed_slots"]) > 0
+    assert res.inscan_violations == 0
 
 
 def test_perm_crash_owner_recovery():

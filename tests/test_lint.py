@@ -137,6 +137,8 @@ def test_concurrency_fixture():
     vs = concurrency.check(ROOT, files=[FIX / "fixture_locked.py"])
     got = sorted((v.code, v.message.split("`")[1]) for v in vs)
     assert got == [
+        ("PXC401", "self._map"),        # RouterLike.install_racy —
+                                        # the unlocked routing-table swap
         ("PXC401", "self.count"),       # bad_write
         ("PXC401", "self.count"),       # inline_escaped (raw: engine
                                         # suppression is tested below)
@@ -149,15 +151,31 @@ def test_concurrency_fixture():
         ("PXC451", "self.count"),               # deferred.cb (returned)
         ("PXC451", "self.items.clear(...)"),    # register's lambda
         ("PXC451", "self.items.pop(...)"),      # returned lambda
+        ("PXC452", "batches.clear(...)"),       # RouterLike.flush_racy
         ("PXC452", "d.append(...)"),            # alias_race
         ("PXC452", "items.clear(...)"),         # BatchLike.flush_racy
     ]
     msgs = " | ".join(v.message for v in vs)
     # negative controls: a callback that takes the lock itself and a
-    # synchronous lambda stay clean — and the real batch-buffer shape
-    # (swap under lock, flush callback outside) is clean too
+    # synchronous lambda stay clean — and the real batch-buffer and
+    # shard-router shapes (reference/queue swap under lock, ship
+    # outside) are clean too
     assert "locked_callback_is_fine" not in msgs
     assert "sync_lambda_is_fine" not in msgs
+    clean = {"install_ok", "route_ok", "flush_ok"}
+    flagged_lines = {v.line for v in vs}
+    src = (FIX / "fixture_locked.py").read_text().splitlines()
+    # resolve inside RouterLike: BatchLike defines a flush_ok too, and
+    # matching the first one would range-check the wrong class
+    cls_start = next(i for i, l in enumerate(src, 1)
+                     if l.startswith("class RouterLike"))
+    for name in clean:
+        start = next(i for i, l in enumerate(src[cls_start:],
+                                             cls_start + 1)
+                     if f"def {name}" in l)
+        end = next((i for i, l in enumerate(src[start:], start + 1)
+                    if l.strip().startswith("def ")), len(src))
+        assert not (flagged_lines & set(range(start, end))), name
     assert "add_ok" not in msgs and "flush_ok" not in msgs
 
 
@@ -396,7 +414,8 @@ def test_inline_disable_comment_suppresses():
                         if "disable=PXC401" in l)
     assert (escaped_line, "inline") in dropped
     assert escaped_line not in kept
-    assert len(kept) == 10     # everything seeded except the escape
+    assert len(kept) == 12     # everything seeded except the escape
+    # (10 SharedThing/BatchLike seeds + RouterLike's swap pair)
 
 
 def test_baseline_parse_and_match(tmp_path):

@@ -1,0 +1,216 @@
+"""Sharded multi-group serving (paxi_tpu/shard/): ShardMap semantics,
+the router end-to-end over a cluster-of-clusters, the stale-epoch
+reroute regression, per-group metrics aggregation, and cross-group
+per-key linearizability through the router."""
+
+import asyncio
+import json
+
+import pytest
+
+from paxi_tpu.core.command import TPC_MAGIC, TXN_MAGIC
+from paxi_tpu.host.client import _Conn
+from paxi_tpu.shard import ShardMap, ShardedCluster
+
+pytestmark = pytest.mark.host
+
+
+# ---- ShardMap (pure) -----------------------------------------------------
+def test_shardmap_static_partition():
+    m = ShardMap.static(4, span=1 << 12)
+    assert m.version == 1 and m.n_groups == 4
+    assert [m.group_of(k) for k in (0, 1023, 1024, 2048, 4095)] \
+        == [0, 0, 1, 2, 3]
+    # keys outside the span fold in by modulo (unbounded int surface)
+    assert m.group_of(4096) == 0 and m.group_of(4096 + 1024) == 1
+    assert m.group_of(-1) == m.group_of((1 << 12) - 1)
+
+
+def test_shardmap_move_range_versions_and_coalesces():
+    m = ShardMap.static(2, span=1000)
+    m2 = m.move_range(100, 200, 1)
+    assert m2.version == 2
+    assert m.group_of(150) == 0          # the old map is unchanged
+    assert m2.group_of(150) == 1
+    assert m2.group_of(99) == 0 and m2.group_of(200) == 0
+    # moving it back coalesces to the original layout, version moves on
+    m3 = m2.move_range(100, 200, 0)
+    assert m3.version == 3
+    assert (m3.starts, m3.groups) == (m.starts, m.groups)
+    with pytest.raises(ValueError):
+        m.move_range(500, 100, 1)
+    with pytest.raises(ValueError):
+        m.move_range(0, 2000, 1)
+
+
+def test_shardmap_json_round_trip():
+    m = ShardMap.static(3).move_range(10, 99, 2)
+    m2 = ShardMap.from_json(json.dumps(m.to_json()))
+    assert m2 == m
+    bad = m.to_json()
+    bad["starts"] = [5] + bad["starts"][1:]   # must start at 0
+    with pytest.raises(ValueError):
+        ShardMap.from_json(bad)
+
+
+# ---- the serving tier end-to-end ----------------------------------------
+def _req(conn, method, path, body=b"", cid="t", cmd=1):
+    return conn.request(method, path,
+                        {"Client-Id": cid, "Command-Id": str(cmd)},
+                        body)
+
+
+def test_router_end_to_end_and_stale_epoch():
+    """One cluster-of-clusters boot covering the serving surface:
+    routed KV placement, the /shardmap control plane, the
+    mid-pipeline stale-epoch reroute, 2PC through the router, and the
+    group-labeled metrics aggregation."""
+    async def main():
+        sc = ShardedCluster("paxos", groups=2, n=3, base_port=18700,
+                            router_port=18798)
+        await sc.start()
+        try:
+            conn = _Conn(sc.router_url)
+            span = sc.map.span
+            k0, k1 = 7, span // 2 + 7
+            st, _, _ = await _req(conn, "PUT", f"/{k0}", b"alpha", cmd=1)
+            assert st == 200
+            st, _, _ = await _req(conn, "PUT", f"/{k1}", b"beta", cmd=2)
+            assert st == 200
+            st, _, p = await _req(conn, "GET", f"/{k0}", cmd=3)
+            assert (st, p) == (200, b"alpha")
+            # placement: each group's store holds only its own range
+            g0, g1 = sc.leader_node(0), sc.leader_node(1)
+            assert g0.db.get(k0) == b"alpha" and g1.db.get(k0) is None
+            assert g1.db.get(k1) == b"beta" and g0.db.get(k1) is None
+            # reserved prefixes stay rejected at the router
+            st, _, _ = await _req(conn, "PUT", f"/{k0}",
+                                  TXN_MAGIC + b"x", cmd=4)
+            assert st == 400
+            st, _, _ = await _req(conn, "PUT", f"/{k0}",
+                                  TPC_MAGIC + b"x", cmd=5)
+            assert st == 400
+
+            # ---- stale-epoch reroute (regression): ops enqueued
+            # under map v1 whose key moves groups BEFORE the flush
+            # must re-resolve to the new owner, not execute at the old
+            router = sc.router
+            loop = asyncio.get_running_loop()
+            mk = 1234     # owned by group 0 under the static map
+            assert router.shard_map.group_of(mk) == 0
+            frame = (f"PUT /{mk} HTTP/1.1\r\nContent-Length: 5\r\n"
+                     f"Client-Id: st\r\nCommand-Id: 9\r\n\r\n"
+                     ).encode() + b"moved"
+            slot = router.route_kv(mk, frame, loop)     # queued, v1
+            moved = router.shard_map.move_range(mk, mk + 1, 1)
+            router.install_map(moved)                   # v2 mid-pipeline
+            await router.flush()
+            resp = await asyncio.wait_for(slot, 10)
+            assert resp.startswith(b"HTTP/1.1 200")
+            assert g1.db.get(mk) == b"moved", "op executed at the " \
+                "old owner after the map bump"
+            assert g0.db.get(mk) is None
+            snap = await router.metrics_snapshot()
+            stale = sum(c["value"] for c in snap["counters"]
+                        if c["name"] == "paxi_router_stale_reroutes_total")
+            assert stale == 1
+            # new requests route by the new map
+            st, _, p = await _req(conn, "GET", f"/{mk}", cmd=6)
+            assert (st, p) == (200, b"moved")
+
+            # ---- /shardmap surface
+            st, _, p = await _req(conn, "GET", "/shardmap")
+            doc = json.loads(p)
+            assert doc["version"] == 2
+            # a no-op move still advances the version (swap discipline
+            # is by version, not layout diff)
+            st, _, p = await conn.request(
+                "POST", f"/shardmap/move?lo={mk}&hi={mk + 1}&group=1",
+                {}, b"")
+            assert st == 200 and json.loads(p)["version"] == 3
+
+            # ---- cross-shard txn through the router
+            st, _, p = await _req(conn, "POST", "/transaction",
+                                  json.dumps([
+                                      {"key": k0, "value": "A2"},
+                                      {"key": k1, "value": "B2"},
+                                  ]).encode(), cmd=7)
+            out = json.loads(p)
+            assert st == 200 and out["ok"], out
+            assert out["values"] == ["alpha", "beta"]
+            assert g0.db.get(k0) == b"A2" and g1.db.get(k1) == b"B2"
+            # single-group txn forwards as a packed transaction
+            st, _, p = await _req(conn, "POST", "/transaction",
+                                  json.dumps([
+                                      {"key": k0, "value": "A3"},
+                                      {"key": k0 + 1, "value": "A4"},
+                                  ]).encode(), cmd=8)
+            assert st == 200 and json.loads(p)["ok"]
+            assert g0.db.get(k0) == b"A3"
+
+            # ---- per-group metrics through the one registry path
+            st, _, p = await _req(conn, "GET", "/metrics?format=json")
+            snap = json.loads(p)
+            by_group = {c["labels"].get("group")
+                        for c in snap["counters"]}
+            assert {"0", "1"} <= by_group
+            assert any(c["name"] == "paxi_router_forwards_total"
+                       for c in snap["counters"])
+            st, _, p = await _req(conn, "GET", "/metrics")
+            assert b'group="1"' in p     # prometheus text, same data
+            conn.close()
+        finally:
+            await sc.stop()
+    asyncio.run(main())
+
+
+def test_router_move_endpoint_and_unknown_routes():
+    async def main():
+        sc = ShardedCluster("paxos", groups=2, n=3, base_port=18710,
+                            router_port=18799)
+        await sc.start()
+        try:
+            conn = _Conn(sc.router_url)
+            st, _, p = await conn.request(
+                "POST", "/shardmap/move?lo=0&hi=64&group=1", {}, b"")
+            assert st == 200 and json.loads(p)["version"] == 2
+            assert sc.router.shard_map.group_of(10) == 1
+            # bad group / bad range rejected
+            st, _, _ = await conn.request(
+                "POST", "/shardmap/move?lo=0&hi=64&group=9", {}, b"")
+            assert st == 400
+            st, _, _ = await conn.request("GET", "/nope/route", {}, b"")
+            assert st == 404
+            conn.close()
+        finally:
+            await sc.stop()
+    asyncio.run(main())
+
+
+def test_cross_group_linearizability_per_key():
+    """The open loop through the router with a CROSSING key range
+    (every worker hits both groups): per-key linearizability must hold
+    across the sharded surface — each key's history is served by
+    exactly one group, so the per-worker verdicts stay clean."""
+    from paxi_tpu.host.benchmark import OpenLoopBenchmark
+    from paxi_tpu.shard.bench import _router_cfg, worker_key_maps
+
+    async def main():
+        sc = ShardedCluster("paxos", groups=2, n=3, base_port=18720,
+                            router_port=18797)
+        await sc.start()
+        try:
+            maps = worker_key_maps(sc.map, 2, 2, 64)
+            outs = await asyncio.gather(*[
+                OpenLoopBenchmark(
+                    _router_cfg(sc.router_url), rates=[250.0],
+                    step_s=1.2, seed=11 + w, conns=2, W=0.5, K=64,
+                    client_tag=f"x{w}w", drain_s=3.0,
+                    key_map=maps[w]["crossing"]).run()
+                for w in range(2)])
+            for out in outs:
+                assert out["total_completed"] > 0
+                assert (out["anomalies"] or 0) == 0
+        finally:
+            await sc.stop()
+    asyncio.run(main())

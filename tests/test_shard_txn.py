@@ -1,0 +1,203 @@
+"""Cross-shard 2PC over per-group Paxos logs (paxi_tpu/shard/txn.py):
+commit / conflict-abort semantics, and the mid-2PC coordinator-kill
+matrix (hunt/cases.SHARD_ROUTER_CASES) replayed on ONE virtual-clock
+fabric sequencing every group's deliveries — atomicity must hold at
+every kill point."""
+
+import asyncio
+
+import pytest
+
+from paxi_tpu.core.command import Command, Request, pack_tpc
+from paxi_tpu.host.fabric import VirtualClockFabric
+from paxi_tpu.hunt.cases import SHARD_ROUTER_CASES
+from paxi_tpu.shard import (CoordinatorKilled, ShardCoordinator,
+                            ShardedCluster, atomic_check)
+
+pytestmark = pytest.mark.host
+
+
+def direct_submit(sc):
+    """ShardCoordinator transport for fabric tests: records pack to
+    their TPC_MAGIC wire form and inject straight into each group's
+    entry replica (the router's /tpc hop collapsed away — the fabric
+    owns every consensus delivery)."""
+    async def submit(group, key, rec):
+        value = pack_tpc(rec["kind"], rec["txid"],
+                         ops=rec.get("ops"),
+                         outcome=rec.get("outcome", ""))
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+
+        def cb(rep, _fut=fut):
+            if not _fut.done():
+                _fut.set_result((not rep.err, rep.value
+                                 or (rep.err or "").encode()))
+        sc.leader_node(group).handle_client_request(Request(
+            command=Command(int(key), value), reply_to=cb))
+        return await fut
+    return submit
+
+
+async def drive(fab, aw, max_steps=600, tick_s=0.0):
+    """Run ``aw`` while stepping the fabric's logical clock; returns
+    the finished task (result OR exception kept)."""
+    task = asyncio.ensure_future(aw)
+    for _ in range(max_steps):
+        if task.done():
+            break
+        await fab.run(1)
+        if tick_s:
+            await asyncio.sleep(tick_s)
+    assert task.done(), "fabric steps exhausted mid-2PC"
+    return task
+
+
+def applied_pairs(sc, parts):
+    """The atomicity oracle's readback: per group, (txn value,
+    observed value) for every op, checked at EVERY replica (the
+    groups' logs must have converged identically)."""
+    pairs = {}
+    for g, ops in parts.items():
+        for r in sc.group(g).replicas.values():
+            for k, v in ops:
+                pairs.setdefault(g, []).append(
+                    (v, r.db.get(k) or b""))
+    return pairs
+
+
+def fresh_parts(span, G, base):
+    gsize = span // G
+    return {g: [(g * gsize + base, f"v{g}:{base}".encode())]
+            for g in range(G)}
+
+
+def _fabric_cluster(groups=2, n=3):
+    fab = VirtualClockFabric()
+    sc = ShardedCluster("paxos", groups=groups, n=n, http=False,
+                        fabric=fab, tag="txnfab")
+    return fab, sc
+
+
+def test_txn_commit_all_groups():
+    async def main():
+        fab, sc = _fabric_cluster()
+        await sc.start()
+        try:
+            coord = ShardCoordinator(direct_submit(sc), lease_s=0.0)
+            parts = fresh_parts(sc.map.span, 2, 100)
+            task = await drive(fab, coord.run_txn(parts))
+            out = task.result()
+            assert out.committed, out
+            # prepare-point previous values: all fresh keys -> empty
+            assert all(v == [b""] for v in out.values.values())
+            pairs = applied_pairs(sc, parts)
+            assert atomic_check(pairs)
+            assert all(obs == want for ps in pairs.values()
+                       for want, obs in ps), "committed txn not applied"
+        finally:
+            await sc.stop()
+    asyncio.run(main())
+
+
+def test_txn_conflict_votes_no_and_aborts():
+    async def main():
+        fab, sc = _fabric_cluster()
+        await sc.start()
+        try:
+            submit = direct_submit(sc)
+            coord = ShardCoordinator(submit, lease_s=0.0)
+            parts = fresh_parts(sc.map.span, 2, 200)
+            blocked_key = parts[0][0][0]
+            # another in-flight txn already staged the group-0 key
+            task = await drive(fab, submit(
+                0, blocked_key,
+                {"kind": "prepare", "txid": "blocker",
+                 "ops": [(blocked_key, b"held")]}))
+            ok, payload = task.result()
+            assert ok and payload.startswith(b"yes:")
+            task = await drive(fab, coord.run_txn(parts))
+            out = task.result()
+            assert not out.committed and "abort" in out.err
+            pairs = applied_pairs(sc, parts)
+            assert atomic_check(pairs)
+            assert not any(obs == want for ps in pairs.values()
+                           for want, obs in ps), "aborted txn applied"
+            # the blocker aborts; a retry of the same txn now commits
+            task = await drive(fab, submit(
+                0, blocked_key, {"kind": "abort", "txid": "blocker"}))
+            assert task.result()[0]
+            task = await drive(fab, coord.run_txn(parts))
+            assert task.result().committed
+            assert atomic_check(applied_pairs(sc, parts))
+        finally:
+            await sc.stop()
+    asyncio.run(main())
+
+
+@pytest.mark.parametrize("point,groups,n,seeds",
+                         SHARD_ROUTER_CASES,
+                         ids=[c[0] for c in SHARD_ROUTER_CASES])
+def test_coordinator_kill_matrix(point, groups, n, seeds):
+    """The hunt matrix: kill the coordinator at ``point`` mid-2PC,
+    replay the groups on the virtual-clock fabric, run recovery, and
+    require (a) one outcome everywhere — the atomicity oracle — and
+    (b) the decide-log semantics: a kill AFTER the decide record must
+    recover to COMMIT, a kill before it to ABORT (presumed abort)."""
+    async def one(seed):
+        fab, sc = _fabric_cluster(groups=groups, n=n)
+        await sc.start()
+        try:
+            submit = direct_submit(sc)
+            coord = ShardCoordinator(submit, lease_s=0.0)
+            parts = fresh_parts(sc.map.span, groups, 300 + seed)
+            task = await drive(fab,
+                               coord.run_txn(parts, crash_at=point))
+            exc = task.exception()
+            assert isinstance(exc, CoordinatorKilled), exc
+            # a fresh recovery party takes over (lease fence > 0:
+            # wall time passes while the fabric keeps stepping)
+            rec = ShardCoordinator(submit, lease_s=0.05)
+            rtask = await drive(fab, rec.recover(exc.txid, parts),
+                                tick_s=0.001)
+            outcome = rtask.result()
+            want = "c" if point in ("after_decide", "mid_commit") \
+                else "a"
+            assert outcome == want, (point, outcome)
+            pairs = applied_pairs(sc, parts)
+            assert atomic_check(pairs), (point, pairs)
+            fully = all(obs == want_v for ps in pairs.values()
+                        for want_v, obs in ps)
+            assert fully == (outcome == "c"), (point, outcome, pairs)
+        finally:
+            await sc.stop()
+
+    async def main():
+        for seed in seeds:
+            await one(seed)
+    asyncio.run(main())
+
+
+def test_recovery_is_idempotent_against_live_coordinator():
+    """The decide race both ways: recovery colliding with a txn that
+    already finished must adopt the committed outcome and leave state
+    untouched."""
+    async def main():
+        fab, sc = _fabric_cluster()
+        await sc.start()
+        try:
+            submit = direct_submit(sc)
+            coord = ShardCoordinator(submit, lease_s=0.0)
+            parts = fresh_parts(sc.map.span, 2, 400)
+            task = await drive(fab, coord.run_txn(parts))
+            txid = task.result().txid
+            assert task.result().committed
+            rec = ShardCoordinator(submit, lease_s=0.0)
+            rtask = await drive(fab, rec.recover(txid, parts))
+            assert rtask.result() == "c"
+            pairs = applied_pairs(sc, parts)
+            assert all(obs == want for ps in pairs.values()
+                       for want, obs in ps)
+        finally:
+            await sc.stop()
+    asyncio.run(main())
