@@ -167,11 +167,24 @@ def worker() -> int:
     n_slots = int(os.environ.get("BENCH_RING", 64))
 
     # layout by backend: lane-major (G-last) feeds the TPU vector lanes;
-    # the per-group kernel vmapped over a leading G axis is ~6x faster
+    # the per-group kernel vmapped over a leading G axis is faster
     # on XLA:CPU (VERDICT r4 weak #1).  --backend pallas forces the
     # lane-major kernel (the layout the fused exchange was built for).
-    proto = sim_protocol("paxos" if (backend == "pallas" or not on_cpu)
-                         else "paxos_pg")
+    # BENCH_KERNEL / --kernel overrides the choice — how the fixed-cell
+    # lane-major curves (PR 15) and their frozen sliding-window
+    # controls ("<name>_sw" resolves the sim_sw reference module) are
+    # measured side by side.
+    kname = os.environ.get("BENCH_KERNEL", "")
+    if kname.endswith("_sw"):
+        import importlib
+        proto = importlib.import_module(
+            f"paxi_tpu.protocols.{kname[:-3]}.sim_sw").PROTOCOL
+    elif kname:
+        proto = sim_protocol(kname)
+    else:
+        proto = sim_protocol("paxos"
+                             if (backend == "pallas" or not on_cpu)
+                             else "paxos_pg")
     cfg = SimConfig(n_replicas=n_replicas, n_slots=n_slots)
     exchange = "pallas" if backend == "pallas" else "dense"
     if use_mesh:
@@ -469,6 +482,10 @@ def main(argv=None) -> int:
                    help="skip accelerator attempts (BENCH_FORCE_CPU=1)")
     p.add_argument("--label", default=None,
                    help="BENCH_SCALING.json curve label (BENCH_LABEL)")
+    p.add_argument("--kernel", default=None,
+                   help="kernel override (BENCH_KERNEL): any registered "
+                        "sim protocol, or '<name>_sw' for a frozen "
+                        "sliding-window reference (layout A/B runs)")
     args = p.parse_args(argv)
     if args.mesh is not None:
         os.environ.setdefault("BENCH_MESH", args.mesh)
@@ -478,6 +495,8 @@ def main(argv=None) -> int:
         os.environ.setdefault("BENCH_FORCE_CPU", "1")
     if args.label is not None:
         os.environ.setdefault("BENCH_LABEL", args.label)
+    if args.kernel is not None:
+        os.environ.setdefault("BENCH_KERNEL", args.kernel)
     if os.environ.get("BENCH_STAGE") == "worker":
         return worker()
     return launcher()

@@ -28,6 +28,11 @@ functions; guards the PR-11 on-device measurement layer):
 
 - ``measurement-isolation`` (measure.py,    PXM10x)
 
+Layout contracts (import/reference pins over the fixed-cell hot-path
+kernels; guards the PR-15 shift-gather elimination):
+
+- ``fixed-cell-layout``    (layout.py,      PXL11x)
+
 Entry points: ``python -m paxi_tpu lint [--rule ...] [--json]`` (cli.py;
 ``--rule`` takes family names or code prefixes like ``PXQ,PXB``) and
 :func:`run_lint` for tests/tooling.  Intentional exceptions live in
@@ -43,7 +48,8 @@ from pathlib import Path
 from typing import Dict, List, Optional, Sequence
 
 from paxi_tpu.analysis import astutil, asyncflow, ballots, concurrency, \
-    crossflow, handlers, measure, parity, purity, quorum, tracemap
+    crossflow, handlers, layout, measure, parity, purity, quorum, \
+    tracemap
 from paxi_tpu.analysis.model import (LintReport, Suppression, Violation,
                                      apply_suppressions, inline_disables,
                                      load_baseline)
@@ -64,6 +70,7 @@ RULES = {
     crossflow.RULE: crossflow,
     asyncflow.RULE: asyncflow,
     measure.RULE: measure,
+    layout.RULE: layout,
 }
 
 # violation-code prefix -> rule family, the CLI's short spelling
@@ -80,6 +87,7 @@ CODE_PREFIXES = {
     "PXF": crossflow.RULE,
     "PXA": asyncflow.RULE,
     "PXM": measure.RULE,
+    "PXL": layout.RULE,
 }
 
 # pair-driven rules (registry-derived sim/host pairs instead of globs)

@@ -70,6 +70,10 @@ TARGETS = (
     "paxi_tpu/protocols/*/sim.py",
     "paxi_tpu/protocols/*/sim_pg.py",
     "paxi_tpu/sim/ballot_ring.py",
+    # the fixed-cell twin of the ballot-ring core (PR 15): same
+    # epoch-plane writes, same guard-domination obligation, proven
+    # through ITS consumers' call sites (paxos/sdpaxos/wankeeper)
+    "paxi_tpu/sim/cell_ring.py",
 )
 
 SIM_TYPES = "paxi_tpu/sim/types.py"

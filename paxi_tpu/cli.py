@@ -449,7 +449,7 @@ def cmd_profile(args) -> int:
                      slots=args.slots, seed=args.seed,
                      shard=args.shard, repeats=args.repeats,
                      exchange=args.exchange, trace_dir=args.trace_dir,
-                     fuzz=fuzz)
+                     gathers=args.gathers, fuzz=fuzz)
 
 
 def cmd_trace(args) -> int:
@@ -967,6 +967,12 @@ def main(argv=None) -> int:
                     dest="trace_dir", default="",
                     help="also write a jax.profiler trace here "
                          "(view with tensorboard/xprof)")
+    pr.add_argument("-gathers", "--gathers", action="store_true",
+                    help="skip the timed run; report compiled-HLO "
+                         "data-movement op counts instead — for the "
+                         "five fixed-cell kernels also compiles the "
+                         "frozen sim_sw layout twin and prints the "
+                         "gathers-eliminated delta")
     pr.set_defaults(fn=cmd_profile)
 
     t = sub.add_parser("trace", help="violation traces: replay/shrink")

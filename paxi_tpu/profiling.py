@@ -22,10 +22,38 @@ from __future__ import annotations
 
 import contextlib
 import json
+import re
 import time
 from typing import Optional
 
-__all__ = ["run_profile"]
+__all__ = ["run_profile", "gather_report"]
+
+# kernels rewritten onto the fixed-cell layout (PR 15) keep their
+# frozen sliding-window counterpart in-tree as ``sim_sw.py`` — both
+# for the bit-canonical equivalence proof (tests/test_fixed_cell_equiv)
+# and so ``profile --gathers`` can diff the two compiled HLOs and make
+# the "shift gathers eliminated" claim checkable from the CLI
+SW_TWINS = {
+    "paxos": "paxi_tpu.protocols.paxos.sim_sw",
+    "sdpaxos": "paxi_tpu.protocols.sdpaxos.sim_sw",
+    "wpaxos": "paxi_tpu.protocols.wpaxos.sim_sw",
+    "wankeeper": "paxi_tpu.protocols.wankeeper.sim_sw",
+    "bpaxos": "paxi_tpu.protocols.bpaxos.sim_sw",
+}
+
+# data-movement op families worth watching in the optimized HLO; the
+# fixed-cell claim is about ``gather`` (XLA:CPU scalarizes it), the
+# others are context
+_HLO_OPS = ("gather", "scatter", "dynamic-slice", "dynamic-update-slice")
+
+
+def hlo_op_counts(compiled) -> dict:
+    """Count data-movement ops in a compiled executable's optimized
+    HLO.  ``(?<![-\\w])`` keeps collective ops (all-gather) and name
+    fragments from inflating the counts."""
+    txt = compiled.as_text()
+    return {op: len(re.findall(rf"(?<![-\w]){op}\(", txt))
+            for op in _HLO_OPS}
 
 
 def run_profile(algorithm: str = "paxos_pg", groups: int = 2048,
@@ -71,6 +99,7 @@ def run_profile(algorithm: str = "paxos_pg", groups: int = 2048,
     t0 = time.perf_counter()
     compiled = lowered.compile()
     compile_s = time.perf_counter() - t0
+    hlo_ops = hlo_op_counts(compiled)
 
     t0 = time.perf_counter()
     jax.block_until_ready(compiled(jr.PRNGKey(seed + 1)))
@@ -107,11 +136,67 @@ def run_profile(algorithm: str = "paxos_pg", groups: int = 2048,
         "slots_per_s": round(committed / best, 1),
         "committed_slots": committed,
         "invariant_violations": int(viols),
+        # data-movement ops in the optimized HLO (hlo_op_counts): the
+        # structural half of a wall-time regression diagnosis — a
+        # jump in ``gather`` on a fixed-cell kernel means a layout
+        # regression (see gather_report / ``profile --gathers``)
+        "hlo_ops": hlo_ops,
         "profile_dir": trace_dir or None,
     }
 
 
+def gather_report(algorithm: str = "paxos", groups: int = 64,
+                  steps: int = 16, replicas: int = 5, slots: int = 64,
+                  fuzz=None) -> dict:
+    """Compile a kernel (small shape — op counts are shape-independent
+    structure) and report its data-movement op counts; for the five
+    fixed-cell rewrites, also compile the frozen ``sim_sw`` layout twin
+    and report the before/after delta — the CLI-checkable form of the
+    "per-step ring-shift gathers eliminated" claim.
+
+    ``python -m paxi_tpu profile --gathers [-algorithm X]``."""
+    import importlib
+
+    import jax.random as jr
+
+    from paxi_tpu.protocols import sim_protocol
+    from paxi_tpu.sim import FuzzConfig, SimConfig, make_run
+
+    cfg = SimConfig(n_replicas=replicas, n_slots=slots)
+    fuzz = fuzz or FuzzConfig()
+
+    def compile_counts(proto):
+        run = make_run(proto, cfg, fuzz=fuzz)
+        return hlo_op_counts(run.lower(jr.PRNGKey(0), groups, steps)
+                             .compile())
+
+    out = {
+        "algorithm": algorithm,
+        "groups": groups,
+        "steps": steps,
+        "replicas": replicas,
+        "ring_slots": slots,
+        "hlo_ops": compile_counts(sim_protocol(algorithm)),
+    }
+    tw = SW_TWINS.get(algorithm)
+    if tw is not None:
+        sw = importlib.import_module(tw).PROTOCOL
+        out["hlo_ops_sw"] = compile_counts(sw)
+        out["gathers_eliminated"] = (out["hlo_ops_sw"]["gather"]
+                                     - out["hlo_ops"]["gather"])
+    return out
+
+
 def main_json(**kw) -> int:
+    if kw.pop("gathers", False):
+        kw.pop("seed", None)
+        kw.pop("shard", None)
+        kw.pop("repeats", None)
+        kw.pop("exchange", None)
+        kw.pop("trace_dir", None)
+        rep = gather_report(**kw)
+        print(json.dumps(rep))
+        return 0
     rep = run_profile(**kw)
     print(json.dumps(rep))
     return 0 if rep["invariant_violations"] == 0 else 1

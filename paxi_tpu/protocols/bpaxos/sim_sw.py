@@ -1,63 +1,21 @@
-"""Compartmentalized high-throughput Paxos (BPaxos) as a TPU kernel.
+"""FROZEN pre-rewrite reference: the sliding-window (ring-position)
+lane-major bpaxos kernel, kept verbatim from before the fixed-cell
+rewrite (PR 15) as the equivalence-proof counterpart.
 
-Reference: "Bipartisan Paxos: A Modular State Machine Replication
-Protocol" + "HT-Paxos" (PAPERS.md) — decouple the monolithic replica
-into roles that scale out independently, and amortize one quorum round
-over a *batch* of client commands:
-
-- **proxy leaders** (nodes ``0..P-1``): own disjoint slot stripes
-  (slot ``s`` belongs to proxy ``s % P``), accept client command
-  batches and drive phase-2, one grid round per slot;
-- **acceptor grid** (the next ``GR x GC`` nodes, row-major): the first
-  protocol in this repo whose quorum system is NOT a simple majority —
-  the write quorum is ONE FULL ROW (``GC`` acceptors), the read
-  quorum ONE FULL COLUMN (``GR`` acceptors); any row and any column
-  share exactly one cell, so every read/write pair intersects
-  (``paxi-lint``'s PXQ rowcol proof derives this from the tallies
-  below);
-- **replica executors** (the rest): learn commits (P3), execute the
-  contiguous prefix, and answer clients.
-
-TPU re-design (not a translation):
-- lane-major batch layout (sim/lanes.py): state ``(R, G)`` /
-  ``(R, S, G)``, mailbox planes ``(src, dst, G)``; roles are static
-  index masks over one node axis, so every handler is a masked update
-  on the whole grid at once.
-- per-slot ballots (BPaxos instances are independent): acceptors keep
-  a promised-ballot ring ``abal`` next to the accepted value
-  ``(vbal, vcmd, vbsz)``; there is no global leader and no election —
-  steady state is phase-2 only.
-- **HT-Paxos batching**: a slot carries a command *batch* — ``vcmd``
-  is the batch id (encodes proposer ballot + slot, so the agreement
-  oracle catches divergent decisions), ``vbsz`` its size (drawn
-  ``1..batch_max`` per proposal); ``committed_cmds`` counts commands,
-  not slots, so the amortization is visible in the metrics.
-- **thrifty grid messaging**: a proposal P2a goes only to the target
-  row (``slot % GR``), a recovery read P1a only to one column —
-  exactly the quorum, never the whole acceptor set.
-- **takeover recovery** (the read quorum's reason to exist): when a
-  proxy's execute frontier stalls on a hole while commits exist above
-  it (evidence the hole's owner is stuck or dead), it runs classic
-  per-slot Paxos recovery at a fresh higher ballot: read ONE FULL
-  COLUMN (rotating per attempt, so a crashed acceptor's column is
-  eventually avoided), adopt the highest-ballot accepted value (else
-  NOOP), then write ONE FULL ROW (also rotating).  Takeover timers
-  stagger by stripe distance so the owner retries first.
-- ``Quorum.ACK`` is a bit-packed int32 ack mask over the node axis;
-  the grid predicates are ``_row_quorums`` / ``_col_quorums`` —
-  per-line popcounts that count COMPLETE rows/columns (the fullness
-  paxi-lint's PXQ rowcol rule verifies symbolically).
-
-The same protocol runs event-driven on the host runtime (host.py);
-``PROTOCOL_NOREAD`` is the seeded-bug hunt twin whose recovery skips
-the column read — the exact mistake the grid intersection prevents —
-and is expected to violate agreement under drops (hunt positive
-control, never a correctness case).
+Ring layout contract (the OLD one): ring position ``i`` holds absolute
+slot ``base + i``; every base advance is a ``ring.shift_window`` data
+movement.  The live kernel in ``sim.py`` holds absolute slot ``a`` at
+cell ``a % S`` forever (sim/cell.py) and must stay BIT-CANONICALLY
+equal to this module on pinned fuzz seeds: same PRNG draws, same
+outboxes, same counters, and a state that matches after rolling each
+ring plane to window order (cell.window_view_np) —
+tests/test_fixed_cell_equiv.py enforces it, and ``python -m paxi_tpu
+profile --gathers`` diffs the two compiled HLOs' gather counts.  Do
+not edit except to mirror a semantic (non-layout) change in sim.py.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, Tuple
 
 import jax
@@ -66,8 +24,9 @@ import jax.random as jr
 
 from paxi_tpu.metrics import lathist
 from paxi_tpu.ops.hashing import fib_key
-from paxi_tpu.sim import cell, inscan
+from paxi_tpu.sim import inscan
 from paxi_tpu.sim.ring import require_packable
+from paxi_tpu.sim.ring import shift_window as _shift
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 NO_CMD = -1    # empty log entry
@@ -213,13 +172,9 @@ def _step(state, inbox, ctx: StepCtx, *, read_quorum: bool = True):
         return jnp.sum(jnp.where(oh, plane, 0), axis=1)
 
     def slot_oh(slot):
-        # fixed cell mapping (sim/cell.py): the one-hot must be masked
-        # in-window — an out-of-window slot's cell holds a DIFFERENT
-        # absolute slot (the old ring-position one-hot missed for free)
-        inw = cell.in_window(slot, base, S)
-        oh = inw[:, None, :] \
-            & (sidx[None, :, None] == jnp.remainder(slot, S)[:, None, :])
-        return oh, inw
+        rel = slot - base
+        inw = (rel >= 0) & (rel < S)
+        return sidx[None, :, None] == rel[:, None, :], inw
 
     def out_planes(fields):
         z = jnp.zeros((R, R, G), i32)
@@ -347,22 +302,19 @@ def _step(state, inbox, ctx: StepCtx, *, read_quorum: bool = True):
         # entries (shifted, promises included) where the sender has no
         # commit, and adopt the sender's executed state wholesale.
         # Adoption is BY REFERENCE to the sender's live base/planes
-        # (the wpaxos/cell_ring precedent): a message-carried window
+        # (the wpaxos/ballot_ring precedent): a message-carried window
         # base goes stale between send and delivery as the sender's
         # ring slides, and re-basing to a stale base misaligns every
-        # adopted slot.  Fixed cell mapping: the sender's cells are
-        # already aligned with mine — keep my cells still inside the
-        # sender's window, everything below was recycled (masked
-        # clears, no re-alignment shifts).
+        # adopted slot.
         low = base[s][None, :]
         adopt = ok & (execute < low)
         a2 = adopt[:, None, :]
-        keep = cell.cell_abs(base, S) >= low[:, None, :]
-        my_abal = jnp.where(keep, abal, 0)
-        my_vbal = jnp.where(keep, vbal, 0)
-        my_vcmd = jnp.where(keep, vcmd, NO_CMD)
-        my_vbsz = jnp.where(keep, vbsz, 0)
-        my_com = keep & committed
+        adv_a = jnp.where(adopt, low - base, 0)
+        my_abal = _shift(abal, adv_a, 0)
+        my_vbal = _shift(vbal, adv_a, 0)
+        my_vcmd = _shift(vcmd, adv_a, NO_CMD)
+        my_vbsz = _shift(vbsz, adv_a, 0)
+        my_com = _shift(committed, adv_a, False)
         s_com = committed[s][None]
         abal = jnp.where(a2, jnp.maximum(abal[s][None], my_abal), abal)
         vbal = jnp.where(a2, jnp.where(s_com, vbal[s][None], my_vbal),
@@ -402,14 +354,12 @@ def _step(state, inbox, ctx: StepCtx, *, read_quorum: bool = True):
     rec_slot = jnp.where(drop_rec, -1, rec_slot)
 
     # ------------- execute the contiguous committed prefix --------------
-    abs_ = cell.cell_abs(base, S)        # abs slot per cell (fixed map)
+    abs_ = base[:, None, :] + sidx[None, :, None]
     advanced = jnp.zeros_like(execute)
     running = jnp.ones_like(execute, dtype=bool)
     for e in range(cfg.exec_window):
-        abs_e = execute + e                              # absolute
-        inb_e = abs_e < base + S                         # execute >= base
-        oh_e = inb_e[:, None, :] & (sidx[None, :, None]
-                                    == jnp.remainder(abs_e, S)[:, None, :])
+        rel = execute + e - base
+        oh_e = sidx[None, :, None] == rel[:, None, :]
         com = jnp.any(oh_e & committed, axis=1)
         running = running & com
         cmd_e = at_slot(vcmd, oh_e)
@@ -434,15 +384,16 @@ def _step(state, inbox, ctx: StepCtx, *, read_quorum: bool = True):
               & (abs_ < next_slot[:, None, :]))
     proposed = proposed & ~reopen
 
-    BIGS = jnp.int32(2 ** 30)
     mask_re = (is_proxy[:, None, :] & own & ~proposed & ~committed
                & (abs_ < next_slot[:, None, :]))
-    re_abs = jnp.min(jnp.where(mask_re, abs_, BIGS), axis=1)
+    first_re = jnp.argmin(jnp.where(mask_re, sidx[None, :, None], S),
+                          axis=1).astype(i32)
     has_re = jnp.any(mask_re, axis=1)
     can_new = (next_slot - base) < S
-    prop_slot = jnp.where(has_re, re_abs, next_slot)     # absolute
-    oh_p = sidx[None, :, None] \
-        == jnp.remainder(prop_slot, S)[:, None, :]
+    rel_new = jnp.clip(next_slot - base, 0, S - 1)
+    prop_rel = jnp.where(has_re, first_re, rel_new)
+    prop_slot = base + prop_rel
+    oh_p = sidx[None, :, None] == prop_rel[:, None, :]
     # skip own fresh slots someone else already recovered (NOOP-filled)
     fresh_com = jnp.any(oh_p & committed, axis=1)
     is_new = ~has_re & can_new
@@ -499,19 +450,22 @@ def _step(state, inbox, ctx: StepCtx, *, read_quorum: bool = True):
     }
 
     # ------------- outgoing P3: fresh commit else retransmit ------------
-    low_new = jnp.min(jnp.where(newly, abs_, BIGS), axis=1)  # abs
+    low_new = jnp.argmin(jnp.where(newly, sidx[None, :, None], S),
+                         axis=1).astype(i32)
     any_new = jnp.any(newly, axis=1)
     span = jnp.maximum(new_execute - base, 1)
-    p3_abs = jnp.where(any_new, low_new, base + ctx.t % span)
-    p3_abs = jnp.where(rec_done & rec_inw, rec_slot, p3_abs)
-    oh_3 = sidx[None, :, None] == jnp.remainder(p3_abs, S)[:, None, :]
+    p3_rel = jnp.where(any_new, low_new, ctx.t % span)
+    p3_rel = jnp.where(rec_done & rec_inw,
+                       jnp.clip(rec_slot - base, 0, S - 1), p3_rel)
+    p3_rel = jnp.clip(p3_rel, 0, S - 1).astype(i32)
+    oh_3 = sidx[None, :, None] == p3_rel[:, None, :]
     p3_commit = jnp.any(oh_3 & committed, axis=1)
     p3_do = is_proxy & p3_commit
     out_p3 = {
         "valid": jnp.broadcast_to(p3_do[:, None, :], (R, R, G)),
         "bal": jnp.broadcast_to(at_slot(vbal, oh_3)[:, None, :],
                                 (R, R, G)),
-        "slot": jnp.broadcast_to(p3_abs[:, None, :], (R, R, G)),
+        "slot": jnp.broadcast_to((base + p3_rel)[:, None, :], (R, R, G)),
         "cmd": jnp.broadcast_to(at_slot(vcmd, oh_3)[:, None, :],
                                 (R, R, G)),
         "bsz": jnp.broadcast_to(at_slot(vbsz, oh_3)[:, None, :],
@@ -519,9 +473,7 @@ def _step(state, inbox, ctx: StepCtx, *, read_quorum: bool = True):
     }
 
     # ------------- takeover trigger + recovery restart ------------------
-    hole_oh = ((new_execute < base + S)[:, None, :]
-               & (sidx[None, :, None]
-                  == jnp.remainder(new_execute, S)[:, None, :]))
+    hole_oh = sidx[None, :, None] == (new_execute - base)[:, None, :]
     hole_com = jnp.any(hole_oh & committed, axis=1)
     evid = jnp.any(committed & (abs_ > new_execute[:, None, :]), axis=1)
     owner = new_execute % P
@@ -553,34 +505,34 @@ def _step(state, inbox, ctx: StepCtx, *, read_quorum: bool = True):
     abal = jnp.maximum(abal, jnp.where(committed, vbal, 0))
 
     # ------------- slide the ring past the executed prefix --------------
-    # fixed cell mapping: recycled cells reset in place, nothing moves
     new_base = jnp.maximum(base, new_execute - RETAIN)
-    drop = cell.cell_abs(base, S) < new_base[:, None, :]
-    new_committed = committed & ~drop
-    new_vcmd = jnp.where(drop, NO_CMD, vcmd)
+    adv = new_base - base
+    new_committed = _shift(committed, adv, False)
+    new_vcmd = _shift(vcmd, adv, NO_CMD)
 
     # in-scan linearizability spot-check (sim/inscan): an independent
     # oracle beside invariants(), accumulated on device per group
     m_inscan_viol = state["m_inscan_viol"] + inscan.spot_check(
         state["execute"], new_execute, state["base"], new_base,
-        cell.cell_abs(state["base"], S), cell.cell_abs(new_base, S),
+        state["base"][:, None, :] + sidx[None, :, None],
+        new_base[:, None, :] + sidx[None, :, None],
         state["vcmd"], new_vcmd,
         state["committed"], new_committed,
         kv=kv, lane_major=True)
 
     new_state = dict(
-        abal=jnp.where(drop, 0, abal), vbal=jnp.where(drop, 0, vbal),
-        vcmd=new_vcmd, vbsz=jnp.where(drop, 0, vbsz),
+        abal=_shift(abal, adv, 0), vbal=_shift(vbal, adv, 0),
+        vcmd=new_vcmd, vbsz=_shift(vbsz, adv, 0),
         committed=new_committed,
-        proposed=proposed & ~drop,
-        p2_acks=jnp.where(drop, 0, p2_acks),
+        proposed=_shift(proposed, adv, False),
+        p2_acks=_shift(p2_acks, adv, 0),
         next_slot=next_slot, base=new_base, execute=new_execute,
         kv=kv, cum_cmds=cum_cmds, stuck=stuck,
         rec_slot=rec_slot, rec_bal=rec_bal, rec_phase=rec_phase,
         rec_acks=rec_acks, rec_vbal=rec_vbal, rec_vcmd=rec_vcmd,
         rec_vbsz=rec_vbsz, rec_round=rec_round, rec_timer=rec_timer,
         recovered=recovered,
-        m_prop_t=jnp.where(drop, 0, m_prop_t), m_lat_hist=m_lat_hist,
+        m_prop_t=_shift(m_prop_t, adv, 0), m_lat_hist=m_lat_hist,
         m_lat_sum=m_lat_sum, m_inscan_viol=m_inscan_viol,
     )
     outbox = {"p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
@@ -615,29 +567,32 @@ def invariants(old, new, cfg: SimConfig) -> jax.Array:
     5. Batch sanity: committed batch sizes are in 0..batch_max."""
     BIG = jnp.int32(2**30)
     S = cfg.n_slots
+    sidx = jnp.arange(S, dtype=jnp.int32)
     base, c = new["base"], new["committed"]
     cmd, bsz = new["vcmd"], new["vbsz"]
-    Ab = cell.cell_abs(base, S)
 
-    # 1. agreement on the common window (cells align under the fixed
-    # mapping — see paxos/sim.invariants)
-    vis = c & (Ab >= jnp.max(base, axis=0)[None, None, :])
-    n_c = jnp.sum(vis, axis=0)
-    mx = jnp.max(jnp.where(vis, cmd, -BIG), axis=0)
-    mn = jnp.min(jnp.where(vis, cmd, BIG), axis=0)
-    bx = jnp.max(jnp.where(vis, bsz, -BIG), axis=0)
-    bn = jnp.min(jnp.where(vis, bsz, BIG), axis=0)
+    # 1. agreement on the aligned window
+    align = jnp.max(base, axis=0)[None, :] - base
+    a_c = _shift(c, align, False)
+    a_cmd = _shift(cmd, align, NO_CMD)
+    a_bsz = _shift(bsz, align, 0)
+    n_c = jnp.sum(a_c, axis=0)
+    mx = jnp.max(jnp.where(a_c, a_cmd, -BIG), axis=0)
+    mn = jnp.min(jnp.where(a_c, a_cmd, BIG), axis=0)
+    bx = jnp.max(jnp.where(a_c, a_bsz, -BIG), axis=0)
+    bn = jnp.min(jnp.where(a_c, a_bsz, BIG), axis=0)
     v_agree = jnp.sum((n_c >= 1) & ((mx != mn) | (bx != bn)))
 
-    # 2. stability (retained cells keep their absolute slot in place)
-    kept = cell.cell_abs(old["base"], S) >= base[:, None, :]
-    o_c = old["committed"] & kept
-    v_stable = jnp.sum(o_c & (~c | (cmd != old["vcmd"])
-                              | (bsz != old["vbsz"])))
+    # 2. stability
+    adv = base - old["base"]
+    o_c = _shift(old["committed"], adv, False)
+    o_cmd = _shift(old["vcmd"], adv, NO_CMD)
+    o_bsz = _shift(old["vbsz"], adv, 0)
+    v_stable = jnp.sum(o_c & (~c | (cmd != o_cmd) | (bsz != o_bsz)))
     v_stable = v_stable + jnp.sum(new["execute"] < base)
 
     # 3. promise monotonicity + accepted <= promised
-    o_abal = jnp.where(kept, old["abal"], 0)
+    o_abal = _shift(old["abal"], adv, 0)
     v_bal = jnp.sum(new["abal"] < o_abal)
     P, GR, GC, A, E = _geometry(cfg)
     ridx = jnp.arange(cfg.n_replicas, dtype=jnp.int32)
@@ -645,7 +600,8 @@ def invariants(old, new, cfg: SimConfig) -> jax.Array:
     v_bal = v_bal + jnp.sum(is_acc & (new["vbal"] > new["abal"]))
 
     # 4. executed prefix committed
-    v_exec = jnp.sum((Ab < new["execute"][:, None, :]) & ~c)
+    abs_ = base[:, None, :] + sidx[None, :, None]
+    v_exec = jnp.sum((abs_ < new["execute"][:, None, :]) & ~c)
 
     # 5. batch sizes sane
     v_bsz = jnp.sum(c & ((bsz < 0) | (bsz > cfg.batch_max)))
@@ -658,24 +614,10 @@ def step(state, inbox, ctx: StepCtx):
 
 
 PROTOCOL = SimProtocol(
-    name="bpaxos",
+    name="bpaxos_sw",
     mailbox_spec=mailbox_spec,
     init_state=init_state,
     step=step,
-    metrics=metrics,
-    invariants=invariants,
-    batched=True,
-)
-
-# the seeded-bug hunt twin: takeover recovery skips the column read and
-# blind-writes NOOP at a higher ballot — under drops it overwrites
-# already-chosen batches, violating agreement/stability BY DESIGN
-# (hunt positive control; never a correctness case)
-PROTOCOL_NOREAD = SimProtocol(
-    name="bpaxos_noread",
-    mailbox_spec=mailbox_spec,
-    init_state=init_state,
-    step=functools.partial(_step, read_quorum=False),
     metrics=metrics,
     invariants=invariants,
     batched=True,

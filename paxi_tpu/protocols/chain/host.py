@@ -169,4 +169,12 @@ SIM_STATE_MAP = {
     "kv":         "db",
     "stall":      "",  # retransmit ticks: host retries are wall-clock
     "reads_done": "",  # workload counter (metrics, not protocol state)
+    # on-device observability (PR 11, threaded through chain in PR 15)
+    # — measurement planes, excluded from the trace witness hash; the
+    # host twins are the registry's live latency histograms and the
+    # post-hoc linearizability checker
+    "m_prop_t":      "",
+    "m_lat_hist":    "",
+    "m_lat_sum":     "",
+    "m_inscan_viol": "",
 }

@@ -34,8 +34,21 @@ from typing import Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from paxi_tpu.metrics import lathist
 from paxi_tpu.ops.hashing import fib_key
+from paxi_tpu.sim import inscan
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+
+
+def _seq_at(applied, S: int):
+    """The absolute sequence number ring cell ``c`` holds at a replica
+    with ``applied`` entries: the newest ``a`` < applied congruent to
+    ``c`` (mod S); negative = never written.  The chain log is already
+    fixed-cell (``seq % S`` — see the module docstring), so this is
+    pure elementwise arithmetic, same as invariants() uses."""
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    last = applied[:, None, :] - 1
+    return last - ((last - sidx[None, :, None]) % S)
 
 
 def mailbox_spec(cfg: SimConfig) -> Dict[str, Tuple[str, ...]]:
@@ -77,6 +90,19 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
         stall=jnp.zeros((R, G), i32),
         kv=jnp.zeros((R, K, G), i32),
         reads_done=jnp.zeros((R, G), i32),
+        # ---- on-device observability (PR-11 template: ``m_`` planes,
+        # excluded from the trace witness hash, never read by protocol
+        # logic — PXM10x).  m_prop_t stamps each write's head-append
+        # step at its ring cell; when the commit frontier (tail-applied
+        # learned at the head) advances, the covered writes bin their
+        # append->commit step delta into the shared log2 histogram
+        # (metrics/lathist) — the full-pipeline latency of chain
+        # replication.  m_inscan_viol accumulates the in-scan
+        # linearizability spot-check (sim/inscan).
+        m_prop_t=jnp.zeros((R, S, G), i32),
+        m_lat_hist=lathist.empty_hist(G),
+        m_lat_sum=jnp.zeros((G,), i32),
+        m_inscan_viol=jnp.zeros((G,), i32),
     )
 
 
@@ -135,6 +161,11 @@ def step(state, inbox, ctx: StepCtx):
     ohk = h_do[:, None, :] & (kidx[None, :, None] == h_key[:, None, :])
     kv = jnp.where(ohk, h_val[:, None, :], kv)
     applied = applied + h_do
+    # latency clock: stamp the append step at the write's ring cell
+    # (head lanes only; cell reuse IS the re-arm — an in-flight write
+    # stays ring-resident until committed, so its stamp survives)
+    m_prop_t = write_ring(state["m_prop_t"], h_do, h_seq,
+                          jnp.broadcast_to(ctx.t, h_seq.shape))
 
     # ------------- receive cumulative ack from successor -----------------
     m = inbox["ack"]
@@ -146,6 +177,20 @@ def step(state, inbox, ctx: StepCtx):
     seen_succ = jnp.maximum(state["seen_succ"], a_applied)
     committed = jnp.maximum(state["committed"], a_tail)
     committed = jnp.where(is_tail, applied, committed)
+
+    # in-kernel commit latency, measured at the head (the proposer):
+    # the commit-frontier advance [old, new) bins each covered write's
+    # append->commit step delta — all covered seqs are ring-resident at
+    # the head (flow control keeps applied - committed < S), so this is
+    # one elementwise mask over the ring, no gathers
+    seq_h = _seq_at(applied, S)
+    newly = (is_head[:, None, :]
+             & (seq_h >= state["committed"][:, None, :])
+             & (seq_h < committed[:, None, :]) & (seq_h >= 0))
+    lat_dt = jnp.clip(ctx.t - m_prop_t, 0, None)
+    m_lat_hist = lathist.hist_update(state["m_lat_hist"], lat_dt, newly)
+    m_lat_sum = state["m_lat_sum"] + jnp.sum(
+        jnp.where(newly, lat_dt, 0), axis=(0, 1), dtype=jnp.int32)
 
     # go-back-N: successor stalled => rewind the optimistic pointer
     stall = jnp.where(progress | ~av, 0, state["stall"] + av)
@@ -201,10 +246,27 @@ def step(state, inbox, ctx: StepCtx):
     served = is_tail & (applied > 0) & (r_val != 0)
     reads_done = state["reads_done"] + served
 
+    # in-scan linearizability spot-check (sim/inscan): applied is the
+    # execute frontier, the commit frontier is the base analog (cells
+    # below it are settled), log_val the committed-value plane — the
+    # chain log is already fixed-cell, so the abs plane is _seq_at
+    m_inscan_viol = state["m_inscan_viol"] + inscan.spot_check(
+        state["applied"], applied, state["committed"], committed,
+        _seq_at(state["applied"], S), _seq_at(applied, S),
+        state["log_val"], log_val,
+        (_seq_at(state["applied"], S) >= 0)
+        & (_seq_at(state["applied"], S)
+           < state["committed"][:, None, :]),
+        (_seq_at(applied, S) >= 0)
+        & (_seq_at(applied, S) < committed[:, None, :]),
+        kv=kv, lane_major=True)
+
     new_state = dict(
         log_key=log_key, log_val=log_val, applied=applied,
         committed=committed, known_succ=known_succ, seen_succ=seen_succ,
         stall=stall, kv=kv, reads_done=reads_done,
+        m_prop_t=m_prop_t, m_lat_hist=m_lat_hist, m_lat_sum=m_lat_sum,
+        m_inscan_viol=m_inscan_viol,
     )
     return new_state, {"prop": out_prop, "rep": out_rep, "ack": out_ack}
 
@@ -214,6 +276,11 @@ def metrics(state, cfg: SimConfig):
         "committed_slots": jnp.sum(state["committed"][0]),  # head frontier
         "tail_applied": jnp.sum(state["applied"][cfg.n_replicas - 1]),
         "reads_done": jnp.sum(state["reads_done"]),
+        # on-device observability scalars (PR-11 contract; the
+        # histogram itself rides in state as m_lat_hist)
+        "commit_lat_sum": jnp.sum(state["m_lat_sum"]),
+        "commit_lat_n": jnp.sum(state["m_lat_hist"]),
+        "inscan_violations": jnp.sum(state["m_inscan_viol"]),
     }
 
 

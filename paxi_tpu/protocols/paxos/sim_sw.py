@@ -1,46 +1,17 @@
-"""Multi-Paxos as a pure TPU transition kernel (lane-major layout).
+"""FROZEN pre-rewrite reference: the sliding-window (ring-position)
+lane-major paxos kernel, kept verbatim from before the fixed-cell
+rewrite (PR 15) as the equivalence-proof counterpart.
 
-Reference: paxi paxos/paxos.go — single stable leader, phase-1 ballot
-election with log recovery from P1b payloads, per-slot phase-2 acceptance
-under a majority quorum, P3 commit broadcast, in-order execution
-(HandleRequest/HandleP1a/HandleP1b/HandleP2a/HandleP2b/HandleP3) [driver].
-
-TPU re-design (not a translation):
-- **Lane-major batch layout** (see sim/lanes.py): the kernel operates on
-  the whole group batch with the group axis LAST — state ``(R, G)`` /
-  ``(R, S, G)``, mailbox planes ``(src, dst, G)`` — so the 100k-group
-  axis feeds the 8x128 vector lanes and every tile is full.  (The
-  earlier vmap-over-groups layout put (5, 64)-shaped trailing dims on
-  the lanes: <10% occupancy, slower than one CPU core.)
-- Per-replica state is a struct-of-arrays over a fixed **ring** of S
-  slots with a *fixed cell mapping* (sim/cell.py): absolute slot ``a``
-  always lives in cell ``a % S``; the window ``[base, base + S)``
-  slides forward as the execute frontier advances, retaining the last
-  ``S//2`` executed slots for laggard healing (the reference's
-  unbounded ``log map[int]*entry`` becomes O(window) — 10M slots run
-  in a 64-slot ring).  Sliding the window is a masked *clear* of
-  recycled cells, not a data movement — the per-step
-  ``ring.shift_window`` gathers the previous revision paid (~40% of
-  lane-major step cost on XLA:CPU) are gone; the frozen pre-rewrite
-  kernel survives as ``sim_sw.py`` and this kernel is proven
-  bit-canonically equal to it (tests/test_fixed_cell_equiv.py).
-- All handlers run every step on every replica as fully *masked*
-  updates (leader/follower divergence is `where`-selected).
-- Ballots are ``round * ballot_stride + replica_idx`` int32s
-  (paxos ballot.go packs n<<16|id the same way).
-- ``Quorum.ACK`` becomes a **bit-packed int32 ack mask** per (leader,
-  slot) with ``lax.population_count`` for ``Majority()`` (quorum.go
-  [driver]) — p1_acks (R, G), log_acks (R, S, G).
-- The ballot/ring consensus core (P1a/P1b promise+tally, by-reference
-  P1b merge with laggard state transfer, P2a/P2b, P3 commit + snapshot
-  catch-up, go-back-N stuck retry, jittered elections, window slide)
-  lives in **sim/cell_ring.py**, shared with the sdpaxos and wankeeper
-  kernels — this module contributes the client-load model and
-  execution.
-- Client load: the leader proposes one new command per step while the
-  window has room (closed-loop stream with window flow control);
-  commands encode (ballot, slot) so the agreement oracle can detect
-  any two-leaders-two-values divergence.
+Ring layout contract (the OLD one): ring position ``i`` holds absolute
+slot ``base + i``; every base advance is a ``ring.shift_window`` data
+movement.  The live kernel in ``sim.py`` holds absolute slot ``a`` at
+cell ``a % S`` forever (sim/cell.py) and must stay BIT-CANONICALLY
+equal to this module on pinned fuzz seeds: same PRNG draws, same
+outboxes, same counters, and a state that matches after rolling each
+ring plane to window order (cell.window_view_np) —
+tests/test_fixed_cell_equiv.py enforces it, and ``python -m paxi_tpu
+profile --gathers`` diffs the two compiled HLOs' gather counts.  Do
+not edit except to mirror a semantic (non-layout) change in sim.py.
 """
 
 from __future__ import annotations
@@ -52,14 +23,14 @@ import jax.numpy as jnp
 
 from paxi_tpu.metrics import lathist
 from paxi_tpu.ops.hashing import fib_key
-from paxi_tpu.sim import cell
-from paxi_tpu.sim import cell_ring as br
+from paxi_tpu.sim import ballot_ring as br
 from paxi_tpu.sim import inscan
-from paxi_tpu.sim.cell_ring import NO_CMD, NOOP
+from paxi_tpu.sim.ballot_ring import NO_CMD, NOOP
 from paxi_tpu.sim.ring import require_packable
+from paxi_tpu.sim.ring import shift_window as _shift
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
-# the ballot-ring planes cell_ring.py owns; this kernel adds kv
+# the ballot-ring planes ballot_ring.py owns; this kernel adds kv
 BR_KEYS = br.KEYS
 
 
@@ -95,7 +66,7 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
         ballot=jnp.zeros((R, G), i32),        # highest ballot seen/promised
         active=jnp.zeros((R, G), bool),       # leader with phase-1 done
         p1_acks=jnp.zeros((R, G), i32),       # [ldr] phase-1 ack bitmask
-        base=jnp.zeros((R, G), i32),          # window start (absolute)
+        base=jnp.zeros((R, G), i32),          # abs slot of ring pos 0
         log_bal=jnp.zeros((R, S, G), i32),    # accepted ballot per slot
         log_cmd=jnp.full((R, S, G), NO_CMD, i32),
         log_commit=jnp.zeros((R, S, G), bool),
@@ -122,8 +93,8 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
         # here (one masked write) and the runner bins them into
         # m_lat_hist every flush_every(S) steps under a lax.cond
         # (runner.flush_measurements) — position-free samples, so the
-        # plane is deliberately NOT re-armed with the ring (a recycle
-        # clear would drop pending samples); the flush period is
+        # plane is deliberately NOT shifted with the ring (a shift's
+        # fill would drop pending samples); the flush period is
         # shorter than any cell-reuse cycle
         m_commit_dt=jnp.zeros((R, S, G), i32),
         m_lat_hist=lathist.empty_hist(G),
@@ -142,10 +113,9 @@ def step(state, inbox, ctx: StepCtx):
 
     st = {k: state[k] for k in BR_KEYS}
     kv = state["kv"]
-    # measurement planes (never passed into cell_ring: the helpers
-    # recycle cells on base advances, so m_prop_t is re-armed here by
-    # the SAME clear after every base-moving call — cell.advance_clear
-    # is the fixed-cell twin of the old re-alignment shift)
+    # measurement planes (never passed into ballot_ring: the helpers
+    # shift the log planes by base deltas, so m_prop_t is re-aligned
+    # here by the SAME delta after every base-moving call)
     m_prop_t = state["m_prop_t"]
     m_lat_hist = state["m_lat_hist"]
     m_lat_sum = state["m_lat_sum"]
@@ -156,7 +126,7 @@ def step(state, inbox, ctx: StepCtx):
     b0 = st["base"]
     st, ex = br.adopt_best_acker(st, amask, p1_win, {"kv": kv})
     kv = ex["kv"]
-    m_prop_t = cell.advance_clear(m_prop_t, b0, st["base"], 0)
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
     st = br.merge_acker_logs(st, amask, p1_win)
     # a takeover restarts the adopted slots' latency clocks (re-owned
     # re-proposals measure from the takeover, like the wpaxos kernel)
@@ -174,14 +144,14 @@ def step(state, inbox, ctx: StepCtx):
     b0 = st["base"]
     st, ex, c_has, c_bal = br.apply_p3(st, inbox["p3"], {"kv": kv})
     kv = ex["kv"]
-    m_prop_t = cell.advance_clear(m_prop_t, b0, st["base"], 0)
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
 
     # ---------------- leader proposes (new cmd or re-proposal) ----------
     # the closed-loop client: one fresh command per step, window
     # permitting — this block is what distinguishes this kernel from
-    # other cell_ring users
+    # other ballot_ring users
     is_leader = st["active"] & br.own_bal_mask(st, STRIDE)
-    has_re, can_new, prop_cell, prop_slot, oh_p, re_cmd = \
+    has_re, can_new, prop_rel, prop_slot, oh_p, re_cmd = \
         br.repropose_target(st)
     is_new = ~has_re & can_new
     prop_cmd = jnp.where(is_new, encode_cmd(st["ballot"], prop_slot),
@@ -189,7 +159,7 @@ def step(state, inbox, ctx: StepCtx):
     do = is_leader & (has_re | can_new)
     # latency clock: a slot's FIRST propose starts it (re-proposals and
     # go-back-N retries keep the original start — honest end-to-end
-    # commit latency; recycled cells re-arm via the advance clears)
+    # commit latency; recycled cells re-arm via the shift's 0 fill)
     m_prop_t = jnp.where(do[:, None, :] & oh_p & ~st["proposed"]
                          & (m_prop_t == 0), ctx.t, m_prop_t)
     st, out_p2a = br.propose_write(st, do, is_new, prop_cmd, prop_slot,
@@ -200,10 +170,8 @@ def step(state, inbox, ctx: StepCtx):
     advanced = jnp.zeros_like(execute)
     running = jnp.ones_like(st["active"])
     for e in range(cfg.exec_window):
-        abs_e = execute + e                              # (R, G) absolute
-        inb_e = abs_e < st["base"] + S                   # execute >= base
-        oh_e = inb_e[:, None, :] & (sidx[None, :, None]
-                                    == jnp.remainder(abs_e, S)[:, None, :])
+        rel = execute + e - st["base"]                   # ring position
+        oh_e = sidx[None, :, None] == rel[:, None, :]    # no hit if rel >= S
         com = jnp.any(oh_e & st["log_commit"], axis=1)
         running = running & com
         cmd_e = jnp.sum(jnp.where(oh_e, st["log_cmd"], 0), axis=1)
@@ -221,13 +189,14 @@ def step(state, inbox, ctx: StepCtx):
     st, out_p1a = br.election_tick(st, heard, ctx.rng, cfg)
     b0 = st["base"]
     st = br.slide_window(st, new_execute, RETAIN)
-    m_prop_t = cell.advance_clear(m_prop_t, b0, st["base"], 0)
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
 
     # in-scan linearizability spot-check (sim/inscan): an independent
     # oracle beside invariants(), accumulated on device per group
     m_inscan_viol = state["m_inscan_viol"] + inscan.spot_check(
         state["execute"], st["execute"], state["base"], st["base"],
-        cell.cell_abs(state["base"], S), cell.cell_abs(st["base"], S),
+        state["base"][:, None, :] + sidx[None, :, None],
+        st["base"][:, None, :] + sidx[None, :, None],
         state["log_cmd"], st["log_cmd"],
         state["log_commit"], st["log_commit"],
         kv=kv, lane_major=True)
@@ -264,8 +233,7 @@ def metrics(state, cfg: SimConfig):
 def invariants(old, new, cfg: SimConfig) -> jax.Array:
     """Per-step safety oracle (generalizes history.go's checker):
     1. Agreement: all committed commands for a slot are equal — checked
-       on the common window across replicas (cells align under the
-       fixed mapping, so this is a masked elementwise compare).
+       on the base-aligned common window across replicas.
     2. Stability: a committed (slot, cmd) never changes or un-commits
        while it remains in the window; slots recycled out must have
        been executed (execute >= base always).
@@ -273,37 +241,38 @@ def invariants(old, new, cfg: SimConfig) -> jax.Array:
     4. Executed prefix is committed (within the window)."""
     BIG = jnp.int32(2**30)
     S = cfg.n_slots
+    sidx = jnp.arange(S, dtype=jnp.int32)
     base, c, cmd = new["base"], new["log_commit"], new["log_cmd"]
-    A = cell.cell_abs(base, S)                           # (R, S, G)
 
-    # 1. agreement on the common window [max(base), max(base)+S): cell
-    # c refers to the same absolute slot at every replica whose window
-    # contains it (all in-window abs values are congruent mod S)
-    vis = c & (A >= jnp.max(base, axis=0)[None, None, :])
-    mx = jnp.max(jnp.where(vis, cmd, -BIG), axis=0)      # (S, G)
-    mn = jnp.min(jnp.where(vis, cmd, BIG), axis=0)
-    n_c = jnp.sum(vis, axis=0)
+    # 1. agreement on the aligned window [max(base), max(base)+S)
+    align = jnp.max(base, axis=0)[None, :] - base
+    a_c = _shift(c, align, False)
+    a_cmd = _shift(cmd, align, NO_CMD)
+    mx = jnp.max(jnp.where(a_c, a_cmd, -BIG), axis=0)    # (S, G)
+    mn = jnp.min(jnp.where(a_c, a_cmd, BIG), axis=0)
+    n_c = jnp.sum(a_c, axis=0)
     v_agree = jnp.sum((n_c >= 1) & (mx != mn))
 
-    # 2. stability: old commits still in-window live in the SAME cell
-    # (fixed mapping) and must match; the window may only recycle
-    # executed slots (base <= execute)
-    o_c = old["log_commit"] \
-        & (cell.cell_abs(old["base"], S) >= base[:, None, :])
-    v_stable = jnp.sum(o_c & (~c | (cmd != old["log_cmd"])))
+    # 2. stability: old commits still in-window must match; the window
+    # may only recycle executed slots (base <= execute)
+    adv = base - old["base"]
+    o_c = _shift(old["log_commit"], adv, False)
+    o_cmd = _shift(old["log_cmd"], adv, NO_CMD)
+    v_stable = jnp.sum(o_c & (~c | (cmd != o_cmd)))
     v_stable = v_stable + jnp.sum(new["execute"] < base)
 
     # 3. ballot monotonicity
     v_bal = jnp.sum(new["ballot"] < old["ballot"])
 
-    # 4. executed prefix committed (cells below the frontier)
-    v_exec = jnp.sum((A < new["execute"][:, None, :]) & ~c)
+    # 4. executed prefix committed (ring positions below the frontier)
+    abs_ = base[:, None, :] + sidx[None, :, None]
+    v_exec = jnp.sum((abs_ < new["execute"][:, None, :]) & ~c)
 
     return (v_agree + v_stable + v_bal + v_exec).astype(jnp.int32)
 
 
 PROTOCOL = SimProtocol(
-    name="paxos",
+    name="paxos_sw",
     mailbox_spec=mailbox_spec,
     init_state=init_state,
     step=step,

@@ -1,63 +1,17 @@
-"""SDPaxos (semi-decentralized Paxos) as a pure TPU kernel.
+"""FROZEN pre-rewrite reference: the sliding-window (ring-position)
+lane-major sdpaxos kernel, kept verbatim from before the fixed-cell
+rewrite (PR 15) as the equivalence-proof counterpart.
 
-Reference: the paxi lineage's sdpaxos/ package (SURVEY §2.2 "others" —
-the SoCC'18 protocol): command replication is **decentralized** — every
-replica is the command leader for the commands it receives and
-replicates them from where they arrive (a C-instance per command) —
-while ordering is **centralized** — one elected sequencer assigns
-global sequence slots (O-instances).  A command is executable once BOTH
-its C-instance is durable on a majority and its O-instance is
-committed; execution follows O-log order.  The sequencer is recovered
-with ordinary Paxos ballots, so a sequencer crash costs one election,
-not availability.
-
-TPU re-design (lane-major layout — see sim/lanes.py; not a translation):
-- **O-log = the shared Multi-Paxos ring machinery** (sim/cell_ring.py,
-  also driven by protocols/paxos/sim.py): ballot election with jittered
-  timers, P1 merge by reference, P2 acceptance under bit-packed ack
-  masks, P3 commit + frontier, snapshot catch-up, go-back-N stuck
-  retry, and a FIXED-CELL window over absolute slots (sim/cell.py:
-  slot ``a`` lives at cell ``a % S``; slides are masked clears, not
-  shift gathers — the frozen pre-rewrite kernel survives as
-  ``sim_sw.py`` and bit-canonical equivalence is pinned in
-  tests/test_fixed_cell_equiv.py).
-- **O-entries are owner tokens, bound positionally.**  The reference
-  names (owner, index) pairs in O-instances; here an O-entry carries
-  only the owner id, and the t-th committed token of owner ``o`` maps
-  to o's t-th command.  The binding is a pure function of the agreed
-  O-log, so ordering is **idempotent across sequencer failovers**: a
-  token lost below the new sequencer's P1 quorum is simply re-counted
-  into the backlog and re-proposed, and a token double-adopted by a log
-  merge just orders the owner's next command early — no per-index
-  recovery state, no duplicate/gap hazard for the count-based pointer
-  rebuild.  (An index-named design needs a per-instance recovery map;
-  on TPU that is a gather-heavy set where a cumulative count is free.)
-- **C-replication is frontier-shaped, not ring-shaped.**  Owners
-  propose their own commands strictly in order and command bodies are
-  deterministic functions of (owner, cidx) (as everywhere in this
-  suite: paxos's encode_cmd, chain's encode_val), so a replica's copy
-  of owner ``o``'s command log is fully described by a cumulative
-  count ``c_stored[me, o]``.  C-accepts carry go-back-N cumulative
-  indices per destination and heal drops in ~1 RTT; ``Quorum.ACK``
-  over C-instances becomes the MAJ-th order statistic of the cumulative
-  ack row (a sort over the tiny R axis replaces per-instance bitmasks).
-- The owner reports its *chosen* (majority-stored) frontier to everyone
-  (``oreq``, cumulative); every replica tracks ``o_seen[me, owner]`` so
-  any future sequencer can enqueue without a handoff.  The active
-  sequencer proposes one backlog token per step (deepest backlog
-  first) — the paxos kernel's closed-loop client replaced by the
-  ordering queue.
-- On winning the O-ballot, the new sequencer rebuilds its per-owner
-  token counts from the merged window plus its executed prefix
-  (``exec_c``); P1-quorum intersection guarantees every *committed*
-  token is visible to the merge, exactly the reference's recovery
-  argument.
-- Execution walks the committed O-prefix; a token of owner ``o``
-  applies command ``(o, exec_c[me, o])`` only when that body is locally
-  durable (``exec_c < c_stored``) — a missing body stalls execution
-  (liveness), never reorders it (safety).  Stalls broadcast a ``cneed``
-  body request that any holder answers (``cr``), so a perm-crashed
-  owner's chosen bodies cannot wedge the cluster.
+Ring layout contract (the OLD one): ring position ``i`` holds absolute
+slot ``base + i``; every base advance is a ``ring.shift_window`` data
+movement.  The live kernel in ``sim.py`` holds absolute slot ``a`` at
+cell ``a % S`` forever (sim/cell.py) and must stay BIT-CANONICALLY
+equal to this module on pinned fuzz seeds: same PRNG draws, same
+outboxes, same counters, and a state that matches after rolling each
+ring plane to window order (cell.window_view_np) —
+tests/test_fixed_cell_equiv.py enforces it, and ``python -m paxi_tpu
+profile --gathers`` diffs the two compiled HLOs' gather counts.  Do
+not edit except to mirror a semantic (non-layout) change in sim.py.
 """
 
 from __future__ import annotations
@@ -69,18 +23,18 @@ import jax.numpy as jnp
 
 from paxi_tpu.metrics import lathist
 from paxi_tpu.ops.hashing import fib_key
-from paxi_tpu.sim import cell
-from paxi_tpu.sim import cell_ring as br
+from paxi_tpu.sim import ballot_ring as br
 from paxi_tpu.sim import inscan
-from paxi_tpu.sim.cell_ring import NO_CMD
+from paxi_tpu.sim.ballot_ring import NO_CMD
 from paxi_tpu.sim.ring import diag2, dst_major
 from paxi_tpu.sim.ring import pick_src as _pick_src
 from paxi_tpu.sim.ring import require_packable
+from paxi_tpu.sim.ring import shift_window as _shift
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 IDX_BITS = 20  # cidx field width in the executed command id
 
-# the ballot-ring planes cell_ring.py owns (the O-log); this kernel
+# the ballot-ring planes ballot_ring.py owns (the O-log); this kernel
 # adds the C-plane and kv
 BR_KEYS = br.KEYS
 
@@ -172,9 +126,9 @@ def step(state, inbox, ctx: StepCtx):
     own_diag = ridx[:, None, None] == ridx[None, :, None]   # (R, R, 1)
 
     st = {k: state[k] for k in BR_KEYS}
-    # measurement planes (never passed into cell_ring: the helpers
-    # recycle cells on base advances, so m_prop_t is re-armed here by
-    # the SAME clear after every base-moving call)
+    # measurement planes (never passed into ballot_ring: the helpers
+    # shift the log planes by base deltas, so m_prop_t is re-aligned
+    # here by the SAME delta after every base-moving call)
     m_prop_t = state["m_prop_t"]
     m_lat_hist = state["m_lat_hist"]
     m_lat_sum = state["m_lat_sum"]
@@ -265,7 +219,7 @@ def step(state, inbox, ctx: StepCtx):
     st, ex = br.adopt_best_acker(st, amask, p1_win,
                                  {"kv": kv, "exec_c": exec_c})
     kv, exec_c = ex["kv"], ex["exec_c"]
-    m_prop_t = cell.advance_clear(m_prop_t, b0, st["base"], 0)
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
     st = br.merge_acker_logs(st, amask, p1_win)
     # a takeover restarts the adopted slots' latency clocks (re-owned
     # re-proposals measure from the takeover, like the paxos kernel)
@@ -276,9 +230,9 @@ def step(state, inbox, ctx: StepCtx):
     # tokens ordered for owner o = tokens executed (exec_c) + o's tokens
     # in my window at or above the execute frontier (everything not yet
     # executed is in-window: the ring slides only past executed slots)
-    A = cell.cell_abs(st["base"], S)
-    at_or_above = (A >= st["execute"][:, None, :]) \
-        & (A < st["next_slot"][:, None, :])
+    abs_ = st["base"][:, None, :] + sidx[None, :, None]
+    at_or_above = (abs_ >= st["execute"][:, None, :]) \
+        & (abs_ < st["next_slot"][:, None, :])
     rebuilt = jnp.zeros_like(o_enq)
     for o in range(R):
         cnt = jnp.sum(at_or_above & (st["log_cmd"] == o), axis=1)  # (R, G)
@@ -299,13 +253,13 @@ def step(state, inbox, ctx: StepCtx):
     st, ex, c_has, c_bal = br.apply_p3(st, inbox["p3"],
                                        {"kv": kv, "exec_c": exec_c})
     kv, exec_c = ex["kv"], ex["exec_c"]
-    m_prop_t = cell.advance_clear(m_prop_t, b0, st["base"], 0)
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
 
     # ---------------- sequencer proposes (backlog or re-proposal) -------
     # ordering queue: deepest-backlog owner's token (replaces the paxos
     # kernel's self-generated client command)
     is_leader = st["active"] & br.own_bal_mask(st, STRIDE)
-    has_re, can_new, prop_cell, prop_slot, oh_p, re_cmd = \
+    has_re, can_new, prop_rel, prop_slot, oh_p, re_cmd = \
         br.repropose_target(st)
     backlog = jnp.maximum(o_seen - o_enq, 0)             # (seqr, owner, G)
     pick_o = jnp.argmax(backlog, axis=1).astype(jnp.int32)   # (seqr, G)
@@ -330,10 +284,8 @@ def step(state, inbox, ctx: StepCtx):
     need_own = jnp.full_like(execute, -1)
     need_idx = jnp.zeros_like(execute)
     for e in range(cfg.exec_window):
-        abs_e = execute + e                              # absolute
-        inb_e = abs_e < st["base"] + S                   # execute >= base
-        oh_e = inb_e[:, None, :] & (sidx[None, :, None]
-                                    == jnp.remainder(abs_e, S)[:, None, :])
+        rel = execute + e - st["base"]
+        oh_e = sidx[None, :, None] == rel[:, None, :]
         com = jnp.any(oh_e & st["log_commit"], axis=1)
         cmd_e = jnp.sum(jnp.where(oh_e, st["log_cmd"], 0), axis=1)
         is_tok = cmd_e >= 0
@@ -374,13 +326,14 @@ def step(state, inbox, ctx: StepCtx):
     st, out_p1a = br.election_tick(st, heard, ctx.rng, cfg)
     b0 = st["base"]
     st = br.slide_window(st, new_execute, RETAIN)
-    m_prop_t = cell.advance_clear(m_prop_t, b0, st["base"], 0)
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
 
     # in-scan linearizability spot-check (sim/inscan): an independent
     # oracle beside invariants(), accumulated on device per group
     m_inscan_viol = state["m_inscan_viol"] + inscan.spot_check(
         state["execute"], st["execute"], state["base"], st["base"],
-        cell.cell_abs(state["base"], S), cell.cell_abs(st["base"], S),
+        state["base"][:, None, :] + sidx[None, :, None],
+        st["base"][:, None, :] + sidx[None, :, None],
         state["log_cmd"], st["log_cmd"],
         state["log_commit"], st["log_commit"],
         kv=kv, lane_major=True)
@@ -424,25 +377,27 @@ def invariants(old, new, cfg: SimConfig) -> jax.Array:
     live execution is body-gated regardless.)"""
     BIG = jnp.int32(2**30)
     S = cfg.n_slots
+    sidx = jnp.arange(S, dtype=jnp.int32)
     base, c, cmd = new["base"], new["log_commit"], new["log_cmd"]
-    A = cell.cell_abs(base, S)
 
-    # agreement on the common window (cells align under the fixed
-    # mapping — see paxos/sim.invariants)
-    vis = c & (A >= jnp.max(base, axis=0)[None, None, :])
-    mx = jnp.max(jnp.where(vis, cmd, -BIG), axis=0)
-    mn = jnp.min(jnp.where(vis, cmd, BIG), axis=0)
-    n_c = jnp.sum(vis, axis=0)
+    align = jnp.max(base, axis=0)[None, :] - base
+    a_c = _shift(c, align, False)
+    a_cmd = _shift(cmd, align, NO_CMD)
+    mx = jnp.max(jnp.where(a_c, a_cmd, -BIG), axis=0)
+    mn = jnp.min(jnp.where(a_c, a_cmd, BIG), axis=0)
+    n_c = jnp.sum(a_c, axis=0)
     v_agree = jnp.sum((n_c >= 1) & (mx != mn))
 
-    o_c = old["log_commit"] \
-        & (cell.cell_abs(old["base"], S) >= base[:, None, :])
-    v_stable = jnp.sum(o_c & (~c | (cmd != old["log_cmd"])))
+    adv = base - old["base"]
+    o_c = _shift(old["log_commit"], adv, False)
+    o_cmd = _shift(old["log_cmd"], adv, NO_CMD)
+    v_stable = jnp.sum(o_c & (~c | (cmd != o_cmd)))
     v_stable = v_stable + jnp.sum(new["execute"] < base)
 
     v_bal = jnp.sum(new["ballot"] < old["ballot"])
 
-    v_exec = jnp.sum((A < new["execute"][:, None, :]) & ~c)
+    abs_ = base[:, None, :] + sidx[None, :, None]
+    v_exec = jnp.sum((abs_ < new["execute"][:, None, :]) & ~c)
 
     v_cmono = jnp.sum(new["c_stored"] < old["c_stored"])
     v_cmono = v_cmono + jnp.sum(new["c_next"] < old["c_next"])
@@ -453,7 +408,7 @@ def invariants(old, new, cfg: SimConfig) -> jax.Array:
 
 
 PROTOCOL = SimProtocol(
-    name="sdpaxos",
+    name="sdpaxos_sw",
     mailbox_spec=mailbox_spec,
     init_state=init_state,
     step=step,

@@ -1,61 +1,21 @@
-"""WanKeeper (hierarchical token coordination) as a pure TPU kernel.
+"""FROZEN pre-rewrite reference: the sliding-window (ring-position)
+lane-major wankeeper kernel, kept verbatim from before the fixed-cell
+rewrite (PR 15) as the equivalence-proof counterpart.
 
-Reference: the paxi lineage's wankeeper/ package (SURVEY §2.2 "others")
-— hierarchical leases for WAN coordination: a replicated **root** layer
-grants per-object **tokens** to zones; operations on an object execute
-in the zone currently holding its token (local-latency commits for
-zone-local workloads, like WPaxos's stealing but arbitrated centrally);
-token movements are serialized by the root, and object state travels
-with the token at handoff.
-
-TPU re-design (lane-major layout; not a translation):
-- **Root = the shared fixed-cell ballot-ring core** (sim/cell_ring.py,
-  the same machinery behind the paxos and sdpaxos kernels — absolute
-  slot ``a`` at cell ``a % S``, window slides as masked clears; the
-  frozen sliding-window kernel survives as ``sim_sw.py`` with
-  bit-canonical equivalence pinned in tests/test_fixed_cell_equiv.py):
-  the root log is a
-  Multi-Paxos log over token-transfer commands, its leader elected and
-  recovered with ballots, replicated across ALL replicas (WanKeeper's
-  root is itself a Paxos group spanning zones).  Applying the
-  committed root prefix IS the token table — exclusivity is a pure
-  function of the agreed log, so root-log agreement (the ballot_ring
-  oracle) is token-exclusivity agreement.
-- **Two-entry transfers with version handoff.**  A transfer is
-  ``revoke(o)`` then ``grant(o, z, v)``: applying revoke puts the
-  token in transit (nobody writes) and records the releasing zone; the
-  releasing zone's leader then reports its final zone-committed
-  version (``rel``, every step until the grant lands — idempotent),
-  and the root proposes the grant only after that report, so the
-  receiving zone resumes exactly where the releasing zone committed —
-  the object-state-moves-with-the-token rule, with only a version
-  number travelling (object values are deterministic functions of
-  (object, version), as everywhere in this suite).  Root-local
-  bookkeeping (want/relv/pend) is soft state: after a root failover it
-  is rebuilt by retried ``treq``/``rel`` traffic, and a duplicate
-  revoke against an in-transit token is a no-op.
-- **Zone-level replication is frontier-shaped** (like sdpaxos's
-  C-plane): the holding zone's leader bumps its demanded object's
-  version once per step (gated on the previous version being
-  zone-committed), replicates (obj, ver) to zone members (``zrep``),
-  members apply strictly in order and echo acks (``zack``); the
-  zone-committed version is the zone-majority order statistic over
-  members' acked versions.  Zone leaders are static (lowest replica id
-  per zone) — intra-zone leader failover is the deployment runtime's
-  concern; the sim models zone and root faults via the fuzz schedule.
-- Workload: each zone leader demands a hashed object per step,
-  locality-skewed (``cfg.locality`` = P(home-zone object), home =
-  ``o % Z``) — non-home demands drive token requests (``treq``) and
-  therefore root traffic, exactly the knob the reference's WAN
-  evaluation turns.
-- Version fields carry 16 bits inside root commands (≈65k writes per
-  object per run) — ample for simulation horizons; the encoding is a
-  single positive int32.
+Ring layout contract (the OLD one): ring position ``i`` holds absolute
+slot ``base + i``; every base advance is a ``ring.shift_window`` data
+movement.  The live kernel in ``sim.py`` holds absolute slot ``a`` at
+cell ``a % S`` forever (sim/cell.py) and must stay BIT-CANONICALLY
+equal to this module on pinned fuzz seeds: same PRNG draws, same
+outboxes, same counters, and a state that matches after rolling each
+ring plane to window order (cell.window_view_np) —
+tests/test_fixed_cell_equiv.py enforces it, and ``python -m paxi_tpu
+profile --gathers`` diffs the two compiled HLOs' gather counts.  Do
+not edit except to mirror a semantic (non-layout) change in sim.py.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, Tuple
 
 import jax
@@ -63,12 +23,12 @@ import jax.numpy as jnp
 import jax.random as jr
 
 from paxi_tpu.metrics import lathist
-from paxi_tpu.sim import cell
-from paxi_tpu.sim import cell_ring as br
+from paxi_tpu.sim import ballot_ring as br
 from paxi_tpu.sim import inscan
-from paxi_tpu.sim.cell_ring import NO_CMD
+from paxi_tpu.sim.ballot_ring import NO_CMD
 from paxi_tpu.sim.ring import dst_major
 from paxi_tpu.sim.ring import require_packable
+from paxi_tpu.sim.ring import shift_window as _shift
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 BR_KEYS = br.KEYS
@@ -299,9 +259,9 @@ def step(state, inbox, ctx: StepCtx, gver_floor: bool = True):
         ex["pend"], ex["pgen"], ex["rgen"])
     ver = jnp.maximum(ver, ex["ver"])
     gver = jnp.maximum(gver, ex["gver"])
-    # measurement plane re-arming: cell_ring recycles cells on base
-    # advances; m_prop_t (never passed in) follows suit
-    m_prop_t = cell.advance_clear(m_prop_t, b0, st["base"], 0)
+    # measurement plane re-alignment: ballot_ring shifts the log planes
+    # by the base delta; m_prop_t (never passed in) follows suit
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
     st = br.merge_acker_logs(st, amask, p1_win)
     # a takeover restarts the adopted slots' latency clocks
     m_prop_t = jnp.where(p1_win[:, None, :] & st["proposed"]
@@ -323,7 +283,7 @@ def step(state, inbox, ctx: StepCtx, gver_floor: bool = True):
               "pgen": pgen, "rgen": rgen, "gver": gver}
     b0 = st["base"]
     st, ex, c_has, c_bal = br.apply_p3(st, inbox["p3"], extras)
-    m_prop_t = cell.advance_clear(m_prop_t, b0, st["base"], 0)
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
     token_zone, prev_zone, want, relv, pend, pgen, rgen = (
         ex["token_zone"], ex["prev_zone"], ex["want"], ex["relv"],
         ex["pend"], ex["pgen"], ex["rgen"])
@@ -351,7 +311,7 @@ def step(state, inbox, ctx: StepCtx, gver_floor: bool = True):
         relv = jnp.where(oh, jnp.maximum(relv, rn[:, s, None, :]), relv)
 
     # ---------------- root proposes: revoke, then grant -----------------
-    has_re, can_new, prop_cell, prop_slot, oh_p, re_cmd = \
+    has_re, can_new, prop_rel, prop_slot, oh_p, re_cmd = \
         br.repropose_target(st)
     # grant only for the EXECUTED revoke generation with an accepted,
     # gen-matching release report (pgen/relv are log-derived and
@@ -375,7 +335,7 @@ def step(state, inbox, ctx: StepCtx, gver_floor: bool = True):
     prop_cmd = jnp.where(is_new, new_cmd, re_cmd)
     do = is_root & (has_re | is_new)
     # latency clock: a slot's FIRST propose starts it (retries keep
-    # the original start; recycled cells re-arm via the advance clears)
+    # the original start; recycled cells re-arm via the shifts' 0 fill)
     m_prop_t = jnp.where(do[:, None, :] & oh_p & ~st["proposed"]
                          & (m_prop_t == 0), ctx.t, m_prop_t)
     st, out_p2a = br.propose_write(st, do, is_new, prop_cmd, prop_slot,
@@ -392,10 +352,8 @@ def step(state, inbox, ctx: StepCtx, gver_floor: bool = True):
     running = jnp.ones_like(st["active"])
     viol_gv = jnp.zeros((G,), jnp.int32)
     for e in range(cfg.exec_window):
-        abs_e = execute + e                              # absolute
-        inb_e = abs_e < st["base"] + S                   # execute >= base
-        oh_e = inb_e[:, None, :] & (sidx[None, :, None]
-                                    == jnp.remainder(abs_e, S)[:, None, :])
+        rel_pos = execute + e - st["base"]
+        oh_e = sidx[None, :, None] == rel_pos[:, None, :]
         com = jnp.any(oh_e & st["log_commit"], axis=1)
         running = running & com
         cmd_e = jnp.sum(jnp.where(oh_e, st["log_cmd"], 0), axis=1)
@@ -559,14 +517,15 @@ def step(state, inbox, ctx: StepCtx, gver_floor: bool = True):
     st, out_p1a = br.election_tick(st, heard, ctx.rng, cfg)
     b0 = st["base"]
     st = br.slide_window(st, new_execute, RETAIN)
-    m_prop_t = cell.advance_clear(m_prop_t, b0, st["base"], 0)
+    m_prop_t = _shift(m_prop_t, st["base"] - b0, 0)
 
     # in-scan linearizability spot-check over the root log (sim/inscan;
     # no register plane — WanKeeper's ver/gver tables are zone-local
     # views, not a function of the root frontier alone)
     m_inscan_viol = state["m_inscan_viol"] + inscan.spot_check(
         state["execute"], st["execute"], state["base"], st["base"],
-        cell.cell_abs(state["base"], S), cell.cell_abs(st["base"], S),
+        state["base"][:, None, :] + sidx[None, :, None],
+        st["base"][:, None, :] + sidx[None, :, None],
         state["log_cmd"], st["log_cmd"],
         state["log_commit"], st["log_commit"],
         kv=None, lane_major=True)
@@ -613,25 +572,27 @@ def invariants(old, new, cfg: SimConfig) -> jax.Array:
     version monotonicity + grant monotonicity (in-kernel counter)."""
     BIG = jnp.int32(2**30)
     S = cfg.n_slots
+    sidx = jnp.arange(S, dtype=jnp.int32)
     base, c, cmd = new["base"], new["log_commit"], new["log_cmd"]
-    A = cell.cell_abs(base, S)
 
-    # agreement on the common window (cells align under the fixed
-    # mapping — see paxos/sim.invariants)
-    vis = c & (A >= jnp.max(base, axis=0)[None, None, :])
-    mx = jnp.max(jnp.where(vis, cmd, -BIG), axis=0)
-    mn = jnp.min(jnp.where(vis, cmd, BIG), axis=0)
-    n_c = jnp.sum(vis, axis=0)
+    align = jnp.max(base, axis=0)[None, :] - base
+    a_c = _shift(c, align, False)
+    a_cmd = _shift(cmd, align, NO_CMD)
+    mx = jnp.max(jnp.where(a_c, a_cmd, -BIG), axis=0)
+    mn = jnp.min(jnp.where(a_c, a_cmd, BIG), axis=0)
+    n_c = jnp.sum(a_c, axis=0)
     v_agree = jnp.sum((n_c >= 1) & (mx != mn))
 
-    o_c = old["log_commit"] \
-        & (cell.cell_abs(old["base"], S) >= base[:, None, :])
-    v_stable = jnp.sum(o_c & (~c | (cmd != old["log_cmd"])))
+    adv = base - old["base"]
+    o_c = _shift(old["log_commit"], adv, False)
+    o_cmd = _shift(old["log_cmd"], adv, NO_CMD)
+    v_stable = jnp.sum(o_c & (~c | (cmd != o_cmd)))
     v_stable = v_stable + jnp.sum(new["execute"] < base)
 
     v_bal = jnp.sum(new["ballot"] < old["ballot"])
 
-    v_exec = jnp.sum((A < new["execute"][:, None, :]) & ~c)
+    abs_ = base[:, None, :] + sidx[None, :, None]
+    v_exec = jnp.sum((abs_ < new["execute"][:, None, :]) & ~c)
 
     v_ver = jnp.sum(new["ver"] < old["ver"])
     v_grant = jnp.sum(new["viol_acc"] - old["viol_acc"])
@@ -645,23 +606,10 @@ def invariants(old, new, cfg: SimConfig) -> jax.Array:
 
 
 PROTOCOL = SimProtocol(
-    name="wankeeper",
+    name="wankeeper_sw",
     mailbox_spec=mailbox_spec,
     init_state=init_state,
     step=step,
-    metrics=metrics,
-    invariants=invariants,
-    batched=True,
-)
-
-# the seeded-bug twin (see step's docstring): violates under fault
-# schedules that revoke a token before the receiving zone's acks catch
-# up — the trace subsystem's end-to-end WanKeeper reproduction case
-PROTOCOL_NOFLOOR = SimProtocol(
-    name="wankeeper_nofloor",
-    mailbox_spec=mailbox_spec,
-    init_state=init_state,
-    step=functools.partial(step, gver_floor=False),
     metrics=metrics,
     invariants=invariants,
     batched=True,

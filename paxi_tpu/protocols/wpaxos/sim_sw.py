@@ -1,53 +1,21 @@
-"""WPaxos — multi-leader WAN Paxos with object stealing, as a TPU kernel.
+"""FROZEN pre-rewrite reference: the sliding-window (ring-position)
+lane-major wpaxos kernel, kept verbatim from before the fixed-cell
+rewrite (PR 15) as the equivalence-proof counterpart.
 
-Reference: paxi wpaxos/ [driver] — every key is a separate Paxos object
-whose ballot embeds the owning zone/node; a zone *steals* an object by
-running phase-1 on that object's ballot when the access policy
-(policy.go, ``Config.Policy``/``Threshold``) says its clients dominate;
-quorums are flexible grids (quorum.go): phase-1 needs zone-majorities in
-``Z - q2 + 1`` zones, phase-2 only in ``q2`` zones (q2=1 => steady-state
-commits stay inside the owner's zone — the WAN latency win the paper
-dissects).  BASELINE config: 3x3 zone grid, locality-skewed workload.
-
-TPU re-design (not a translation):
-- **Lane-major batch layout** (see sim/lanes.py): state ``(R, O, G)`` /
-  ``(R, O, S, G)``, mailbox planes ``(src, dst, G)`` — the group axis
-  feeds the 8x128 vector lanes.
-- Replicas r in 0..R-1 are arranged in Z zones of R/Z nodes,
-  ``zone(r) = r // (R/Z)``.
-- Per-object per-replica log SoA over a **fixed-cell ring** of S slots
-  (sim/cell.py): absolute slot ``a`` lives at cell ``a % S`` forever;
-  each (replica, object) window ``[base[r, o], base[r, o] + S)`` slides
-  with its execute frontier as a masked clear of recycled cells —
-  no per-step ``shift_window`` alignment gathers (SURVEY §7 slot
-  recycling — unbounded horizon; the frozen sliding-window kernel
-  survives as ``sim_sw.py``, bit-canonical equivalence pinned in
-  tests/test_fixed_cell_equiv.py).  Messages carry absolute slots;
-  acceptors ack only what they durably stored.
-- ``Quorum.ACK`` is a **bit-packed int32 ack mask** per (owner, object,
-  slot); grid-quorum tests are per-zone popcounts over bit ranges
-  (zone-majority per zone, then >= q1 / q2 zones — quorum.go).
-- The workload generator is in-kernel: each replica demands one object
-  per step, drawn home-zone-biased (``cfg.locality``) with one shaped
-  draw per plane from the step key.  Owners propose for the demanded
-  object; non-owners accumulate per-object demand (``hits``) — the
-  requester-side form of policy.go's counters — and fire a phase-1
-  steal at ``steal_threshold``.
-- At most one steal is in flight per replica (``steal_obj``); P1b acks
-  are merged with the same by-reference log-merge argument as the
-  paxos kernel (acceptor logs only grow in ballot), base-aligned to
-  the max acker base so no committed entry is ever dropped.
-- P3 carries the owner's window base (``lowslot``): a replica whose
-  frontier fell below it adopts the owner's object row (log, base,
-  execute, register) by reference — snapshot catch-up for laggards.
-- All handlers are fully masked; messages for *different* objects from
-  different sources in the same step are all applied via dense
-  (dst, obj) one-hot scatters, per-(dst, obj) max-ballot selected.
+Ring layout contract (the OLD one): ring position ``i`` holds absolute
+slot ``base + i``; every base advance is a ``ring.shift_window`` data
+movement.  The live kernel in ``sim.py`` holds absolute slot ``a`` at
+cell ``a % S`` forever (sim/cell.py) and must stay BIT-CANONICALLY
+equal to this module on pinned fuzz seeds: same PRNG draws, same
+outboxes, same counters, and a state that matches after rolling each
+ring plane to window order (cell.window_view_np) —
+tests/test_fixed_cell_equiv.py enforces it, and ``python -m paxi_tpu
+profile --gathers`` diffs the two compiled HLOs' gather counts.  Do
+not edit except to mirror a semantic (non-layout) change in sim.py.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, Tuple
 
 import jax
@@ -55,8 +23,9 @@ import jax.numpy as jnp
 import jax.random as jr
 
 from paxi_tpu.metrics import lathist
-from paxi_tpu.sim import cell, inscan
-from paxi_tpu.sim.ring import dst_major, require_packable
+from paxi_tpu.sim import inscan
+from paxi_tpu.sim.ring import (dst_major, require_packable,
+                               shift_window)
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
 
 NO_CMD = -1
@@ -264,11 +233,8 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
 
     # ---------------- steal win: adopt object, merge ackers' logs -------
     # gather every replica's row for MY stolen object via a one-hot
-    # contraction over the object axis.  Fixed cell mapping: all rows
-    # (and my own) are already cell-aligned — stealer cell c and acker
-    # cell c hold the SAME absolute slot exactly when the slot under
-    # the merge base is inside the acker's window, so the old per-src
-    # base-alignment shifts become one elementwise in-window mask
+    # contraction over the object axis, then base-align all rows (and my
+    # own) to the max acker base so no resident entry is dropped
     so_oh = (oidx[None, :, None] == so[:, None, :])    # (me, O, G)
     soF = so_oh.astype(jnp.int32)
     amask = ((p1_acks[:, None, :] >> ridx[None, :, None]) & 1
@@ -281,13 +247,13 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
     base_so = at_obj(base, so)                         # (me, G)
     base_star = jnp.maximum(
         base_so, jnp.max(jnp.where(amask, b_src, 0), axis=1))
-    A_star = cell.cell_abs(base_star, S)               # (me, S, G) abs
-    in_src = (A_star[:, None] >= b_src[:, :, None, :]) \
-        & (A_star[:, None] < b_src[:, :, None, :] + S)  # (me, src, S, G)
-    sel = amask[:, :, None, :] & in_src
-    lbm = jnp.where(sel, lb, -1)
+    adv_s = base_star[:, None, :] - b_src              # (me, src, G) >= 0
+    lb = shift_window(lb, adv_s, 0)
+    lc = shift_window(lc, adv_s, NO_CMD)
+    lk = shift_window(lk, adv_s, False)
+    lbm = jnp.where(amask[:, :, None, :], lb, -1)
     best_bal = jnp.max(lbm, axis=1)                    # (me, S, G)
-    cmask = sel & lk
+    cmask = amask[:, :, None, :] & lk
     merged_commit = jnp.any(cmask, axis=1)
     merged_cmd = jnp.full((R, S, G), NO_CMD, jnp.int32)
     committed_cmd = jnp.full((R, S, G), NO_CMD, jnp.int32)
@@ -295,23 +261,22 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
         merged_cmd = jnp.where(lbm[:, s] == best_bal, lc[:, s], merged_cmd)
         committed_cmd = jnp.where(cmask[:, s], lc[:, s], committed_cmd)
     has_acc = (best_bal > 0) | merged_commit
-    top = jnp.max(jnp.where(has_acc, A_star + 1, 0), axis=1)  # (me, G) abs
+    abs_m = base_star[:, None, :] + sidx[None, :, None]
+    top = jnp.max(jnp.where(has_acc, abs_m + 1, 0), axis=1)   # (me, G) abs
     my_next = at_obj(next_slot, so)
     new_next = jnp.maximum(my_next, top)
-    in_win = A_star < new_next[:, None, :]             # (me, S, G)
+    in_win = abs_m < new_next[:, None, :]              # (me, S, G)
     adopt_cmd = jnp.where(merged_commit, committed_cmd,
                           jnp.where(best_bal > 0, merged_cmd, NOOP))
     win_oh = p1_win[:, None, :] & so_oh                # (me, O, G)
-    # raise my stolen object's base to base_star: recycled cells (abs
-    # now below it) reset in place — the fixed mapping's no-copy move
-    nb_steal = jnp.where(win_oh, base_star[:, None, :], base)
-    drop4 = cell.cell_abs(base, S) < nb_steal[:, :, None, :]
-    log_bal = jnp.where(drop4, 0, log_bal)
-    log_cmd = jnp.where(drop4, NO_CMD, log_cmd)
-    log_commit = log_commit & ~drop4
-    proposed = proposed & ~drop4
-    log_acks = jnp.where(drop4, 0, log_acks)
-    m_prop_t = jnp.where(drop4, 0, m_prop_t)
+    # shift my own object row to the base_star frame before overwriting
+    adv_me = jnp.where(win_oh, (base_star - base_so)[:, None, :], 0)
+    log_bal = shift_window(log_bal, adv_me, 0)
+    log_cmd = shift_window(log_cmd, adv_me, NO_CMD)
+    log_commit = shift_window(log_commit, adv_me, False)
+    proposed = shift_window(proposed, adv_me, False)
+    log_acks = shift_window(log_acks, adv_me, 0)
+    m_prop_t = shift_window(m_prop_t, adv_me, 0)
     w4 = win_oh[:, :, None, :]                         # (me, O, 1, G)
     iw4 = in_win[:, None, :, :]                        # (me, 1, S, G)
     my_bal_so = at_obj(ballot, so)                     # (me, G)
@@ -326,7 +291,7 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
                          log_acks)
     # adopted rows restart their latency clocks at the takeover step
     m_prop_t = jnp.where(w4, jnp.where(iw4, ctx.t, 0), m_prop_t)
-    base = nb_steal
+    base = jnp.where(win_oh, base_star[:, None, :], base)
     next_slot = jnp.where(win_oh, new_next[:, None, :], next_slot)
     # adopt execute/register from the max-base acker when it is ahead
     # (its frontier covers everything its base recycled)
@@ -358,10 +323,10 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
     active = active & ~demote
     sk = jnp.any(demote & my_steal_oh, axis=1)
     steal_obj = jnp.where(sk, -1, steal_obj)
-    inw2 = cell.in_window(slot2, base, S)              # (me, O, G)
+    rel2 = slot2 - base                                # (me, O, G)
+    inw2 = (rel2 >= 0) & (rel2 < S)
     oh = ((acc_ok & inw2)[:, :, None, :]
-          & (sidx[None, None, :, None]
-             == jnp.remainder(slot2, S)[:, :, None, :]))
+          & (sidx[None, None, :, None] == rel2[:, :, None, :]))
     writable = oh & (log_bal <= b2[:, :, None, :]) & ~log_commit
     log_bal = jnp.where(writable, b2[:, :, None, :], log_bal)
     log_cmd = jnp.where(writable, cmd2[:, :, None, :], log_cmd)
@@ -397,12 +362,10 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
         ob_s, bl_s, sl_s = ob[:, s], bl[:, s], sl[:, s]
         ok_s = (v[:, s] & (bl_s == at_obj(ballot, ob_s))
                 & (at_obj((active & own).astype(jnp.int32), ob_s) > 0))
-        inw_s = cell.in_window(sl_s[:, None, :], base, S)  # (own, O, G)
+        rel_s = sl_s[:, None, :] - base                # (own, O, G)
         oh_s = (ok_s[:, None, None, :]
                 & (ob_s[:, None, None, :] == oidx[None, :, None, None])
-                & inw_s[:, :, None, :]
-                & (jnp.remainder(sl_s, S)[:, None, None, :]
-                   == sidx[None, None, :, None]))
+                & (rel_s[:, :, None, :] == sidx[None, None, :, None]))
         log_acks = log_acks | jnp.where(oh_s, jnp.int32(1) << s, 0)
     zq2 = _zone_quorums(log_acks, cfg)                 # (own, O, S, G)
     newly = ((active & own)[:, :, None, :] & (zq2 >= Q2)
@@ -448,15 +411,15 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
     active = active & ~promote3
     sk3 = jnp.any(promote3 & my_steal_oh, axis=1)
     steal_obj = jnp.where(sk3, -1, steal_obj)
-    inw3 = cell.in_window(slot3, base, S)
+    rel3 = slot3 - base
+    inw3 = (rel3 >= 0) & (rel3 < S)
     oh = ((has3 & inw3)[:, :, None, :]
-          & (sidx[None, None, :, None]
-             == jnp.remainder(slot3, S)[:, :, None, :]))
+          & (sidx[None, None, :, None] == rel3[:, :, None, :]))
     log_cmd = jnp.where(oh, cmd3[:, :, None, :], log_cmd)
     log_bal = jnp.where(oh, jnp.maximum(log_bal, b3_[:, :, None, :]),
                         log_bal)
     log_commit = log_commit | oh
-    abs_ = cell.cell_abs(base, S)                      # (me, O, S, G)
+    abs_ = base[:, :, None, :] + sidx[None, None, :, None]
     ohu = (fresh3[:, :, None, :] & (abs_ < upto3[:, :, None, :])
            & (log_bal == b3_[:, :, None, :]) & (log_cmd != NO_CMD))
     log_commit = log_commit | ohu
@@ -484,14 +447,12 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
         b_own = jnp.where(mp, base[s][None], b_own)
         e_own = jnp.where(mp, execute[s][None], e_own)
         k_own = jnp.where(mp, kv[s][None], k_own)
-    # fixed cell mapping: the owner's cells are already aligned with
-    # mine — keep my cells still inside the owner's window (adopt
-    # requires my execute — hence my base — below the owner's base),
-    # everything below was recycled
-    keep4 = cell.cell_abs(base, S) >= b_own[:, :, None, :]
-    my_bal_s = jnp.where(keep4, log_bal, 0)
-    my_cmd_s = jnp.where(keep4, log_cmd, NO_CMD)
-    my_com_s = keep4 & log_commit
+    # align my row to the owner's frame (adv > 0: adopt requires my
+    # execute — hence my base — below the owner's base)
+    adv_a = jnp.where(adopt, b_own - base, 0)
+    my_bal_s = shift_window(log_bal, adv_a, 0)
+    my_cmd_s = shift_window(log_cmd, adv_a, NO_CMD)
+    my_com_s = shift_window(log_commit, adv_a, False)
     a4 = adopt[:, :, None, :]
     log_bal = jnp.where(a4, jnp.where(s_com, s_bal, my_bal_s), log_bal)
     log_cmd = jnp.where(a4, jnp.where(s_com, s_cmd, my_cmd_s), log_cmd)
@@ -523,16 +484,17 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
     d_base = at_obj(base, d)
     c_at_d = row_at_obj(log_commit, d, False)          # (R, S, G)
     p_at_d = row_at_obj(proposed, d, False)
-    BIG = jnp.int32(2 ** 30)
-    A_d = cell.cell_abs(d_base, S)                     # (R, S, G) abs
-    mask_re = (~c_at_d) & (~p_at_d) & (A_d < d_next[:, None, :])
-    re_abs = jnp.min(jnp.where(mask_re, A_d, BIG), axis=1)
+    abs_d = d_base[:, None, :] + sidx[None, :, None]
+    mask_re = (~c_at_d) & (~p_at_d) & (abs_d < d_next[:, None, :])
+    first_re = jnp.argmin(jnp.where(mask_re, sidx[None, :, None], S),
+                          axis=1)
     has_re = jnp.any(mask_re, axis=1)
     can_new = d_next - d_base < S                      # window flow control
-    prop_slot = jnp.where(has_re, re_abs, d_next)      # absolute
+    rel_next = jnp.clip(d_next - d_base, 0, S - 1)
+    prop_rel = jnp.where(has_re, first_re, rel_next).astype(jnp.int32)
+    prop_slot = d_base + prop_rel                      # absolute
     new_cmd = encode_cmd(d_bal, prop_slot)
-    oh_pr = sidx[None, :, None] \
-        == jnp.remainder(prop_slot, S)[:, None, :]
+    oh_pr = sidx[None, :, None] == prop_rel[:, None, :]
     re_cmd = jnp.sum(jnp.where(oh_pr, row_at_obj(log_cmd, d, 0), 0),
                      axis=1)
     re_cmd = jnp.where(re_cmd == NO_CMD, NOOP, re_cmd)
@@ -591,11 +553,8 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
     advanced = jnp.zeros((R, O, G), jnp.int32)
     running = jnp.ones((R, O, G), bool)
     for e in range(cfg.exec_window):
-        abs_e = execute + e                            # (R, O, G) absolute
-        inb_e = abs_e < base + S                       # execute >= base
-        oh_e = (inb_e[:, :, None, :]
-                & (sidx[None, None, :, None]
-                   == jnp.remainder(abs_e, S)[:, :, None, :]))
+        rel_e = execute + e - base                     # (R, O, G)
+        oh_e = sidx[None, None, :, None] == rel_e[:, :, None, :]
         com = jnp.any(oh_e & log_commit, axis=2)
         running = running & com
         cmd_e = jnp.sum(jnp.where(oh_e, log_cmd, 0), axis=2)
@@ -607,11 +566,13 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
     # ---------------- P3 out: per owner, its demanded object ------------
     new_at_d = row_at_obj(newly, d, False)             # (R, S, G)
     any_new_d = jnp.any(new_at_d, axis=1)
-    low_new = jnp.min(jnp.where(new_at_d, A_d, BIG), axis=1)  # abs
+    low_new = jnp.argmin(jnp.where(new_at_d, sidx[None, :, None], S),
+                         axis=1)
     my_exec_d = at_obj(new_execute, d)
     rr = ctx.t % jnp.maximum(my_exec_d - d_base, 1)
-    p3_abs = jnp.where(any_new_d, low_new, d_base + rr)
-    oh_3 = sidx[None, :, None] == jnp.remainder(p3_abs, S)[:, None, :]
+    p3_rel = jnp.where(any_new_d, low_new, rr).astype(jnp.int32)
+    p3_rel = jnp.clip(p3_rel, 0, S - 1)
+    oh_3 = sidx[None, :, None] == p3_rel[:, None, :]
     p3_committed = jnp.any(oh_3 & row_at_obj(log_commit, d, False), axis=1)
     p3_cmd = jnp.sum(jnp.where(oh_3, row_at_obj(log_cmd, d, 0), 0), axis=1)
     p3_do = (at_obj((active & own).astype(jnp.int32), d) > 0) & p3_committed
@@ -619,29 +580,29 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
         "valid": jnp.broadcast_to(p3_do[:, None, :], (R, R, G)),
         "obj": jnp.broadcast_to(d[:, None, :], (R, R, G)),
         "bal": jnp.broadcast_to(d_bal[:, None, :], (R, R, G)),
-        "slot": jnp.broadcast_to(p3_abs[:, None, :], (R, R, G)),
+        "slot": jnp.broadcast_to((d_base + p3_rel)[:, None, :], (R, R, G)),
         "cmd": jnp.broadcast_to(p3_cmd[:, None, :], (R, R, G)),
         "upto": jnp.broadcast_to(my_exec_d[:, None, :], (R, R, G)),
         "lowslot": jnp.broadcast_to(d_base[:, None, :], (R, R, G)),
     }
 
     # ---------------- slide the ring windows (slot recycling) -----------
-    # fixed cell mapping: recycled cells reset in place, nothing moves
     new_base = jnp.maximum(base, new_execute - RETAIN)
-    drop_s = cell.cell_abs(base, S) < new_base[:, :, None, :]
-    log_bal = jnp.where(drop_s, 0, log_bal)
-    log_cmd = jnp.where(drop_s, NO_CMD, log_cmd)
-    log_commit = log_commit & ~drop_s
-    proposed = proposed & ~drop_s
-    log_acks = jnp.where(drop_s, 0, log_acks)
-    m_prop_t = jnp.where(drop_s, 0, m_prop_t)
+    adv = new_base - base                              # (R, O, G)
+    log_bal = shift_window(log_bal, adv, 0)
+    log_cmd = shift_window(log_cmd, adv, NO_CMD)
+    log_commit = shift_window(log_commit, adv, False)
+    proposed = shift_window(proposed, adv, False)
+    log_acks = shift_window(log_acks, adv, 0)
+    m_prop_t = shift_window(m_prop_t, adv, 0)
 
     # in-scan linearizability spot-check (sim/inscan), per (replica,
     # object) lane over the per-object rings
+    sidx4 = sidx[None, None, :, None]
     m_inscan_viol = state["m_inscan_viol"] + inscan.spot_check(
         state["execute"], new_execute, state["base"], new_base,
-        cell.cell_abs(state["base"], S),
-        cell.cell_abs(new_base, S),
+        state["base"][:, :, None, :] + sidx4,
+        new_base[:, :, None, :] + sidx4,
         state["log_cmd"], log_cmd,
         state["log_commit"], log_commit,
         kv=kv, lane_major=True)
@@ -687,25 +648,27 @@ def invariants(old, new, cfg: SimConfig) -> jax.Array:
     active owner per object."""
     BIG = jnp.int32(2**30)
     S = cfg.n_slots
+    sidx = jnp.arange(S, dtype=jnp.int32)
     base, c, cmd = new["base"], new["log_commit"], new["log_cmd"]
-    A = cell.cell_abs(base, S)                         # (R, O, S, G)
 
-    # agreement on the common window per object (cells align under the
-    # fixed mapping — see paxos/sim.invariants)
-    vis = c & (A >= jnp.max(base, axis=0)[None, :, None, :])
-    mx = jnp.max(jnp.where(vis, cmd, -BIG), axis=0)
-    mn = jnp.min(jnp.where(vis, cmd, BIG), axis=0)
-    n_c = jnp.sum(vis, axis=0)
+    align = jnp.max(base, axis=0)[None] - base         # (R, O, G)
+    a_c = shift_window(c, align, False)
+    a_cmd = shift_window(cmd, align, NO_CMD)
+    mx = jnp.max(jnp.where(a_c, a_cmd, -BIG), axis=0)
+    mn = jnp.min(jnp.where(a_c, a_cmd, BIG), axis=0)
+    n_c = jnp.sum(a_c, axis=0)
     v_agree = jnp.sum((n_c >= 1) & (mx != mn))
 
-    o_c = old["log_commit"] \
-        & (cell.cell_abs(old["base"], S) >= base[:, :, None, :])
-    v_stable = jnp.sum(o_c & (~c | (cmd != old["log_cmd"])))
+    adv = base - old["base"]
+    o_c = shift_window(old["log_commit"], adv, False)
+    o_cmd = shift_window(old["log_cmd"], adv, NO_CMD)
+    v_stable = jnp.sum(o_c & (~c | (cmd != o_cmd)))
     v_stable = v_stable + jnp.sum(new["execute"] < base)
 
     v_bal = jnp.sum(new["ballot"] < old["ballot"])
 
-    v_exec = jnp.sum((A < new["execute"][:, :, None, :]) & ~c)
+    abs_ = base[:, :, None, :] + sidx[None, None, :, None]
+    v_exec = jnp.sum((abs_ < new["execute"][:, :, None, :]) & ~c)
 
     # two active replicas owning the same object at the same ballot round
     # would be a stolen-twice bug; different ballots are a transient
@@ -722,26 +685,10 @@ def invariants(old, new, cfg: SimConfig) -> jax.Array:
 
 
 PROTOCOL = SimProtocol(
-    name="wpaxos",
+    name="wpaxos_sw",
     mailbox_spec=mailbox_spec,
     init_state=init_state,
     step=step,
-    metrics=metrics,
-    invariants=invariants,
-    batched=True,
-)
-
-# the seeded thin-read-quorum bug twin (see step's docstring): the
-# scenario engine's capturable wpaxos witness source — WAN geo-latency
-# widens the racing-steal window until a one-zone-thin phase-1 read
-# set misses the write zone and the agreement oracle fires.
-# Registered as ``wpaxos_thinq1`` (sim-only, like wankeeper_nofloor);
-# never a correctness case.
-PROTOCOL_THINQ1 = SimProtocol(
-    name="wpaxos_thinq1",
-    mailbox_spec=mailbox_spec,
-    init_state=init_state,
-    step=functools.partial(step, q1_full=False),
     metrics=metrics,
     invariants=invariants,
     batched=True,

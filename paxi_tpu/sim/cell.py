@@ -1,0 +1,136 @@
+"""Fixed-cell ring primitives shared by lane-major sim kernels.
+
+The OTHER ring-layout contract, next to ``sim/ring.py``'s sliding
+window.  Two layouts coexist in this tree:
+
+- **Sliding-window** (``sim/ring.py``): ring position ``i`` holds
+  absolute slot ``base + i``; advancing the window is a
+  ``shift_window`` data movement per plane per step.  On XLA:CPU those
+  shifts scalarize into gathers and dominated the north-star bench
+  (~70% of step cost pre-PR 6).
+- **Fixed-cell** (this module): absolute slot ``a`` lives at ring cell
+  ``a % S`` *forever*.  Advancing the window is a masked **clear** of
+  the recycled cells — no data movement — and any two replicas' cells
+  line up without per-pair realignment: cell ``c`` refers to the same
+  absolute slot at replicas ``x`` and ``y`` exactly when that slot is
+  inside both windows (all in-window slots congruent to ``c`` mod
+  ``S`` coincide).
+
+``protocols/paxos/sim_pg.py`` pioneered the mapping per-group (PR 6,
+412 s -> 107 s at 100k groups x 36 steps); these helpers carry it to
+the lane-major layout (group axis LAST) so the paxos / sdpaxos /
+wankeeper / bpaxos / wpaxos kernels share one audited copy of the
+cell-index arithmetic.  The shared fixed-cell consensus core built on
+them is ``sim/cell_ring.py`` (the ``ballot_ring`` twin); each rewritten
+kernel is proven BIT-CANONICALLY equal to its frozen sliding-window
+reference (``protocols/*/sim_sw.py``) on pinned fuzz seeds —
+``window_view_np`` below is the canonicalizer that maps a fixed-cell
+state onto the window order the old layout stored directly.
+
+Shape conventions (lane-major): ring planes ``(..., S, G)`` with the
+slot axis second-to-last, ``base (..., G)`` absolute; the deps variant
+serves epaxos-style ``(..., S, R, G)`` planes whose slot axis sits
+third-from-last.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def cell_abs(base, S: int):
+    """The absolute slot cell ``c`` currently holds: the unique element
+    of ``[base, base + S)`` congruent to ``c`` (mod S).  ``base`` is
+    ``(..., G)``; returns ``(..., S, G)``.  Pure elementwise — the
+    fixed-mapping replacement for ``base + ring_position``."""
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    b = base[..., None, :]
+    return b + jnp.remainder(sidx[:, None] - b, S)
+
+
+def cell_abs_deps(base, S: int):
+    """``cell_abs`` for deps-style planes ``(..., S, R, G)`` whose slot
+    axis sits third-from-last (the ``ring.shift_deps`` shape, e.g. the
+    epaxos dependency cube): returns ``(..., S, 1, G)``, broadcastable
+    against the plane's per-replica axis."""
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    b = base[..., None, None, :]
+    return b + jnp.remainder(sidx[:, None, None] - b, S)
+
+
+def cell_onehot(slot, S: int):
+    """One-hot ``(..., S, G)`` of the cell holding absolute ``slot``
+    ``(..., G)``.  Carries NO in-window validity: callers must mask
+    with ``in_window`` (an out-of-window slot's cell holds a different
+    absolute slot — writing there would corrupt it)."""
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    return sidx[:, None] == jnp.remainder(slot, S)[..., None, :]
+
+
+def in_window(slot, base, S: int):
+    """``base <= slot < base + S`` — the frontier mask that gates every
+    fixed-cell one-hot write (same shapes as ``slot``/``base``)."""
+    return (slot >= base) & (slot < base + S)
+
+
+def advance_clear(plane, old_base, new_base, fill):
+    """The fixed-cell equivalent of
+    ``ring.shift_window(plane, new_base - old_base, fill)``: cells
+    whose absolute slot (under ``old_base``) fell below ``new_base``
+    were recycled by the advance and reset to ``fill`` in place —
+    nothing moves.  ``plane (..., S, G)``, bases ``(..., G)``."""
+    S = plane.shape[-2]
+    drop = cell_abs(old_base, S) < new_base[..., None, :]
+    return jnp.where(drop, fill, plane)
+
+
+# ring-shaped state planes per fixed-cell kernel (slot axis LAST in
+# the runner's group-major final state) — the ONE registry behind the
+# equivalence canonicalizer: tests/test_fixed_cell_equiv.py and the
+# verify.sh --bench smoke both read it, so adding a ring plane to a
+# kernel updates every consumer at once
+RING_PLANES = {
+    "paxos": ("log_bal", "log_cmd", "log_commit", "log_acks",
+              "proposed"),
+    "sdpaxos": ("log_bal", "log_cmd", "log_commit", "log_acks",
+                "proposed"),
+    "wankeeper": ("log_bal", "log_cmd", "log_commit", "log_acks",
+                  "proposed"),
+    "wpaxos": ("log_bal", "log_cmd", "log_commit", "log_acks",
+               "proposed"),
+    "bpaxos": ("abal", "vbal", "vcmd", "vbsz", "committed", "proposed",
+               "p2_acks"),
+}
+
+
+def canonical_state_np(name, state):
+    """Fixed-cell group-major final state -> the window-ordered view
+    the sliding-window layout stores directly (numpy; ``m_`` planes
+    dropped — they are excluded from the witness hash and compared via
+    metrics).  The bit-canonical equivalence form: hash this against a
+    ``sim_sw`` reference run's state."""
+    import numpy as np
+    base = np.asarray(state["base"])
+    ring = RING_PLANES[name]
+    return {k: (window_view_np(v, base) if k in ring
+                else np.asarray(v))
+            for k, v in state.items() if not k.startswith("m_")}
+
+
+def window_view_np(plane, base):
+    """Roll a fixed-cell ring plane to window order (numpy; tests and
+    tooling only — this IS a gather, which is why it never runs inside
+    a kernel).  Operates on the runner's group-major final state: slot
+    axis LAST (``(G, R, S)`` / ``(G, R, O, S)``), ``base`` matching the
+    leading dims.  ``out[..., i] = plane[..., (base + i) % S]`` holds
+    absolute slot ``base + i`` — exactly what ring position ``i``
+    stores under the sliding-window layout, so a fixed-cell kernel's
+    state equals its ``sim_sw`` reference's state after this view
+    (the bit-canonical equivalence proof in
+    tests/test_fixed_cell_equiv.py)."""
+    import numpy as np
+    plane = np.asarray(plane)
+    base = np.asarray(base)
+    S = plane.shape[-1]
+    idx = (base[..., None] + np.arange(S)) % S
+    return np.take_along_axis(plane, idx, axis=-1)

@@ -1,0 +1,350 @@
+"""Shared Multi-Paxos FIXED-CELL core for lane-major sim kernels.
+
+The ``sim/ballot_ring.py`` consensus machinery rebuilt on the
+fixed-cell layout (``sim/cell.py``: absolute slot ``a`` lives at cell
+``a % S`` forever), so the per-step ``ring.shift_window`` alignment
+gathers — the dominant cost of the old layout on XLA:CPU — disappear:
+window slides and snapshot adoptions become masked clears, and the
+phase-1 log merge a pure elementwise mask over the ``(ldr, src, S, G)``
+ack cube (leader cell ``c`` and acker cell ``c`` hold the SAME absolute
+slot exactly when that slot is inside the acker's window).
+
+Drivers: the paxos kernel (self-generated client commands), sdpaxos
+(sequencer-ordered owner tokens) and wankeeper (root token-transfer
+log).  The function surface mirrors ``ballot_ring`` one-for-one —
+layout-free helpers (``promise_p1a``/``tally_p1b``/``election_tick``/
+``depose``/``own_bal_mask``/``propose_write``) are re-exported from it
+(one audited copy), layout-dependent ones are rebuilt here.  Each
+consumer kernel is proven BIT-CANONICALLY equal to its frozen
+sliding-window reference (``protocols/*/sim_sw.py``) on pinned fuzz
+seeds: identical PRNG draws, outboxes and counters, and identical
+state after ``cell.window_view_np`` (tests/test_fixed_cell_equiv.py).
+
+Measurement-plane contract (``m_prop_t`` and friends, never passed in
+here): these helpers no longer shift anything, so after every
+base-moving call the kernel re-arms its ring-shaped ``m_`` planes with
+``cell.advance_clear(plane, base_before, base_after, 0)`` — the exact
+fixed-cell equivalent of the old re-alignment shift.
+
+Conventions: as ``ballot_ring`` — ``st`` carries the 13 standard keys
+(``KEYS``), ``extras`` travel with state transfer by reference, mailbox
+planes are ``(src, dst, G)`` consumed via masked selects.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# one audited copy of the layout-free machinery (promise/tally/election
+# touch only scalar-per-lane planes; propose_write is given its one-hot)
+from paxi_tpu.sim.ballot_ring import (KEYS, NO_CMD, NOOP, depose,
+                                      election_tick, own_bal_mask,
+                                      promise_p1a, propose_write,
+                                      tally_p1b)
+from paxi_tpu.sim.cell import cell_abs, cell_onehot, in_window
+from paxi_tpu.sim.ring import pick_src
+from paxi_tpu.sim.ring import take_replica as _take_replica
+
+__all__ = ["KEYS", "NO_CMD", "NOOP", "depose", "election_tick",
+           "own_bal_mask", "promise_p1a", "propose_write", "tally_p1b",
+           "adopt_best_acker", "merge_acker_logs", "accept_p2a",
+           "tally_p2b", "apply_p3", "repropose_target", "p3_out",
+           "retry_stuck", "slide_window"]
+
+BIG = jnp.int32(2 ** 30)
+
+
+def _ridx(st):
+    R = st["log_bal"].shape[0]
+    return jnp.arange(R, dtype=jnp.int32)
+
+
+def _clear_ring(st, drop):
+    """Reset recycled cells in place (the no-copy window move)."""
+    return {**st,
+            "log_bal": jnp.where(drop, 0, st["log_bal"]),
+            "log_cmd": jnp.where(drop, NO_CMD, st["log_cmd"]),
+            "log_commit": st["log_commit"] & ~drop,
+            "proposed": st["proposed"] & ~drop,
+            "log_acks": jnp.where(drop, 0, st["log_acks"])}
+
+
+def adopt_best_acker(st, amask, p1_win, extras):
+    """Phase-1 win, step 1: a laggard winner adopts the most advanced
+    acker's (extras, execute, base) by reference.  Fixed cell mapping:
+    raising my base recycles the cells that fell below it — a masked
+    clear, where the old layout shifted every plane.  Returns
+    (st', extras')."""
+    el_exec = jnp.where(amask, st["execute"][None, :, :], -1)
+    f_src = jnp.argmax(el_exec, axis=1).astype(jnp.int32)
+    front = jnp.max(el_exec, axis=1)
+    el_ad = p1_win & (front > st["execute"])
+    ex = {k: jnp.where(el_ad[(slice(None),)
+                             + (None,) * (v.ndim - 2) + (slice(None),)],
+                       _take_replica(v, f_src), v)
+          for k, v in extras.items()}
+    execute = jnp.where(el_ad, front, st["execute"])
+    next_slot = jnp.where(el_ad, jnp.maximum(st["next_slot"], front),
+                          st["next_slot"])
+    # never adopt a LOWER base: dropping my own top-of-window entries
+    # (possibly committed via P3) is never safe; the merge tolerates
+    # ackers whose base is below mine (front-fill only)
+    f_base = _take_replica(st["base"], f_src)
+    S = st["log_bal"].shape[1]
+    A_old = cell_abs(st["base"], S)
+    base = jnp.where(el_ad, jnp.maximum(f_base, st["base"]), st["base"])
+    st = _clear_ring({**st, "execute": execute, "next_slot": next_slot,
+                      "base": base}, A_old < base[:, None, :])
+    return st, ex
+
+
+def merge_acker_logs(st, amask, p1_win):
+    """Phase-1 win, step 2: merge the ackers' current logs — per slot
+    adopt any committed value, else the highest-ballot accepted value,
+    else NOOP-fill below the frontier; own the window under my ballot.
+    Fixed cell mapping: leader cell c and acker cell c hold the SAME
+    absolute slot exactly when the leader's slot A[ldr, c] is inside
+    the acker's window — a pure mask over the (ldr, src, S, G) cube,
+    no base-alignment gathers.  Returns st' (active set for
+    winners)."""
+    S = st["log_bal"].shape[1]
+    ridx = _ridx(st)
+    self_bit3 = (jnp.int32(1) << ridx)[:, None, None]
+    base = st["base"]
+    A = cell_abs(base, S)                                # (ldr, S, G)
+    Al = A[:, None]                                      # (ldr, 1, S, G)
+    in_src = (Al >= base[None, :, None, :]) \
+        & (Al < base[None, :, None, :] + S)
+    sel = amask[:, :, None, :] & in_src                  # (ldr, src, S, G)
+    lb = jnp.where(sel, st["log_bal"][None], -1)
+    src_best = jnp.argmax(lb, axis=1)                    # first max src
+    best_bal = jnp.max(lb, axis=1)                       # (ldr, S, G)
+    oh_best = ridx[None, :, None, None] == src_best[:, None]
+    merged_cmd = jnp.sum(jnp.where(oh_best, st["log_cmd"][None], 0),
+                         axis=1)
+    cmask = sel & st["log_commit"][None]
+    merged_commit = jnp.any(cmask, axis=1)
+    csrc = jnp.argmax(cmask, axis=1)                     # first committed
+    oh_csrc = ridx[None, :, None, None] == csrc[:, None]
+    committed_cmd = jnp.sum(jnp.where(oh_csrc, st["log_cmd"][None], 0),
+                            axis=1)
+    has_acc = (best_bal > 0) | merged_commit
+    top = jnp.max(jnp.where(has_acc, A + 1, 0), axis=1)  # (ldr, G) abs
+    new_next = jnp.maximum(st["next_slot"], top)
+    in_win = A < new_next[:, None, :]
+    w = p1_win[:, None, :]
+    adopt_cmd = jnp.where(merged_commit, committed_cmd,
+                          jnp.where(best_bal > 0, merged_cmd, NOOP))
+    return {**st,
+            "log_cmd": jnp.where(w & in_win, adopt_cmd, st["log_cmd"]),
+            "log_bal": jnp.where(w & in_win, st["ballot"][:, None, :],
+                                 st["log_bal"]),
+            "log_commit": jnp.where(w & in_win,
+                                    merged_commit | st["log_commit"],
+                                    st["log_commit"]),
+            "proposed": jnp.where(w, in_win
+                                  & (merged_commit | st["log_commit"]),
+                                  st["proposed"]),
+            "log_acks": jnp.where(w, jnp.where(in_win, self_bit3, 0),
+                                  st["log_acks"]),
+            "next_slot": jnp.where(p1_win, new_next, st["next_slot"]),
+            "active": st["active"] | p1_win}
+
+
+def accept_p2a(st, m):
+    """P2a handler: accept from the highest-ballot proposer; ack ONLY
+    what was durably stored in-window.  Returns (st', out_p2b, acc_ok,
+    demote)."""
+    R = st["log_bal"].shape[0]
+    S = st["log_bal"].shape[1]
+    ridx = _ridx(st)
+    G = st["ballot"].shape[-1]
+    b_in = jnp.where(m["valid"], m["bal"], -1)
+    a_src = jnp.argmax(b_in, axis=0).astype(jnp.int32)
+    a_bal = jnp.max(b_in, axis=0)
+    a_has = a_bal > 0
+    a_slot = pick_src(m["slot"], a_src)                  # absolute
+    a_cmd = pick_src(m["cmd"], a_src)
+    acc_ok = a_has & (a_bal >= st["ballot"])
+    demote = acc_ok & (a_bal > st["ballot"])
+    st = depose(st, demote, a_bal)
+    a_inw = in_window(a_slot, st["base"], S)
+    oh = (acc_ok & a_inw)[:, None, :] & cell_onehot(a_slot, S)
+    writable = oh & (st["log_bal"] <= a_bal[:, None, :]) \
+        & ~st["log_commit"]
+    out_p2b = {
+        "valid": (acc_ok & a_inw)[:, None, :]
+        & (ridx[None, :, None] == a_src[:, None, :]),
+        "bal": jnp.broadcast_to(a_bal[:, None, :], (R, R, G)),
+        "slot": jnp.broadcast_to(a_slot[:, None, :], (R, R, G)),
+    }
+    st = {**st,
+          "log_bal": jnp.where(writable, a_bal[:, None, :], st["log_bal"]),
+          "log_cmd": jnp.where(writable, a_cmd[:, None, :], st["log_cmd"])}
+    return st, out_p2b, acc_ok, demote
+
+
+def tally_p2b(st, m, majority, stride):
+    """P2b handler: the leader tallies acks per (slot) bitmask and
+    commits at majority.  Returns (st', newly)."""
+    R = st["log_bal"].shape[0]
+    S = st["log_bal"].shape[1]
+    ob = own_bal_mask(st, stride)
+    okb = m["valid"] & (m["bal"] == st["ballot"][None, :, :]) \
+        & (st["active"] & ob)[None, :, :]                # (src, ldr, G)
+    base = st["base"]
+    log_acks = st["log_acks"]
+    for s in range(R):
+        ok_s = okb[s] & in_window(m["slot"][s], base, S)
+        oh_s = ok_s[:, None, :] & cell_onehot(m["slot"][s], S)
+        log_acks = log_acks | jnp.where(oh_s, jnp.int32(1) << s, 0)
+    acks_n = jax.lax.population_count(log_acks)
+    newly = ((st["active"] & ob)[:, None, :] & (acks_n >= majority)
+             & ~st["log_commit"] & (st["log_cmd"] != NO_CMD)
+             & st["proposed"])
+    return {**st, "log_acks": log_acks,
+            "log_commit": st["log_commit"] | newly}, newly
+
+
+def apply_p3(st, m, extras):
+    """P3 handler: adopt the commit notification, frontier-commit below
+    ``upto`` at the sender's exact ballot, and snapshot-adopt (extras,
+    execute, base) when my frontier fell below the sender's window.
+    Returns (st', extras', c_has, c_bal).
+
+    Zombie fences as in ``ballot_ring.apply_p3`` (higher-ballot P3
+    deposes; frontier-commit only at ``bal >= my promised ballot``).
+    Fixed cell mapping: under snapshot adoption the sender's cells are
+    already aligned with mine, so the overlay is elementwise — my cells
+    still inside the sender's window (``A >= src_base``) are kept where
+    the sender has no commit, everything below was recycled."""
+    S = st["log_bal"].shape[1]
+    c_src = jnp.argmax(jnp.where(m["valid"], m["bal"], -1), axis=0) \
+        .astype(jnp.int32)
+    c_bal = jnp.max(jnp.where(m["valid"], m["bal"], -1), axis=0)
+    c_has = c_bal > 0
+    c_slot = pick_src(m["slot"], c_src)
+    c_cmd = pick_src(m["cmd"], c_src)
+    c_upto = pick_src(m["upto"], c_src)
+    fresh3 = c_has & (c_bal >= st["ballot"])             # fence (2)
+    promote3 = c_has & (c_bal > st["ballot"])            # fence (1)
+    st = depose(st, promote3, c_bal)
+    base = st["base"]
+    A = cell_abs(base, S)
+    c_inw = in_window(c_slot, base, S)
+    oh = (c_has & c_inw)[:, None, :] & cell_onehot(c_slot, S)
+    log_cmd = jnp.where(oh, c_cmd[:, None, :], st["log_cmd"])
+    log_bal = jnp.where(oh, jnp.maximum(st["log_bal"],
+                                        c_bal[:, None, :]), st["log_bal"])
+    log_commit = st["log_commit"] | oh
+    ohu = (fresh3[:, None, :] & (A < c_upto[:, None, :])
+           & (log_bal == c_bal[:, None, :]) & (log_cmd != NO_CMD))
+    log_commit = log_commit | ohu
+
+    # snapshot catch-up for deep laggards
+    src_base = _take_replica(base, c_src)
+    adopt = c_has & (st["execute"] < src_base)
+    keep = A >= src_base[:, None, :]     # my cells still in the new window
+    my_bal = jnp.where(keep, log_bal, 0)
+    my_cmd = jnp.where(keep, log_cmd, NO_CMD)
+    my_com = keep & log_commit
+    s_bal = _take_replica(log_bal, c_src)
+    s_cmd = _take_replica(log_cmd, c_src)
+    s_com = _take_replica(log_commit, c_src)
+    a2 = adopt[:, None, :]
+    ex = {k: jnp.where(adopt[(slice(None),)
+                             + (None,) * (v.ndim - 2) + (slice(None),)],
+                       _take_replica(v, c_src), v)
+          for k, v in extras.items()}
+    execute = jnp.where(adopt, _take_replica(st["execute"], c_src),
+                        st["execute"])
+    st = {**st,
+          "log_bal": jnp.where(a2, jnp.where(s_com, s_bal, my_bal),
+                               log_bal),
+          "log_cmd": jnp.where(a2, jnp.where(s_com, s_cmd, my_cmd),
+                               log_cmd),
+          "log_commit": jnp.where(a2, s_com | my_com, log_commit),
+          "proposed": jnp.where(a2, False, st["proposed"]),
+          "log_acks": jnp.where(a2, 0, st["log_acks"]),
+          "execute": execute,
+          "next_slot": jnp.where(adopt,
+                                 jnp.maximum(st["next_slot"], execute),
+                                 st["next_slot"]),
+          "base": jnp.where(adopt, src_base, base)}
+    return st, ex, c_has, c_bal
+
+
+def repropose_target(st):
+    """Shared proposal targeting: the lowest unproposed-uncommitted
+    absolute slot below next_slot (re-proposal), else the next fresh
+    slot (window flow control).  Returns (has_re, can_new, prop_cell,
+    prop_slot, oh_p, re_cmd)."""
+    S = st["log_bal"].shape[1]
+    base, next_slot = st["base"], st["next_slot"]
+    A = cell_abs(base, S)
+    mask_re = (~st["log_commit"]) & (~st["proposed"]) \
+        & (A < next_slot[:, None, :])
+    re_abs = jnp.min(jnp.where(mask_re, A, BIG), axis=1)
+    has_re = jnp.any(mask_re, axis=1)
+    can_new = (next_slot - base) < S
+    prop_slot = jnp.where(has_re, re_abs, next_slot)     # absolute
+    prop_cell = jnp.remainder(prop_slot, S)
+    oh_p = cell_onehot(prop_slot, S)
+    re_cmd = jnp.sum(jnp.where(oh_p, st["log_cmd"], 0), axis=1)
+    re_cmd = jnp.where(re_cmd == NO_CMD, NOOP, re_cmd)
+    return has_re, can_new, prop_cell, prop_slot, oh_p, re_cmd
+
+
+def p3_out(st, newly, new_execute, is_leader, t):
+    """Emit P3: the lowest newly committed absolute slot, else
+    round-robin retransmit through the committed prefix (laggards
+    behind the window heal via snapshot adoption)."""
+    R = st["log_bal"].shape[0]
+    S = st["log_bal"].shape[1]
+    G = st["ballot"].shape[-1]
+    A = cell_abs(st["base"], S)
+    low_new = jnp.min(jnp.where(newly, A, BIG), axis=1)  # abs
+    any_new = jnp.any(newly, axis=1)
+    span = jnp.maximum(new_execute - st["base"], 1)
+    rr = t % span
+    p3_abs = jnp.where(any_new, low_new, st["base"] + rr)
+    oh_3 = cell_onehot(p3_abs, S)
+    p3_committed = jnp.any(oh_3 & st["log_commit"], axis=1)
+    p3_cmd = jnp.sum(jnp.where(oh_3, st["log_cmd"], 0), axis=1)
+    p3_do = is_leader & p3_committed
+    return {
+        "valid": jnp.broadcast_to(p3_do[:, None, :], (R, R, G)),
+        "bal": jnp.broadcast_to(st["ballot"][:, None, :], (R, R, G)),
+        "slot": jnp.broadcast_to(p3_abs[:, None, :], (R, R, G)),
+        "cmd": jnp.broadcast_to(p3_cmd[:, None, :], (R, R, G)),
+        "upto": jnp.broadcast_to(new_execute[:, None, :], (R, R, G)),
+    }
+
+
+def retry_stuck(st, new_execute, is_leader, retry_timeout):
+    """Stuck-frontier retry, go-back-N: on a stall re-open EVERY
+    uncommitted in-flight slot so the proposer re-proposes one per step
+    (see ballot_ring.retry_stuck)."""
+    S = st["log_bal"].shape[1]
+    A = cell_abs(st["base"], S)
+    stalled = is_leader & (new_execute == st["execute"]) \
+        & (st["next_slot"] > new_execute)
+    stuck = jnp.where(stalled, st["stuck"] + 1, 0)
+    retry = stuck >= retry_timeout
+    ohr = (retry[:, None, :] & ~st["log_commit"]
+           & (A >= new_execute[:, None, :])
+           & (A < st["next_slot"][:, None, :]))
+    return {**st, "proposed": st["proposed"] & ~ohr,
+            "stuck": jnp.where(retry, 0, stuck)}
+
+
+def slide_window(st, new_execute, retain):
+    """Slide the window past the executed prefix, retaining ``retain``
+    executed slots for P3 retransmits.  Fixed cell mapping: recycled
+    cells are cleared in place, nothing moves."""
+    S = st["log_bal"].shape[1]
+    new_base = jnp.maximum(st["base"], new_execute - retain)
+    drop = cell_abs(st["base"], S) < new_base[:, None, :]
+    return _clear_ring({**st, "base": new_base, "execute": new_execute},
+                       drop)

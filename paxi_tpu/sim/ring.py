@@ -2,14 +2,31 @@
 
 The reference keeps unbounded per-slot maps (``log map[int]*entry``,
 paxos.go [driver]); inside a jitted kernel the log must be a fixed-shape
-ring instead: ring position ``i`` holds absolute slot ``base + i`` and
-the window slides forward as the execute frontier advances (SURVEY §7
-slot-recycling requirement — a 10M-slot horizon runs in a 64-slot ring).
+ring instead (SURVEY §7 slot-recycling requirement — a 10M-slot horizon
+runs in a 64-slot ring).  TWO ring-layout contracts coexist in this
+tree; know which one a kernel uses before touching its slot math:
+
+- **Sliding-window (this module)**: ring position ``i`` holds absolute
+  slot ``base + i``; the window slides forward as the execute frontier
+  advances via :func:`shift_window` data movements.  Every shift
+  scalarizes into a gather on XLA:CPU, which is why the hot-path
+  kernels left this layout.  Still used by: epaxos, kpaxos,
+  switchpaxos (via sim/ballot_ring.py), and the frozen pre-rewrite
+  references ``protocols/*/sim_sw.py``.
+- **Fixed-cell (sim/cell.py)**: absolute slot ``a`` lives at cell
+  ``a % S`` forever; window moves are masked clears of recycled cells,
+  and replicas' cells align without per-pair realignment.  Used by:
+  paxos (+ the per-group ``paxos_pg``), sdpaxos, wankeeper (via
+  sim/cell_ring.py), wpaxos, bpaxos, and chain (fixed-cell since
+  birth).  The PXL11x lint family pins the rewritten kernels to it,
+  and tests/test_fixed_cell_equiv.py proves each rewrite
+  bit-canonically equal to its ``sim_sw`` reference.
 
 These helpers operate on lane-major arrays (group axis LAST, slot axis
-second-to-last) so every protocol kernel shares one shift
-implementation: paxos (R, S, G), kpaxos (R, P, S, G), wpaxos
-(R, O, S, G), ...
+second-to-last) so every sliding-window kernel shares one shift
+implementation: epaxos (R, S, G) + deps planes, kpaxos (R, P, S, G),
+...  The masked-select helpers (``pick_src``/``take_replica``/
+``dst_major``/``diag2``) are layout-free and serve both contracts.
 """
 
 from __future__ import annotations

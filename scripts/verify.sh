@@ -151,6 +151,38 @@ print(f"bench smoke OK: {r['committed_slots']} slots in "
       f"{lat['p50_rounds']} p99={lat['p99_rounds']} rounds "
       f"({lat['n']} samples), inscan_violations=0")
 PYEOF
+    echo "== bench smoke (fixed-cell layout equivalence) =="
+    # the PR-15 layout contract at a toy shape: the fixed-cell paxos
+    # kernel must be bit-canonically equal to its frozen sliding-window
+    # reference (sim_sw) on a pinned fuzzed seed — state hash after
+    # rolling to window order, counters, and both oracle verdicts.
+    # A layout regression fails this gate in seconds.
+    timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'PYEOF' || exit $?
+import numpy as np
+from paxi_tpu.protocols.paxos.sim import PROTOCOL as NEW
+from paxi_tpu.protocols.paxos.sim_sw import PROTOCOL as SW
+from paxi_tpu.sim import FuzzConfig, SimConfig, simulate
+from paxi_tpu.sim.cell import canonical_state_np
+from paxi_tpu.trace.replay import state_hash
+cfg = SimConfig(n_replicas=3, n_slots=16)
+fz = FuzzConfig(p_drop=0.2, max_delay=2)
+r_sw = simulate(SW, cfg, 4, 48, fuzz=fz, seed=11)
+r_new = simulate(NEW, cfg, 4, 48, fuzz=fz, seed=11)
+assert int(r_sw.violations) == int(r_new.violations) == 0
+assert r_sw.inscan_violations == r_new.inscan_violations == 0
+c_sw = {k: np.asarray(v) for k, v in r_sw.state.items()
+        if not k.startswith("m_")}
+c_new = canonical_state_np("paxos", r_new.state)
+h_sw, h_new = state_hash(c_sw), state_hash(c_new)
+assert h_sw == h_new, (h_sw, h_new)
+ctr = (r_sw.counters, r_new.counters)
+assert {k: int(v) for k, v in ctr[0].items()} \
+    == {k: int(v) for k, v in ctr[1].items()}, ctr
+assert int(r_new.metrics["committed_slots"]) > 0
+print(f"fixed-cell smoke OK: paxos sim == sim_sw bit-canonically "
+      f"(hash {h_new[:12]}..., "
+      f"{int(r_new.metrics['committed_slots'])} slots, counters equal)")
+PYEOF
     echo "== bench smoke (bpaxos compartmentalized grid) =="
     # the 11th protocol's bench_all config at a toy shape: grid-quorum
     # commits must progress, the HT-Paxos batching must be visible
@@ -265,7 +297,7 @@ for v in r["violations"] + r["suppressed"]:
     for k in ("rule", "code", "path", "line", "col", "message"):
         assert k in v, (k, v)
 known = ("PXK", "PXH", "PXT", "PXC", "PXQ", "PXB", "PXS", "PXF", "PXA",
-         "PXM")
+         "PXM", "PXL")
 for s in r["suppressed"]:
     assert s["code"].startswith(known), s["code"]
     assert s.get("suppressed_by"), s

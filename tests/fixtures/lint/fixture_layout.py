@@ -1,0 +1,36 @@
+"""Seeded violations for the fixed-cell-layout family (PXL11x).
+
+A miniature fixed-cell-kernel-shaped module that re-introduces every
+sliding-window spelling the rule must catch: a shift primitive
+from-import (PXL111), a module-alias attribute reference (PXL111),
+and the sliding-window ballot_ring core import (PXL112) —
+``clean_step`` shows the sanctioned fixed-cell idioms and must stay
+green.  Never imported; driven via
+``layout.check(root, files=[...])`` in tests/test_lint.py.
+"""
+
+import jax.numpy as jnp
+
+# MUTANT 1 (PXL111): the shift primitive is back
+from paxi_tpu.sim.ring import shift_window  # noqa: F401
+# MUTANT 2 (PXL112): the sliding-window core instead of cell_ring
+from paxi_tpu.sim import ballot_ring as br  # noqa: F401
+from paxi_tpu.sim import ring
+
+from paxi_tpu.sim import cell
+
+
+def step(state, inbox, ctx):
+    # MUTANT 3 (PXL111): the module-attribute spelling
+    log = ring.shift_window(state["log_cmd"], state["base"], -1)
+    return dict(state, log_cmd=log), {}
+
+
+def clean_step(state, inbox, ctx):
+    # the sanctioned fixed-cell idioms: abs-plane arithmetic + masked
+    # clears (sim/cell.py), never a shift
+    S = state["log_cmd"].shape[-2]
+    A = cell.cell_abs(state["base"], S)
+    drop = A < state["base"][..., None, :]
+    log = jnp.where(drop, -1, state["log_cmd"])
+    return dict(state, log_cmd=log), {}

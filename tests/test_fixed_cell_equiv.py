@@ -1,0 +1,165 @@
+"""Fixed-cell rewrite equivalence: bit-canonical proof per kernel.
+
+PR 15 rewrote the five lane-major kernels (paxos, sdpaxos, wpaxos,
+wankeeper, bpaxos) from the sliding-window ring layout onto the
+fixed-cell mapping (sim/cell.py — absolute slot ``a`` at cell ``a % S``
+forever).  The rewrite claims *layout-only* change: identical PRNG
+draws, identical outboxes, identical counters, identical logical state.
+These tests enforce it against the frozen pre-rewrite kernels
+(``protocols/*/sim_sw.py``) on pinned fuzz seeds:
+
+- the final state matches BIT-FOR-BIT after rolling each fixed-cell
+  ring plane to window order (``cell.window_view_np`` — a pure
+  permutation), hashed with the trace witness hash (``m_`` excluded);
+- every metric and ``net_*`` counter matches exactly, as do the
+  invariant-oracle and in-scan spot-check verdicts.
+
+One deliberate exception: the deferred-flush kernels' (paxos, sdpaxos)
+``commit_lat_n`` sample COUNT.  Their pending ``m_commit_dt`` plane is
+position-keyed; under the old layout steady-state commits landed on the
+same window-relative position and overwrote unflushed samples, while
+fixed cells never collide within a flush period — the rewrite strictly
+gains samples (an observability improvement, not a behavior change;
+``commit_lat_sum`` still matches exactly because sums are
+position-free).
+
+Tier-1 runs one drop/delay-fuzzed pair per kernel at a small recycling
+shape; the heavier partition/crash and long-horizon pairs are ``slow``
+(tier-1 budget precedent, PR 5/7/9/11).
+"""
+
+import numpy as np
+import pytest
+
+from paxi_tpu.sim import FuzzConfig, SimConfig, simulate
+from paxi_tpu.sim.cell import (RING_PLANES, canonical_state_np,
+                               window_view_np)
+from paxi_tpu.trace.replay import state_hash
+
+# small shapes that still recycle the ring (steps >> n_slots)
+CFG = {
+    "paxos": dict(n_replicas=3, n_slots=16),
+    "sdpaxos": dict(n_replicas=3, n_slots=16),
+    "wankeeper": dict(n_replicas=6, n_zones=3, n_slots=16),
+    "wpaxos": dict(n_replicas=6, n_zones=3, n_slots=8, n_objects=4),
+    "bpaxos": dict(n_replicas=7, n_slots=16),
+}
+
+# the deferred-flush kernels whose pending-plane sample count legally
+# differs (see module docstring); everything else compares exactly
+PENDING_PLANE = {"paxos", "sdpaxos"}
+
+DROP = FuzzConfig(p_drop=0.2, max_delay=2)
+HEAVY = FuzzConfig(p_partition=0.3, p_crash=0.2, max_delay=2, window=12)
+
+
+def _protocols(name):
+    import importlib
+    sw = importlib.import_module(
+        f"paxi_tpu.protocols.{name}.sim_sw").PROTOCOL
+    new = importlib.import_module(
+        f"paxi_tpu.protocols.{name}.sim").PROTOCOL
+    return sw, new
+
+
+def assert_equivalent(name, fuzz, groups=6, steps=80, seed=11):
+    sw, new = _protocols(name)
+    cfg = SimConfig(**CFG[name])
+    r_sw = simulate(sw, cfg, groups, steps, fuzz=fuzz, seed=seed)
+    r_new = simulate(new, cfg, groups, steps, fuzz=fuzz, seed=seed)
+
+    # oracle verdicts agree (and are clean)
+    assert int(r_sw.violations) == int(r_new.violations) == 0
+    assert r_sw.inscan_violations == r_new.inscan_violations == 0
+
+    # bit-canonical state: hash after rolling to window order (the
+    # shared canonicalizer — sim/cell.py owns the ring-plane registry)
+    c_sw = {k: np.asarray(v) for k, v in r_sw.state.items()
+            if not k.startswith("m_")}
+    c_new = canonical_state_np(name, r_new.state)
+    assert sorted(c_sw) == sorted(c_new)
+    for k in c_sw:
+        assert np.array_equal(c_sw[k], c_new[k]), \
+            f"{name}: state plane {k!r} diverges"
+    assert state_hash(c_sw) == state_hash(c_new)
+
+    # metrics + net_* counters, exact (commit_lat_n excepted for the
+    # pending-plane kernels — see module docstring)
+    assert sorted(r_sw.metrics) == sorted(r_new.metrics)
+    for k in r_sw.metrics:
+        if k == "commit_lat_n" and name in PENDING_PLANE:
+            assert int(r_new.metrics[k]) >= int(r_sw.metrics[k])
+            continue
+        assert int(r_sw.metrics[k]) == int(r_new.metrics[k]), \
+            f"{name}: metric {k!r} diverges"
+    # progress actually happened (the proof is vacuous on a dead run)
+    assert int(r_new.metrics["committed_slots"]) > 0
+
+
+@pytest.mark.parametrize("name", sorted(RING_PLANES))
+def test_drop_fuzzed_equivalence(name):
+    """One drop/delay-fuzzed pair per kernel in tier-1: elections,
+    retries, re-proposals, snapshots and ring recycling all fire at
+    steps >> n_slots, and the fixed-cell kernel must match its frozen
+    sliding-window reference bit-canonically."""
+    assert_equivalent(name, DROP)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(RING_PLANES))
+def test_partition_crash_equivalence(name):
+    """Partition/crash schedules drive the deep-laggard paths (P1b
+    state transfer, P3 snapshot adoption) hardest — slow tier."""
+    assert_equivalent(name, HEAVY, steps=120, seed=7)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(RING_PLANES))
+def test_fault_free_long_horizon_equivalence(name):
+    """Fault-free long horizon: hundreds of slots through the small
+    ring — the steady-state recycling path at depth — slow tier."""
+    assert_equivalent(name, FuzzConfig(), steps=200, seed=3)
+
+
+def test_paxos_compiled_hlo_has_zero_gathers():
+    """The mechanism behind the wall-clock win, pinned structurally:
+    the fixed-cell lane-major paxos kernel compiles to ZERO gather ops
+    while its frozen sliding-window twin pays one per shift (XLA:CPU
+    scalarizes them) — the same diff ``python -m paxi_tpu profile
+    --gathers`` reports from the CLI."""
+    from paxi_tpu.profiling import gather_report
+    rep = gather_report("paxos", groups=16, steps=8, replicas=3,
+                        slots=16)
+    assert rep["hlo_ops"]["gather"] == 0, rep["hlo_ops"]
+    assert rep["hlo_ops_sw"]["gather"] > 0, rep["hlo_ops_sw"]
+    assert rep["gathers_eliminated"] == rep["hlo_ops_sw"]["gather"]
+
+
+def test_window_view_roundtrip():
+    """The canonicalizer is a pure permutation: scattering a window
+    into fixed cells and rolling it back is the identity."""
+    rng = np.random.default_rng(0)
+    S = 8
+    base = rng.integers(0, 100, size=(3, 2))
+    win = rng.integers(0, 1000, size=(3, 2, S))
+    fixed = np.zeros_like(win)
+    for i in np.ndindex(3, 2):
+        for j in range(S):
+            fixed[i][(base[i] + j) % S] = win[i][j]
+    assert np.array_equal(window_view_np(fixed, base), win)
+
+
+def test_cell_abs_matches_window():
+    """cell_abs assigns each cell the unique in-window slot congruent
+    to it mod S, for any base."""
+    import jax.numpy as jnp
+
+    from paxi_tpu.sim.cell import cell_abs
+    base = jnp.array([[0, 5], [17, 63]], jnp.int32)      # (..., G)
+    S = 8
+    A = np.asarray(cell_abs(base, S))
+    for i in np.ndindex(2, 8, 2):
+        r, c, g = i
+        a = A[r, c, g]
+        assert base[r, g] <= a < base[r, g] + S
+        assert a % S == c

@@ -17,8 +17,8 @@ import pytest
 
 from paxi_tpu import analysis
 from paxi_tpu.analysis import (asyncflow, ballots, concurrency, crossflow,
-                               handlers, measure, parity, purity, quorum,
-                               tracemap)
+                               handlers, layout, measure, parity, purity,
+                               quorum, tracemap)
 from paxi_tpu.analysis.model import (Suppression, Violation,
                                      apply_suppressions, inline_disables,
                                      load_baseline)
@@ -489,6 +489,36 @@ def test_measure_repo_tree_is_clean():
     assert measure.check(ROOT) == []
 
 
+def test_layout_fixture_catches_each_mutant():
+    """PXL11x: all three sliding-window re-introductions fire (the
+    shift from-import, the ballot_ring core import, the
+    module-attribute shift reference); the sanctioned fixed-cell
+    idioms in ``clean_step`` stay green."""
+    vs = layout.check(ROOT, files=[FIX / "fixture_layout.py"])
+    assert codes(vs) == ["PXL111", "PXL112"]
+    # mutants 1 (from-import) and 3 (module-attribute reference) are
+    # distinct PXL111 sites; mutant 2 is the PXL112 core import
+    assert len([v for v in vs if v.code == "PXL111"]) == 2
+    assert len([v for v in vs if v.code == "PXL112"]) == 1
+    src = (FIX / "fixture_layout.py").read_text().splitlines()
+    clean_start = next(i for i, l in enumerate(src, 1)
+                       if l.startswith("def clean_step"))
+    assert all(v.line < clean_start for v in vs), \
+        "the fixed-cell cell_abs/masked-clear idioms must not be flagged"
+
+
+def test_layout_rewritten_kernels_are_clean():
+    """The five fixed-cell kernels (paxos/sdpaxos/wpaxos/wankeeper/
+    bpaxos sim.py) never re-import a sliding-window shift primitive or
+    the ballot_ring core — the layout contract behind the PR-15
+    gather elimination (tier-1, no baseline).  The frozen ``sim_sw``
+    references and the still-sliding kernels are deliberately not
+    targets."""
+    assert layout.check(ROOT) == []
+    # the default target set IS the five rewritten kernels
+    assert len(layout.TARGETS) == 5
+
+
 def test_cli_lint_json_on_fixture(capsys):
     from paxi_tpu.cli import main
     rc = main(["lint", str(FIX / "fixture_host.py"),
@@ -590,23 +620,39 @@ def test_crossflow_call_site_proof_shape():
 
 def test_crossflow_repo_clean_and_covers_all_five_kernels():
     """Tier-1 pin of the ISSUE's acceptance bar: the tree is clean and
-    the ballot-ring guard proof covers every consumer — the three
-    kernels importing sim/ballot_ring.py through its call sites, and
-    the two grid kernels (wpaxos/bpaxos) through their in-module
-    epoch writes."""
+    the ballot-ring guard proof covers every consumer — both consensus
+    cores (sliding-window ballot_ring and its fixed-cell twin
+    cell_ring) through their call sites, and the two grid kernels
+    (wpaxos/bpaxos) through their in-module epoch writes."""
     assert crossflow.check(ROOT) == []
     cov = crossflow.coverage(ROOT)
     br = cov["paxi_tpu/sim/ballot_ring.py"]
     assert br["writes"] >= 10 and br["proven"] == br["writes"]
     assert "call-site" in br["via"]
+    # layout-free helpers (promise/tally/election) are re-exported
+    # through cell_ring, so the live kernels AND the frozen sim_sw
+    # references AND the fixed-cell core itself are consumers now
     assert set(br["consumers"]) == {
         "paxi_tpu/protocols/paxos/sim.py",
+        "paxi_tpu/protocols/paxos/sim_sw.py",
         "paxi_tpu/protocols/sdpaxos/sim.py",
+        "paxi_tpu/protocols/sdpaxos/sim_sw.py",
         "paxi_tpu/protocols/switchpaxos/sim.py",
         "paxi_tpu/protocols/wankeeper/sim.py",
+        "paxi_tpu/protocols/wankeeper/sim_sw.py",
+        "paxi_tpu/sim/cell_ring.py",
     }
-    # the cross-module proofs name all three importing kernels
-    proof_text = " ".join(br["call_site_proofs"])
+    # the fixed-cell core's own layout-dependent writes are proven
+    # through its three consumer kernels' call sites
+    cr = cov["paxi_tpu/sim/cell_ring.py"]
+    assert cr["writes"] >= 5 and cr["proven"] == cr["writes"]
+    assert "call-site" in cr["via"]
+    assert set(cr["consumers"]) == {
+        "paxi_tpu/protocols/paxos/sim.py",
+        "paxi_tpu/protocols/sdpaxos/sim.py",
+        "paxi_tpu/protocols/wankeeper/sim.py",
+    }
+    proof_text = " ".join(cr["call_site_proofs"])
     for kernel in ("paxos/sim.py", "sdpaxos/sim.py", "wankeeper/sim.py"):
         assert kernel in proof_text, kernel
     for rel in ("paxi_tpu/protocols/wpaxos/sim.py",
