@@ -15,6 +15,13 @@ bench.py's methodology.
 over an N-device mesh via parallel/mesh.make_sharded_run (default 8
 virtual CPU devices); group counts that don't divide the mesh ride the
 inert-padding path.
+
+``--workload`` switches to the workload x topology matrix
+(paxi_tpu/workload): {uniform, zipf99, flash} x {paxos 3-replica,
+wpaxos 3x3 grid}, one JSON line per cell into BENCH_WORKLOAD.json.
+The uniform rows are the same-day controls: skew effects (per-key-class
+latency split, wpaxos object-stealing churn) are read against a
+control measured in the SAME invocation on the same build.
 """
 
 import json
@@ -29,6 +36,7 @@ if "--mesh" in sys.argv:
     sys.argv = [a for j, a in enumerate(sys.argv)
                 if j != i and not (j == i + 1 and nxt.isdigit())]
 MESH_N = int(os.environ.get("BENCH_ALL_MESH", "0"))
+WL_MODE = "--workload" in sys.argv
 
 if (os.environ.get("BENCH_ALL_DEVICE", "cpu") == "cpu"
         and os.environ.get("_BENCH_ALL_STAGE") != "run"):
@@ -143,12 +151,115 @@ def _cfgs():
     ]
 
 
+def _wl_cfgs():
+    """The workload matrix: (label, protocol, SimConfig, workload
+    name, groups, steps, metric key, unit).  Every (protocol,
+    topology) pair runs its uniform control next to the skewed
+    specs."""
+    big = jax.default_backend() != "cpu"
+    s = 16 if big else 1
+    # single-zone majority-quorum baseline
+    paxos_cfg = SimConfig(n_replicas=3, n_slots=16, n_keys=64)
+    # the 3x3 locality grid sized so skew visibly churns object
+    # ownership: 16 objects over 32 keys, steal threshold 4 remote
+    # demands — uniform traffic rarely concentrates 4 remote demands
+    # on one object, a zipf hot set does constantly
+    wpaxos_cfg = SimConfig(n_replicas=9, n_zones=3, n_slots=16,
+                           n_keys=32, n_objects=16, steal_threshold=4,
+                           locality=0.8)
+    out = []
+    for wl_name in ("uniform", "zipf99", "flash"):
+        out.append((f"paxos_{wl_name}", "paxos", paxos_cfg, wl_name,
+                    64 * s, 120, "committed_slots", "slots/s"))
+        out.append((f"wpaxos_grid_{wl_name}", "wpaxos", wpaxos_cfg,
+                    wl_name, 8 * s, 120, "committed_slots", "slots/s"))
+    return out
+
+
+def workload_main(dev, mesh) -> int:
+    """--workload: the matrix above -> BENCH_WORKLOAD.json."""
+    from paxi_tpu.metrics import lathist
+    from paxi_tpu.workload import (apply_workload, class_split,
+                                   named_workload)
+    results = []
+    worst = 0
+    steals = {}
+    for (label, proto_name, cfg0, wl_name, groups, steps, key,
+         unit) in _wl_cfgs():
+        cfg = apply_workload(cfg0, named_workload(wl_name))
+        proto = sim_protocol(proto_name)
+        if mesh is not None:
+            from paxi_tpu.parallel import make_sharded_run
+            run = make_sharded_run(proto, cfg, fuzz=FAULT_FREE,
+                                   mesh=mesh)
+        else:
+            run = make_run(proto, cfg, FAULT_FREE)
+        compiled = run.lower(jr.PRNGKey(0), groups, steps).compile()
+        jax.block_until_ready(compiled(jr.PRNGKey(1)))
+        t0 = time.perf_counter()
+        state, metrics, viols = compiled(jr.PRNGKey(0))
+        jax.block_until_ready(viols)
+        dt = time.perf_counter() - t0
+        n = int(metrics[key])
+        line = {
+            "metric": f"{label}_{key}_per_sec",
+            "value": round(n / dt, 1),
+            "unit": unit,
+            "config": label,
+            "protocol": proto.name,
+            "workload": wl_name,
+            key: n,
+            "wall_s": round(dt, 3),
+            "invariant_violations": int(viols),
+            "inscan_violations": int(metrics.get("inscan_violations",
+                                                 0)),
+            "groups": groups,
+            "steps": steps,
+            "mesh": mesh.shape["i"] if mesh is not None else 0,
+            "device": dev,
+        }
+        hist = lathist.total_hist(state)
+        if hist is not None:
+            line["commit_latency"] = lathist.summarize(
+                hist, int(metrics.get("commit_lat_sum", 0)))
+        line["key_class_latency"] = class_split(state)
+        line["key_class_counts"] = {
+            c: int(metrics.get(f"wl_{c}_n", 0))
+            for c in ("hot", "warm", "cold")}
+        if "steals" in metrics:
+            line["steals"] = int(metrics["steals"])
+            steals[(proto.name, wl_name)] = line["steals"]
+        worst = max(worst, int(viols), line["inscan_violations"])
+        results.append(line)
+        print(json.dumps(line), flush=True)
+    # the headline contrast, spelled out so the artifact answers it
+    # without arithmetic: skew churns ownership, the control does not
+    u, z = steals.get(("wpaxos", "uniform")), \
+        steals.get(("wpaxos", "zipf99"))
+    if u is not None and z is not None:
+        contrast = {"summary": "wpaxos_steal_contrast",
+                    "uniform_steals": u, "zipf99_steals": z,
+                    "skew_drives_stealing": z > u}
+        results.append(contrast)
+        print(json.dumps(contrast), flush=True)
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_WORKLOAD.json")
+        with open(path, "w") as f:
+            json.dump(results, f, indent=1)
+    except OSError:
+        pass
+    return 0 if worst == 0 else 1
+
+
 def main() -> int:
     dev = str(jax.devices()[0])
     mesh = None
     if MESH_N and len(jax.devices()) > 1:
         from paxi_tpu.parallel import make_mesh, make_sharded_run
         mesh = make_mesh(min(MESH_N, len(jax.devices())))
+    if WL_MODE:
+        return workload_main(dev, mesh)
     results = []
     worst = 0
     for (label, proto_name, cfg, fuzz, groups, steps, key,

@@ -22,6 +22,7 @@ from paxi_tpu.core.config import Bconfig, local_config
 from paxi_tpu.host.benchmark import Benchmark
 from paxi_tpu.host.simulation import Cluster
 from paxi_tpu.metrics import merge_snapshots
+from paxi_tpu.workload import named_workload
 
 CONFIGS = [
     # (protocol, n, zones, linearizable?)
@@ -53,11 +54,16 @@ async def bench_one(name: str, n: int, zones: int, lin: bool) -> dict:
     cfg.benchmark = Bconfig(T=secs, K=8, W=0.5, concurrency=4,
                             warmup=min(warm, secs / 2),
                             linearizability_check=lin)
+    # BENCH_HOST_WORKLOAD=<named spec>: drive every protocol with a
+    # paxi_tpu/workload spec instead of the uniform KeyGen/W draws
+    # (same spec family the sim kernels compile — workload/compile.py)
+    wl_name = os.environ.get("BENCH_HOST_WORKLOAD", "")
+    wl = named_workload(wl_name) if wl_name else None
     c = Cluster(name, cfg=cfg, http=True)
     await c.start()
     try:
         t0 = time.perf_counter()
-        bench = Benchmark(cfg, cfg.benchmark, seed=1)
+        bench = Benchmark(cfg, cfg.benchmark, seed=1, workload=wl)
         stats = await bench.run()
         dt = time.perf_counter() - t0
         return {
@@ -76,6 +82,7 @@ async def bench_one(name: str, n: int, zones: int, lin: bool) -> dict:
             "errors": stats.errors,
             "anomalies": (stats.anomalies if lin else None),
             "consistency": ("linearizable" if lin else "eventual"),
+            **({"workload": wl.name} if wl is not None else {}),
             "wall_s": round(dt, 2),
             "latency": {k: v for k, v in stats.summary().items()
                         if k.startswith("latency_")},

@@ -33,6 +33,11 @@ kernels; guards the PR-15 shift-gather elimination):
 
 - ``fixed-cell-layout``    (layout.py,      PXL11x)
 
+Workload purity (counter-based draw contract over the workload
+engine; guards the PR-16 cross-runtime pinned replay):
+
+- ``workload-purity``      (workload.py,    PXW12x)
+
 Entry points: ``python -m paxi_tpu lint [--rule ...] [--json]`` (cli.py;
 ``--rule`` takes family names or code prefixes like ``PXQ,PXB``) and
 :func:`run_lint` for tests/tooling.  Intentional exceptions live in
@@ -49,7 +54,7 @@ from typing import Dict, List, Optional, Sequence
 
 from paxi_tpu.analysis import astutil, asyncflow, ballots, concurrency, \
     crossflow, handlers, layout, measure, parity, purity, quorum, \
-    tracemap
+    tracemap, workload
 from paxi_tpu.analysis.model import (LintReport, Suppression, Violation,
                                      apply_suppressions, inline_disables,
                                      load_baseline)
@@ -71,6 +76,7 @@ RULES = {
     asyncflow.RULE: asyncflow,
     measure.RULE: measure,
     layout.RULE: layout,
+    workload.RULE: workload,
 }
 
 # violation-code prefix -> rule family, the CLI's short spelling
@@ -88,6 +94,7 @@ CODE_PREFIXES = {
     "PXA": asyncflow.RULE,
     "PXM": measure.RULE,
     "PXL": layout.RULE,
+    "PXW": workload.RULE,
 }
 
 # pair-driven rules (registry-derived sim/host pairs instead of globs)

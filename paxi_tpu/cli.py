@@ -107,7 +107,8 @@ def cmd_bench_host(args) -> int:
             rates=rates, step_s=args.step_s, K=args.K, W=args.W,
             seed=args.seed, base_port=args.base_port,
             txns=args.txns, lin=not args.no_lin, conns=args.conns,
-            proc=args.cluster_proc))
+            proc=args.cluster_proc,
+            workload=getattr(args, "workload", "")))
         print(json.dumps({k: v for k, v in out.items()
                           if k != "phases"}))
         if args.out:
@@ -129,6 +130,15 @@ def cmd_bench_host(args) -> int:
     cfg.leader_reads = args.leader_reads
     rates = [float(r) for r in args.rates.split(",") if r]
 
+    wl = None
+    if getattr(args, "workload", ""):
+        from paxi_tpu.workload import named_workload
+        try:
+            wl = named_workload(args.workload)
+        except KeyError as e:
+            print(f"bench-host: {e.args[0]}", file=sys.stderr)
+            return 2
+
     async def run_open_loop(target_cfg, worker_rates=None):
         from paxi_tpu.host.benchmark import OpenLoopBenchmark
         bench = OpenLoopBenchmark(
@@ -137,7 +147,8 @@ def cmd_bench_host(args) -> int:
             key_base=args.key_base, client_tag=args.client_tag,
             ops_per_req=args.ops_per_req,
             max_inflight=args.max_inflight,
-            linearizability_check=not args.no_lin)
+            linearizability_check=not args.no_lin,
+            workload=wl, wl_stream=args.wl_stream)
         return await bench.run()
 
     if args.attach:
@@ -173,6 +184,7 @@ def cmd_bench_host(args) -> int:
               "batch_wait": cfg.batch_wait,
               "leader_reads": cfg.leader_reads,
               "ops_per_req": args.ops_per_req,
+              **({"workload": wl.name} if wl is not None else {}),
               "cluster_proc": bool(args.cluster_proc
                                    or args.gen_procs > 1)}
 
@@ -266,6 +278,10 @@ def _parallel_workers(args, cfg_path: str, rates) -> dict:
                "-ops_per_req", str(args.ops_per_req),
                "-max_inflight", str(args.max_inflight),
                "-client_tag", f"w{w}c"]
+        if getattr(args, "workload", ""):
+            # each worker keeps the spec but draws its own counter
+            # stream (deterministic per worker, independent across)
+            cmd += ["-workload", args.workload, "-wl_stream", str(w)]
         if args.no_lin:
             cmd.append("--no-lin")
         procs.append(subprocess.Popen(
@@ -294,10 +310,20 @@ def _parallel_workers(args, cfg_path: str, rates) -> dict:
         merged["achieved_ops_s"] = round(
             sum(r["steps"][i]["achieved_ops_s"] for r in reports), 1)
         h = Histogram()
+        by_class: dict = {}
         for r in reports:
             for hs in r["metrics"]["histograms"]:
-                if hs["labels"].get("rate") == str(worker_rates[i]):
+                if hs["labels"].get("rate") != str(worker_rates[i]):
+                    continue
+                kc = hs["labels"].get("key_class")
+                if kc is None:
                     h.merge(Histogram.from_snapshot(hs))
+                else:
+                    # workers double-record into a per-key-class series;
+                    # keep it out of the overall merge and bucket-merge
+                    # per class instead
+                    by_class.setdefault(kc, Histogram()).merge(
+                        Histogram.from_snapshot(hs))
         merged["latency_ms"] = {
             "mean": round(h.mean() * 1e3, 3),
             "p50": round(h.percentile(50) * 1e3, 3),
@@ -305,6 +331,12 @@ def _parallel_workers(args, cfg_path: str, rates) -> dict:
             "p99": round(h.percentile(99) * 1e3, 3),
             "max": round(h.max * 1e3, 3),
         }
+        if by_class:
+            merged["key_class_latency"] = {
+                c: {"n": ch.count,
+                    "p50_ms": round(ch.percentile(50) * 1e3, 3),
+                    "p99_ms": round(ch.percentile(99) * 1e3, 3)}
+                for c, ch in by_class.items()}
         steps.append(merged)
     achieved = [s["achieved_ops_s"] for s in steps]
     peak = max(range(len(steps)), key=lambda i: achieved[i])
@@ -333,7 +365,11 @@ async def _closed_loop(args, cfg) -> dict:
                             concurrency=args.concurrency,
                             warmup=args.warmup,
                             linearizability_check=not args.no_lin)
-    bench = Benchmark(cfg, cfg.benchmark, seed=args.seed)
+    wl = None
+    if getattr(args, "workload", ""):
+        from paxi_tpu.workload import named_workload
+        wl = named_workload(args.workload)
+    bench = Benchmark(cfg, cfg.benchmark, seed=args.seed, workload=wl)
     stats = await bench.run()
     return dict(stats.summary(), mode="closed-loop")
 
@@ -645,6 +681,58 @@ def cmd_scenario(args) -> int:
     return 0 if payload["invariant_violations"] == 0 else 1
 
 
+def cmd_workload(args) -> int:
+    """The production workload engine (paxi_tpu/workload): list the
+    named spec catalog, or run one spec through the sim runtime and
+    report the per-key-class latency split.  (The host runtime serves
+    the same specs via ``bench-host -workload`` / the closed-loop
+    ``BENCH_HOST_WORKLOAD`` env.)"""
+    from paxi_tpu import workload as wlmod
+
+    if args.workload_cmd == "list":
+        for name in sorted(wlmod.NAMED):
+            print(json.dumps(wlmod.describe(wlmod.NAMED[name],
+                                            n_keys=args.keys)))
+        return 0
+    assert args.workload_cmd == "run"
+    try:
+        wl = wlmod.named_workload(args.workload)
+    except KeyError as e:
+        print(f"workload: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    from paxi_tpu.protocols import sim_protocol
+    from paxi_tpu.sim import FuzzConfig, SimConfig, simulate
+    cfg = SimConfig(n_replicas=args.replicas, n_slots=args.slots,
+                    n_keys=args.keys, n_zones=args.zones,
+                    n_objects=args.objects)
+    try:
+        cfg = wlmod.apply_workload(cfg, wl)
+    except ValueError as e:
+        print(f"workload: {e}", file=sys.stderr)
+        return 2
+    proto = sim_protocol(args.algorithm)
+    fuzz = FuzzConfig(p_drop=args.p_drop, max_delay=args.max_delay)
+    res = simulate(proto, cfg, args.groups, args.steps, fuzz=fuzz,
+                   seed=args.seed)
+    payload = {k: int(v) for k, v in res.metrics.items()
+               if not k.startswith("commit_lat_")}
+    payload.update(runtime="sim", algorithm=args.algorithm,
+                   workload=wl.name, groups=args.groups,
+                   steps=args.steps, replicas=args.replicas,
+                   invariant_violations=int(res.violations))
+    lat = res.latency_summary()
+    if lat is not None:
+        payload["commit_latency"] = {k: lat[k] for k in
+                                     ("n", "p50_rounds", "p99_rounds")}
+    payload["key_class_latency"] = {
+        c: {k: s[k] for k in ("n", "mean_rounds", "p50_rounds",
+                              "p99_rounds")}
+        for c, s in wlmod.class_split(res.state).items()}
+    print(json.dumps(payload))
+    return 0 if payload["invariant_violations"] == 0 else 1
+
+
 def cmd_metrics(args) -> int:
     """Pretty-print a metrics snapshot from either source: scrape a
     live host node's /metrics endpoint, or pull the snapshots embedded
@@ -901,6 +989,14 @@ def main(argv=None) -> int:
                     type=int, default=0, help="key-range offset")
     bh.add_argument("-client_tag", "--client-tag", dest="client_tag",
                     default="ol", help="client-id prefix")
+    bh.add_argument("-workload", "--workload", default="",
+                    help="drive the ramp with a named paxi_tpu/workload "
+                         "spec (zipf99, flash, hotrange, ...) instead of "
+                         "uniform keys")
+    bh.add_argument("-wl_stream", "--wl-stream", dest="wl_stream",
+                    type=int, default=0,
+                    help="workload sampler stream id (parallel workers "
+                         "get distinct streams automatically)")
     bh.add_argument("-shards", "--shards", type=int, default=0,
                     help="sharded mode: run G consensus groups of "
                          "shard_fleet/G replicas behind the shard "
@@ -1057,6 +1153,31 @@ def main(argv=None) -> int:
     scr.add_argument("-p_drop", type=float, default=0.0)
     scr.add_argument("-max_delay", type=int, default=1)
     sc.set_defaults(fn=cmd_scenario)
+
+    wp = sub.add_parser("workload",
+                        help="production workload engine: key skew, "
+                             "read mixes, flash crowds "
+                             "(paxi_tpu/workload)")
+    wpsub = wp.add_subparsers(dest="workload_cmd", required=True)
+    wpl = wpsub.add_parser("list", help="print the named-spec catalog")
+    wpl.add_argument("-keys", type=int, default=64,
+                     help="key-space size the descriptions assume")
+    wpr = wpsub.add_parser("run",
+                           help="run one named spec on the sim runtime")
+    wpr.add_argument("-workload", "--workload", default="zipf99",
+                     help="a name from `workload list`")
+    wpr.add_argument("-algorithm", "--algorithm", default="paxos")
+    wpr.add_argument("-groups", type=int, default=16)
+    wpr.add_argument("-steps", type=int, default=120)
+    wpr.add_argument("-replicas", type=int, default=3)
+    wpr.add_argument("-zones", type=int, default=1)
+    wpr.add_argument("-slots", type=int, default=16)
+    wpr.add_argument("-keys", type=int, default=64)
+    wpr.add_argument("-objects", type=int, default=8)
+    wpr.add_argument("-seed", type=int, default=0)
+    wpr.add_argument("-p_drop", type=float, default=0.0)
+    wpr.add_argument("-max_delay", type=int, default=1)
+    wp.set_defaults(fn=cmd_workload)
 
     li = sub.add_parser(
         "lint", help="protocol-aware static analysis (paxi-lint)")

@@ -34,6 +34,8 @@ from paxi_tpu.host.client import Client, _Conn
 from paxi_tpu.host.history import History
 from paxi_tpu.metrics import Histogram, Registry
 from paxi_tpu.utils import log
+from paxi_tpu.workload import compile as wlc
+from paxi_tpu.workload.spec import Workload  # noqa: F401 (typing/docs)
 
 
 class KeyGen:
@@ -138,7 +140,7 @@ class Benchmark:
     """Closed-loop load against a cluster via the REST client."""
 
     def __init__(self, cfg: Config, b: Optional[Bconfig] = None,
-                 seed: int = 0):
+                 seed: int = 0, workload=None):
         self.cfg = cfg
         self.b = b or cfg.benchmark
         self.seed = seed
@@ -146,6 +148,11 @@ class Benchmark:
         # per-run registry: per-stream latency series + client op/retry
         # counters; bench_host.py embeds its snapshot in the artifact
         self.metrics = Registry(source="bench")
+        # declarative workload spec (paxi_tpu/workload): replaces the
+        # KeyGen/W draws with the spec's counter-based host sampler, so
+        # the SAME spec drives this generator and the sim kernels
+        self.workload = (workload.validate(self.b.K)
+                         if workload is not None else None)
 
     async def run(self) -> Stats:
         b = self.b
@@ -160,6 +167,15 @@ class Benchmark:
             nonlocal left
             gen = KeyGen(b, self.seed, si)
             rng = random.Random(self.seed * 77 + si)
+            # workload spec mode: the spec's deterministic per-stream
+            # sampler supplies (key, write, class); per-class latency
+            # series land beside the per-stream one in the registry
+            sampler = (wlc.host_sampler(self.workload, b.K, stream=si)
+                       if self.workload is not None else None)
+            class_hists = {
+                c: self.metrics.histogram("paxi_op_seconds",
+                                          stream=str(si), key_class=c)
+                for c in wlc.CLASSES} if sampler is not None else None
             client = Client(self.cfg,
                             id=self.cfg.ids[si % len(self.cfg.ids)],
                             client_id=f"bench-{si}",
@@ -179,8 +195,13 @@ class Benchmark:
                         if left <= 0:
                             break
                         left -= 1
-                    key = gen.next()
-                    write = rng.random() < b.W
+                    if sampler is None:
+                        key = gen.next()
+                        write = rng.random() < b.W
+                        kcls = None
+                    else:
+                        k0, write, kcls = sampler(n_local)
+                        key = b.min + k0
                     n_local += 1
                     value = f"{si}:{n_local}".encode() if write else b""
                     s = time.time()
@@ -197,6 +218,8 @@ class Benchmark:
                             stats.warmup_ops += 1
                         else:
                             hist.observe(e - s)
+                            if kcls is not None:
+                                class_hists[kcls].observe(e - s)
                             stats.ops += 1
                         if b.linearizability_check:
                             self.history.add(
@@ -251,7 +274,8 @@ class OpenLoopBenchmark:
                  drain_s: float = 5.0,
                  linearizability_check: bool = True,
                  key_base: int = 0, client_tag: str = "ol",
-                 ops_per_req: int = 1, key_map=None):
+                 ops_per_req: int = 1, key_map=None,
+                 workload=None, wl_stream: int = 0):
         self.cfg = cfg
         self.rates = list(rates)
         self.step_s = step_s
@@ -284,6 +308,18 @@ class OpenLoopBenchmark:
         self.target = ids[0] if target is None else target
         self.history = History()
         self.metrics = Registry(source="bench_open_loop")
+        # declarative workload spec (paxi_tpu/workload): key/write/class
+        # come from the spec's counter-based sampler (stream
+        # ``wl_stream`` — parallel workers pass distinct streams so
+        # their draws are independent but each is deterministic), the
+        # ramp's offered rates gain the spec's flash-crowd multipliers
+        # (host_rates), and surge steps re-aim FlashCrowd.focus of the
+        # draws at the hot set.  Composes with key_map/key_base the
+        # same way the uniform draw does.
+        self.workload = (workload.validate(self.K)
+                         if workload is not None else None)
+        self.wl_stream = int(wl_stream)
+        self._wl_n = 0          # op counter across the whole ramp
 
     async def run(self) -> Dict:
         url = self.cfg.http_addrs[self.target]
@@ -294,10 +330,19 @@ class OpenLoopBenchmark:
         inflight = [0]
         cmd_ids = [0] * self.n_conns
         steps: List[Dict] = []
+        # flash-crowd lowering for the open loop: surge ramp steps
+        # offer mult*rate (the arrival-surge half) and focus-bias the
+        # key draws (the hot-spot half); flat tuples for flashless specs
+        wl = self.workload
+        eff_rates = (wlc.host_rates(wl, self.rates) if wl is not None
+                     else list(self.rates))
+        surges = (wlc.surge_steps(wl, len(self.rates)) if wl is not None
+                  else [False] * len(self.rates))
         try:
-            for rate in self.rates:
+            for si, rate in enumerate(eff_rates):
                 steps.append(await self._one_rate(
-                    rate, conns, rng, inflight, cmd_ids))
+                    rate, conns, rng, inflight, cmd_ids,
+                    surge=surges[si], ramp_i=si))
         finally:
             for c in conns:
                 c.close()
@@ -320,6 +365,9 @@ class OpenLoopBenchmark:
             "total_shed": sum(s["shed"] for s in steps),
             "anomalies": anomalies,
             "history_ops": len(self.history),
+            **({"workload": wl.name,
+                "surge_steps": [i for i, s in enumerate(surges) if s]}
+               if wl is not None else {}),
             # per-rate latency histograms (mergeable across parallel
             # generator workers — shared bucket layout)
             "metrics": self.metrics.snapshot(),
@@ -339,11 +387,26 @@ class OpenLoopBenchmark:
                 pass
 
     async def _one_rate(self, rate: float, conns, rng, inflight,
-                        cmd_ids) -> Dict:
+                        cmd_ids, surge: bool = False,
+                        ramp_i: int = 0) -> Dict:
         hist = self.metrics.histogram("paxi_op_seconds", rate=str(rate))
         stat = {"offered_ops_s": rate, "duration_s": self.step_s,
                 "submitted": 0, "completed": 0, "errors": 0, "shed": 0,
                 "unfinished": 0}
+        if surge:
+            stat["surge"] = True
+        # workload spec mode (see __init__): deterministic sampler per
+        # (spec, stream, op index), per-class latency series beside the
+        # per-rate one, migration epoch = ramp position
+        wl = self.workload
+        sampler = (wlc.host_sampler(wl, self.K, stream=self.wl_stream)
+                   if wl is not None else None)
+        class_hists = {
+            c: self.metrics.histogram("paxi_op_seconds", rate=str(rate),
+                                      key_class=c)
+            for c in wlc.CLASSES} if sampler is not None else None
+        wl_epoch = ramp_i if (wl is not None and wl.migrate_every > 0) \
+            else 0
         step_open = [0]     # this step's in-flight ops
         closed = [False]    # set when the step's books close: later
         # completions still balance the in-flight counters and feed the
@@ -375,6 +438,21 @@ class OpenLoopBenchmark:
                     b"Command-Id: %d\r\n\r\n%s")
         json_loads = __import__("json").loads
 
+        # one draw = (wire key, write?, key class | None); the workload
+        # path threads the spec sampler through the same key_map /
+        # key_base shaping as the uniform path
+        if sampler is None:
+            def draw():
+                j = randrange(K)
+                return ((key_map(j) if key_map is not None
+                         else key_base + j), random_() < W, None)
+        else:
+            def draw(_s=surge, _ep=wl_epoch):
+                self._wl_n += 1
+                j, w, c = sampler(self._wl_n, surge=_s, epoch=_ep)
+                return ((key_map(j) if key_map is not None
+                         else key_base + j), w, c)
+
         def issue_batched(sched_t: float) -> None:
             """One arrival = one request of B independent commands on
             the Transaction surface (client-side batching)."""
@@ -389,15 +467,14 @@ class OpenLoopBenchmark:
             parts = []
             ops_meta = []
             for j in range(B):
-                key = (key_map(randrange(K)) if key_map is not None
-                       else key_base + randrange(K))
-                if random_() < W:
+                key, is_w, kcls = draw()
+                if is_w:
                     v = "%d:%d:%d" % (ci, wid, j)
                     parts.append('{"key":%d,"value":"%s"}' % (key, v))
-                    ops_meta.append((key, v.encode()))
+                    ops_meta.append((key, v.encode(), kcls))
                 else:
                     parts.append('{"key":%d}' % key)
-                    ops_meta.append((key, None))
+                    ops_meta.append((key, None, kcls))
             body = ("[" + ",".join(parts) + "]").encode()
             inflight[0] += B
             step_open[0] += 1
@@ -412,16 +489,19 @@ class OpenLoopBenchmark:
                     if not closed[0]:
                         stat["errors"] += B
                     if lin:
-                        for k, v in _ops:
+                        for k, v, _c in _ops:
                             if v is not None:
                                 history_add(k, v, None, _sw, math.inf)
                     return
                 if not closed[0]:
                     stat["completed"] += B
                     observe(now - _sched)   # request latency, B cmds
+                    if class_hists is not None:
+                        for _k, _v, _c in _ops:
+                            class_hists[_c].observe(now - _sched)
                 if lin:
                     vals = json_loads(payload)["values"]
-                    for j, (k, v) in enumerate(_ops):
+                    for j, (k, v, _c) in enumerate(_ops):
                         if v is None:
                             history_add(k, None,
                                         vals[j].encode("latin1"),
@@ -440,9 +520,7 @@ class OpenLoopBenchmark:
             conn = conns[ci]
             cmd_ids[ci] += 1
             cmd_id = cmd_ids[ci]
-            key = (key_map(randrange(K)) if key_map is not None
-                   else key_base + randrange(K))
-            write = random_() < W
+            key, write, kcls = draw()
             # unique value per write: read-from edges in the checker
             # are unambiguous, and the per-conn (client, command_id)
             # stream is monotonic for the server's at-most-once table
@@ -458,7 +536,7 @@ class OpenLoopBenchmark:
 
             def done(status, _hdr, payload, exc, _k=key,
                      _v=value if write else None, _sched=sched_t,
-                     _sw=submit_wall):
+                     _sw=submit_wall, _c=kcls):
                 inflight[0] -= 1
                 step_open[0] -= 1
                 now = wall()
@@ -473,6 +551,8 @@ class OpenLoopBenchmark:
                 if not closed[0]:
                     stat["completed"] += 1
                     observe(now - _sched)   # includes queueing delay
+                    if _c is not None:
+                        class_hists[_c].observe(now - _sched)
                 if lin:
                     history_add(_k, _v, payload if _v is None else None,
                                 _sw, now)
@@ -536,4 +616,12 @@ class OpenLoopBenchmark:
             "p99": round(hist.percentile(99) * 1e3, 3),
             "max": round(hist.max * 1e3, 3),
         }
+        if class_hists is not None:
+            # per-key-class tail split (the host face of the sim's
+            # m_wl_hist_* planes; full series stay in the registry)
+            stat["key_class_latency"] = {
+                c: {"n": h.count,
+                    "p50_ms": round(h.percentile(50) * 1e3, 3),
+                    "p99_ms": round(h.percentile(99) * 1e3, 3)}
+                for c, h in class_hists.items()}
         return stat

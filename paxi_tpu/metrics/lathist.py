@@ -128,6 +128,15 @@ def bin_steps(dts) -> np.ndarray:
     return out
 
 
+def plane_total(plane) -> np.ndarray:
+    """Sum a histogram plane (group-major final state: bucket axis
+    LAST; or a single group's (N_BUCKETS,)) down to one bucket vector
+    — the reduction behind ``total_hist`` and the per-key-class
+    ``m_wl_hist_*`` planes (workload/compile.class_split)."""
+    h = np.asarray(plane).astype(np.int64)
+    return h.reshape(-1, N_BUCKETS).sum(axis=0).astype(np.int32)
+
+
 def total_hist(state) -> Optional[np.ndarray]:
     """Whole-state commit-latency bucket vector: the accumulated
     ``m_lat_hist`` plane (group axis summed out) plus any samples
@@ -136,8 +145,7 @@ def total_hist(state) -> Optional[np.ndarray]:
     single traced group's state; None when uninstrumented."""
     if not (isinstance(state, dict) and "m_lat_hist" in state):
         return None
-    h = np.asarray(state["m_lat_hist"]).astype(np.int64)
-    h = h.reshape(-1, N_BUCKETS).sum(axis=0).astype(np.int32)
+    h = plane_total(state["m_lat_hist"])
     if "m_commit_dt" in state:
         h = h + bin_steps(state["m_commit_dt"])
     return h

@@ -132,6 +132,15 @@ def make_sharded_run(proto: SimProtocol, cfg: SimConfig,
                 out_specs=(P(axis), P(), P()), **rep_kw)
             def sharded(rngs):
                 carry = init_carry(proto, cfg, fuzz, g_local, rngs[0])
+                if isinstance(carry[0], dict) and "wl_gid" in carry[0]:
+                    # workload draws key on GLOBAL group ids: offset
+                    # this shard's local arange by its group base so
+                    # every shard derives exactly its slice of the
+                    # single-device command planes (before the state0
+                    # capture, so pad neutralization preserves it)
+                    d0 = jax.lax.axis_index(axis)
+                    carry[0]["wl_gid"] = (carry[0]["wl_gid"]
+                                          + d0 * g_local)
                 state0 = carry[0]
                 carry = jax.tree.map(lambda x: _vary(x, axis), carry)
                 carry, (viols, counts) = jax.lax.scan(body, carry,

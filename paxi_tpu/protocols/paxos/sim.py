@@ -58,6 +58,8 @@ from paxi_tpu.sim import inscan
 from paxi_tpu.sim.cell_ring import NO_CMD, NOOP
 from paxi_tpu.sim.ring import require_packable
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+from paxi_tpu.workload import compile as wlc
+from paxi_tpu.workload.spec import CLASSES
 
 # the ballot-ring planes cell_ring.py owns; this kernel adds kv
 BR_KEYS = br.KEYS
@@ -91,7 +93,7 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
     # mod 32 in XLA, so replica 32 would silently alias replica 0
     require_packable(R)
     i32 = jnp.int32
-    return dict(
+    st = dict(
         ballot=jnp.zeros((R, G), i32),        # highest ballot seen/promised
         active=jnp.zeros((R, G), bool),       # leader with phase-1 done
         p1_acks=jnp.zeros((R, G), i32),       # [ldr] phase-1 ack bitmask
@@ -130,6 +132,21 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
         m_lat_sum=jnp.zeros((G,), i32),
         m_inscan_viol=jnp.zeros((G,), i32),
     )
+    if cfg.workload is not None:
+        # GLOBAL group ids: the workload's counter-based draws key on
+        # (group, absolute slot), so a sharded mesh can re-derive its
+        # slice exactly — parallel/mesh.py offsets this plane by the
+        # shard's group base after the in-shard init.  NOT m_-prefixed
+        # (it feeds the command key derivation, deliberately).
+        st["wl_gid"] = jnp.arange(G, dtype=i32)
+        # per-key-class commit-latency planes (hot/warm/cold): binned
+        # directly at commit (no pending/deferred flush — the runner's
+        # flush path only knows m_commit_dt/m_lat_hist, and workload
+        # runs are bench-scale)
+        for nm in CLASSES:
+            st[f"m_wl_hist_{nm}"] = lathist.empty_hist(G)
+            st[f"m_wl_sum_{nm}"] = jnp.zeros((G,), i32)
+    return st
 
 
 def step(state, inbox, ctx: StepCtx):
@@ -171,6 +188,24 @@ def step(state, inbox, ctx: StepCtx):
     m_commit_dt = jnp.where(newly, dt, state["m_commit_dt"])
     m_lat_sum = m_lat_sum + jnp.sum(jnp.where(newly, dt, 0),
                                     axis=(0, 1), dtype=jnp.int32)
+    # per-key-class latency (workload runs): the committed cell's key
+    # class derives from (group, absolute slot) — the same counter
+    # draw the executor uses for the key id — so commits bin into the
+    # hot/warm/cold histograms without carrying anything extra
+    wl = cfg.workload
+    wl_planes = {}
+    if wl is not None:
+        gid = state["wl_gid"]                           # (G,) global ids
+        clsP = wlc.class_plane(wl, K, gid[None, None, :],
+                               cell.cell_abs(st["base"], S))
+        for ci, nm in enumerate(CLASSES):
+            mask = newly & (clsP == ci)
+            wl_planes[f"m_wl_hist_{nm}"] = lathist.hist_update(
+                state[f"m_wl_hist_{nm}"], dt, mask)
+            wl_planes[f"m_wl_sum_{nm}"] = state[f"m_wl_sum_{nm}"] \
+                + jnp.sum(jnp.where(mask, dt, 0), axis=(0, 1),
+                          dtype=jnp.int32)
+        wl_planes["wl_gid"] = gid
     b0 = st["base"]
     st, ex, c_has, c_bal = br.apply_p3(st, inbox["p3"], {"kv": kv})
     kv = ex["kv"]
@@ -183,6 +218,14 @@ def step(state, inbox, ctx: StepCtx):
     is_leader = st["active"] & br.own_bal_mask(st, STRIDE)
     has_re, can_new, prop_cell, prop_slot, oh_p, re_cmd = \
         br.repropose_target(st)
+    if wl is not None:
+        # flash-crowd lowering for the closed proposer loop: NEW
+        # commands run the spec's demand gate (1/mult duty cycle
+        # outside surge windows); re-proposals always proceed —
+        # gating recovery would be a liveness bug, not a workload
+        gate = wlc.demand_gate(wl, state["wl_gid"][None, :], ctx.t)
+        if gate is not None:
+            can_new = can_new & gate
     is_new = ~has_re & can_new
     prop_cmd = jnp.where(is_new, encode_cmd(st["ballot"], prop_slot),
                          re_cmd)
@@ -207,8 +250,18 @@ def step(state, inbox, ctx: StepCtx):
         com = jnp.any(oh_e & st["log_commit"], axis=1)
         running = running & com
         cmd_e = jnp.sum(jnp.where(oh_e, st["log_cmd"], 0), axis=1)
-        key_e = cmd_key(cmd_e, K)
-        wr = running & (cmd_e >= 0)
+        if wl is None:
+            key_e = cmd_key(cmd_e, K)
+            wr = running & (cmd_e >= 0)
+        else:
+            # workload command plane: key id + read flag derive from
+            # (global group id, absolute slot) — identical at every
+            # replica, every layout, every shard; reads execute (they
+            # advance the frontier) but never write the KV
+            gidb = state["wl_gid"][None, :]              # (1, G)
+            key_e = wlc.key_plane(wl, K, gidb, abs_e)
+            wr = running & (cmd_e >= 0) \
+                & ~wlc.read_plane(wl, gidb, abs_e)
         ohk = wr[:, None, :] & (kidx[None, :, None] == key_e[:, None, :])
         kv = jnp.where(ohk, cmd_e[:, None, :], kv)
         advanced = advanced + running
@@ -234,7 +287,8 @@ def step(state, inbox, ctx: StepCtx):
 
     new_state = dict(st, kv=kv, m_prop_t=m_prop_t,
                      m_commit_dt=m_commit_dt, m_lat_hist=m_lat_hist,
-                     m_lat_sum=m_lat_sum, m_inscan_viol=m_inscan_viol)
+                     m_lat_sum=m_lat_sum, m_inscan_viol=m_inscan_viol,
+                     **wl_planes)
     outbox = {"p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
               "p2b": out_p2b, "p3": out_p3}
     return new_state, outbox
@@ -258,6 +312,10 @@ def metrics(state, cfg: SimConfig):
                          + jnp.sum((state["m_commit_dt"] > 0)
                                    .astype(jnp.int32))),
         "inscan_violations": jnp.sum(state["m_inscan_viol"]),
+        # per-key-class sample counts (workload runs; the full
+        # per-class histograms ride in state — workload.class_split)
+        **{f"wl_{nm}_n": jnp.sum(state[f"m_wl_hist_{nm}"])
+           for nm in CLASSES if f"m_wl_hist_{nm}" in state},
     }
 
 

@@ -73,6 +73,8 @@ from paxi_tpu.protocols.paxos.sim import (NO_CMD, NOOP, cmd_key,
 from paxi_tpu.sim import inscan
 from paxi_tpu.sim.ring import require_packable
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+from paxi_tpu.workload import compile as wlc
+from paxi_tpu.workload.spec import CLASSES
 
 
 def _cell_abs(base, S: int):
@@ -88,7 +90,7 @@ def init_state(cfg: SimConfig, rng: jax.Array):
     R, S, K = cfg.n_replicas, cfg.n_slots, cfg.n_keys
     del rng
     require_packable(R)   # ack bitmasks: int32 shifts wrap at 32
-    return dict(
+    st = dict(
         ballot=jnp.zeros((R,), jnp.int32),        # highest ballot seen/promised
         active=jnp.zeros((R,), bool),             # leader with phase-1 done
         p1_acks=jnp.zeros((R,), jnp.int32),       # [ldr] phase-1 ack bitmask
@@ -121,6 +123,20 @@ def init_state(cfg: SimConfig, rng: jax.Array):
         m_lat_sum=jnp.zeros((), jnp.int32),
         m_inscan_viol=jnp.zeros((), jnp.int32),
     )
+    if cfg.workload is not None:
+        # GLOBAL group id — a scalar here; the runner's per-group vmap
+        # branch patches the vmapped plane to arange(n_groups) so the
+        # workload's counter-based draws key on the same (group,
+        # absolute slot) pairs as the lane-major lowering (bit-for-bit
+        # parity).  NOT m_-prefixed (feeds key derivation).
+        st["wl_gid"] = jnp.zeros((), jnp.int32)
+        # per-key-class commit-latency planes (hot/warm/cold), binned
+        # directly at commit — mirrors the lane-major kernel; the vmap
+        # gives them their group axis
+        for nm in CLASSES:
+            st[f"m_wl_hist_{nm}"] = lathist.empty_hist()
+            st[f"m_wl_sum_{nm}"] = jnp.zeros((), jnp.int32)
+    return st
 
 
 def step(state, inbox, ctx: StepCtx):
@@ -289,6 +305,21 @@ def step(state, inbox, ctx: StepCtx):
     m_commit_dt = jnp.where(newly, lat_dt, state["m_commit_dt"])
     m_lat_sum = m_lat_sum + jnp.sum(jnp.where(newly, lat_dt, 0),
                                     dtype=jnp.int32)
+    # per-key-class latency (workload runs): the committed cell's key
+    # class derives from (group, absolute slot) — same counter draw as
+    # the executor's key id — mirroring the lane-major kernel
+    wl = cfg.workload
+    wl_planes = {}
+    if wl is not None:
+        gid = state["wl_gid"]                             # scalar group id
+        clsP = wlc.class_plane(wl, K, gid, A)             # (R, S)
+        for ci, nm in enumerate(CLASSES):
+            cm = newly & (clsP == ci)
+            wl_planes[f"m_wl_hist_{nm}"] = lathist.hist_update(
+                state[f"m_wl_hist_{nm}"], lat_dt, cm)
+            wl_planes[f"m_wl_sum_{nm}"] = state[f"m_wl_sum_{nm}"] \
+                + jnp.sum(jnp.where(cm, lat_dt, 0), dtype=jnp.int32)
+        wl_planes["wl_gid"] = gid
 
     # ---------------- P3: commit notifications --------------------------
     # Zombie fences (see sim/ballot_ring.py apply_p3): a higher-ballot
@@ -352,6 +383,12 @@ def step(state, inbox, ctx: StepCtx):
     re_abs = jnp.min(jnp.where(mask_re, A, BIG), axis=1)
     has_re = jnp.any(mask_re, axis=1)
     can_new = (next_slot - base) < S                      # window flow control
+    if wl is not None:
+        # flash-crowd demand gate on NEW commands only; re-proposals
+        # always proceed (gating recovery would be a liveness bug)
+        gate = wlc.demand_gate(wl, state["wl_gid"], ctx.t)
+        if gate is not None:
+            can_new = can_new & gate
     prop_slot = jnp.where(has_re, re_abs, next_slot)      # absolute
     prop_cell = jnp.remainder(prop_slot, S)
     is_new = ~has_re & can_new
@@ -391,8 +428,18 @@ def step(state, inbox, ctx: StepCtx):
     kidx = jnp.arange(K, dtype=jnp.int32)
     for e in range(E):
         cmd_e = cmdE[:, e]
-        wr = running[:, e] & (cmd_e >= 0)
-        ohk = wr[:, None] & (kidx[None, :] == cmd_key(cmd_e, K)[:, None])
+        if wl is None:
+            key_e = cmd_key(cmd_e, K)
+            wr = running[:, e] & (cmd_e >= 0)
+        else:
+            # workload command plane: key id + read flag derive from
+            # (global group id, absolute slot) — identical at every
+            # replica and every layout; reads advance the frontier
+            # but never write the KV
+            key_e = wlc.key_plane(wl, K, state["wl_gid"], absE[:, e])
+            wr = running[:, e] & (cmd_e >= 0) \
+                & ~wlc.read_plane(wl, state["wl_gid"], absE[:, e])
+        ohk = wr[:, None] & (kidx[None, :] == key_e[:, None])
         kv = jnp.where(ohk, cmd_e[:, None], kv)
     new_execute = execute + advanced
 
@@ -474,6 +521,7 @@ def step(state, inbox, ctx: StepCtx):
         m_prop_t=m_prop_t, m_commit_dt=m_commit_dt,
         m_lat_hist=m_lat_hist, m_lat_sum=m_lat_sum,
         m_inscan_viol=m_inscan_viol,
+        **wl_planes,
     )
     outbox = {"p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
               "p2b": out_p2b, "p3": out_p3}
@@ -496,6 +544,10 @@ def metrics(state, cfg: SimConfig):
                          + jnp.sum((state["m_commit_dt"] > 0)
                                    .astype(jnp.int32))),
         "inscan_violations": state["m_inscan_viol"],
+        # per-key-class sample counts (workload runs; the full
+        # per-class histograms ride in state — workload.class_split)
+        **{f"wl_{nm}_n": jnp.sum(state[f"m_wl_hist_{nm}"])
+           for nm in CLASSES if f"m_wl_hist_{nm}" in state},
     }
 
 

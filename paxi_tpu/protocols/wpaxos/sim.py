@@ -58,6 +58,8 @@ from paxi_tpu.metrics import lathist
 from paxi_tpu.sim import cell, inscan
 from paxi_tpu.sim.ring import dst_major, require_packable
 from paxi_tpu.sim.types import SimConfig, SimProtocol, StepCtx
+from paxi_tpu.workload import compile as wlc
+from paxi_tpu.workload.spec import CLASSES
 
 NO_CMD = -1
 NOOP = -2
@@ -100,7 +102,7 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
     ridx = jnp.arange(R, dtype=i32)
     oidx = jnp.arange(O, dtype=i32)
     owner0 = oidx % R                      # initial round-robin ownership
-    return dict(
+    st = dict(
         # per-object ballots: round 1, owner0 (everyone agrees at init)
         ballot=jnp.broadcast_to(
             (cfg.ballot_stride + owner0)[None, :, None], (R, O, G)
@@ -140,6 +142,16 @@ def init_state(cfg: SimConfig, rng: jax.Array, n_groups: int):
         m_lat_sum=jnp.zeros((G,), i32),
         m_inscan_viol=jnp.zeros((G,), i32),
     )
+    if cfg.workload is not None:
+        # GLOBAL group ids for the workload's counter-based demand
+        # draws (parallel/mesh.py offsets them per shard); per-class
+        # latency planes labeled by the demanded OBJECT's resident key
+        # class (workload/compile.obj_class_table)
+        st["wl_gid"] = jnp.arange(G, dtype=i32)
+        for nm in CLASSES:
+            st[f"m_wl_hist_{nm}"] = lathist.empty_hist(G)
+            st[f"m_wl_sum_{nm}"] = jnp.zeros((G,), i32)
+    return st
 
 
 def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
@@ -431,6 +443,23 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
     m_lat_hist = lathist.hist_update(state["m_lat_hist"], dt, newly)
     m_lat_sum = state["m_lat_sum"] + jnp.sum(
         jnp.where(newly, dt, 0), axis=(0, 1, 2), dtype=jnp.int32)
+    # per-key-class latency (workload runs): a commit's class is its
+    # OBJECT's label — demand maps key -> object by key % O, so the
+    # object's epoch-0 resident rank classes it (a static table, no
+    # extra planes on the wire)
+    wl = cfg.workload
+    wl_planes = {}
+    if wl is not None:
+        clsO = jnp.asarray(wlc.obj_class_table(wl, cfg.n_keys, O),
+                           jnp.int32)[None, :, None, None]
+        for ci, nm in enumerate(CLASSES):
+            cm = newly & (clsO == ci)
+            wl_planes[f"m_wl_hist_{nm}"] = lathist.hist_update(
+                state[f"m_wl_hist_{nm}"], dt, cm)
+            wl_planes[f"m_wl_sum_{nm}"] = state[f"m_wl_sum_{nm}"] \
+                + jnp.sum(jnp.where(cm, dt, 0), axis=(0, 1, 2),
+                          dtype=jnp.int32)
+        wl_planes["wl_gid"] = state["wl_gid"]
 
     # ---------------- P3: commit notifications --------------------------
     # Zombie fences (see sim/ballot_ring.py apply_p3): a higher-ballot
@@ -508,12 +537,23 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
     # locality-skewed demand: each replica mostly touches its own block
     # of "home" objects (modeling paxi's zone-routed clients; when O < R
     # several replicas share a home object, giving steady contention)
-    k_d, k_loc, k_jit = jr.split(ctx.rng, 3)
-    blk = max(O // R, 1)
-    home = (ridx[:, None] * blk + jr.randint(k_d, (R, G), 0, blk)) % O
-    anywhere = jr.randint(jr.fold_in(k_d, 1), (R, G), 0, O)
-    local = jr.bernoulli(k_loc, cfg.locality, (R, G))
-    d = jnp.where(local, home, anywhere).astype(jnp.int32)
+    k_d, k_loc, k_jit = jr.split(ctx.rng, 3)   # k_jit: steal backoff below
+    if wl is None:
+        blk = max(O // R, 1)
+        home = (ridx[:, None] * blk + jr.randint(k_d, (R, G), 0, blk)) % O
+        anywhere = jr.randint(jr.fold_in(k_d, 1), (R, G), 0, O)
+        local = jr.bernoulli(k_loc, cfg.locality, (R, G))
+        d = jnp.where(local, home, anywhere).astype(jnp.int32)
+    else:
+        # workload-driven demand: each replica demands the object of a
+        # spec-drawn key (key % O), on its own counter channel — a
+        # Zipf spec concentrates every zone's demand on the same hot
+        # objects (the steal pressure the uniform control lacks).
+        # The jr.split above stays so the k_jit chain (and pinned
+        # replay of it) is identical with and without a workload.
+        key_d = wlc.key_plane(wl, cfg.n_keys, state["wl_gid"][None, :],
+                              ctx.t, chan=wlc.CH_DEMAND + ridx[:, None])
+        d = jnp.remainder(key_d, O).astype(jnp.int32)
 
     # ---------------- owner proposes for the demanded object ------------
     d_oh = oidx[None, :, None] == d[:, None, :]        # (R, O, G)
@@ -529,6 +569,12 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
     re_abs = jnp.min(jnp.where(mask_re, A_d, BIG), axis=1)
     has_re = jnp.any(mask_re, axis=1)
     can_new = d_next - d_base < S                      # window flow control
+    if wl is not None:
+        # flash-crowd demand gate on NEW proposals only (re-proposals
+        # are recovery, never gated — see paxos kernels)
+        gate = wlc.demand_gate(wl, state["wl_gid"][None, :], ctx.t)
+        if gate is not None:
+            can_new = can_new & gate
     prop_slot = jnp.where(has_re, re_abs, d_next)      # absolute
     new_cmd = encode_cmd(d_bal, prop_slot)
     oh_pr = sidx[None, :, None] \
@@ -656,6 +702,7 @@ def step(state, inbox, ctx: StepCtx, q1_full: bool = True):
         m_lat_local_n=m_lat_local_n, m_lat_cross_sum=m_lat_cross_sum,
         m_lat_cross_n=m_lat_cross_n, m_lat_hist=m_lat_hist,
         m_lat_sum=m_lat_sum, m_inscan_viol=m_inscan_viol,
+        **wl_planes,
     )
     outbox = {"p1a": out_p1a, "p1b": out_p1b, "p2a": out_p2a,
               "p2b": out_p2b, "p3": out_p3}
@@ -676,6 +723,10 @@ def metrics(state, cfg: SimConfig):
         "commit_lat_sum": jnp.sum(state["m_lat_sum"]),
         "commit_lat_n": jnp.sum(state["m_lat_hist"]),
         "inscan_violations": jnp.sum(state["m_inscan_viol"]),
+        # per-key-class sample counts (workload runs; full histograms
+        # ride in state — workload.class_split)
+        **{f"wl_{nm}_n": jnp.sum(state[f"m_wl_hist_{nm}"])
+           for nm in CLASSES if f"m_wl_hist_{nm}" in state},
     }
 
 

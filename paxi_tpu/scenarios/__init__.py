@@ -10,6 +10,13 @@ replay/shrink and the hunt engine work unchanged) and the
 virtual-clock fabric consumes the same spec as per-edge standing
 delays + per-step crash sets + switch down/session planes
 (compile.py).  See README "Scenarios" and "In-network consensus".
+
+The *traffic* sibling of this package is ``paxi_tpu/workload/``: a
+``Workload`` declares what the offered commands look like (key
+popularity, read mix, flash crowds, hot-key migration) the same way a
+``Scenario`` declares the environment they run in; the two specs
+compose — both ride the SimConfig/FuzzConfig statics and lower onto
+both runtimes.  See README "Workloads".
 """
 
 from paxi_tpu.scenarios.spec import (LeaderChurn, Reconfig, Scenario,

@@ -135,9 +135,19 @@ async def shard_ramp(algorithm: str = "paxos", shards: int = 2,
                      seed: int = 0, base_port: int = 18300,
                      txns: int = 8, lin: bool = True,
                      proc: bool = False, conns: int = 2,
-                     drain_s: float = 4.0) -> Dict:
+                     drain_s: float = 4.0,
+                     workload: str = "") -> Dict:
     """One G-point of the curve: ramp both phases, fire the 2PC burst,
-    return the artifact row."""
+    return the artifact row.
+
+    ``workload``: name of a paxi_tpu/workload spec (e.g. hotrange,
+    zipf99).  Adds a third "hot" phase where every worker draws keys
+    from the spec's sampler and a LINEAR key map stretches [0, K) over
+    the whole keyspace — the spec's hot ranks land inside group 0's
+    range while the tail spreads across all groups, so skew shows up
+    directly as per-group load imbalance in the router's
+    ``paxi_router_group_commands_total`` counters (reported under
+    ``router.group_commands`` with the hot group's share)."""
     G = shards
     if fleet % G:
         raise ValueError(f"fleet {fleet} not divisible into {G} groups")
@@ -185,12 +195,38 @@ async def shard_ramp(algorithm: str = "paxos", shards: int = 2,
                                        for s in steps)}]
 
         phases = await phase("disjoint") + await phase("crossing")
+        group_fwd_base: Dict[str, int] = {}
+        if workload:
+            # snapshot per-group counters BEFORE the hot phase so its
+            # row reports only hot-phase routing, not the ramp's
+            group_fwd_base = _group_counters(
+                await sc.router.metrics_snapshot())
+            phases += await _hot_phase(
+                workload, rcfg, sc.map, rates, workers, step_s, seed,
+                conns, W, K, drain_s)
         # G == 1 exercises the single-group packed-transaction path
         # (same surface, single-log atomicity); G > 1 runs real 2PC
         txn_report = await _txn_shots(sc.router_url, sc.map, G, txns) \
             if txns > 0 else None
         router_metrics = await sc.router.metrics_snapshot()
         peak = max(p["peak_ops_s"] for p in phases)
+        router_report = {
+            "forwards": _counter(router_metrics,
+                                 "paxi_router_forwards_total"),
+            "stale_reroutes": _counter(
+                router_metrics, "paxi_router_stale_reroutes_total"),
+            "map_swaps": _counter(router_metrics,
+                                  "paxi_router_map_swaps_total"),
+            "group_commands": _group_counters(router_metrics),
+        }
+        if workload:
+            total = _group_counters(router_metrics)
+            hot = {g: total.get(g, 0) - group_fwd_base.get(g, 0)
+                   for g in sorted(total)}
+            hot_sum = sum(hot.values())
+            router_report["hot_phase_group_commands"] = hot
+            router_report["hot_group_share"] = round(
+                max(hot.values()) / hot_sum, 3) if hot_sum else 0.0
         return {
             "mode": "shard-ramp",
             "algorithm": algorithm,
@@ -200,24 +236,84 @@ async def shard_ramp(algorithm: str = "paxos", shards: int = 2,
             "workers": workers,
             "W": W, "K": K,
             "cluster_proc": proc,
+            **({"workload": workload} if workload else {}),
             "phases": phases,
             "aggregate_peak_ops_s": peak,
             "anomalies": (sum(p["anomalies"] or 0 for p in phases)
                           if lin else None),
             "txn": txn_report,
-            "router": {
-                "forwards": _counter(router_metrics,
-                                     "paxi_router_forwards_total"),
-                "stale_reroutes": _counter(
-                    router_metrics, "paxi_router_stale_reroutes_total"),
-                "map_swaps": _counter(router_metrics,
-                                      "paxi_router_map_swaps_total"),
-            },
+            "router": router_report,
         }
     finally:
         await sc.stop()
 
 
+async def _hot_phase(wl_name: str, rcfg: Config, shard_map,
+                     rates: List[float], workers: int, step_s: float,
+                     seed: int, conns: int, W: float, K: int,
+                     drain_s: float) -> List[Dict]:
+    """Workload-driven phase: every worker samples the SAME named spec
+    (distinct counter streams) and a linear key map stretches the
+    spec's [0, K) key ids over the whole keyspace, concentrating the
+    hot ranks inside group 0's range."""
+    from paxi_tpu.workload import named_workload
+    wl = named_workload(wl_name)
+    stretch = max(shard_map.span // K, 1)
+    outs = await asyncio.gather(*[
+        OpenLoopBenchmark(
+            rcfg, rates=[r / workers for r in rates], step_s=step_s,
+            seed=seed + 307 * w, conns=conns, W=W, K=K,
+            client_tag=f"h{w}w",
+            # workers share the spec's key space (that is the point of
+            # a hot range), so per-worker per-key histories are partial
+            # and the per-worker linearizability verdict cannot compose
+            linearizability_check=False, drain_s=drain_s,
+            key_map=(lambda j, _s=stretch: j * _s),
+            workload=wl, wl_stream=w).run()
+        for w in range(workers)])
+    steps = []
+    for i, r in enumerate(rates):
+        row = {
+            "offered_ops_s": r,
+            "achieved_ops_s": round(sum(
+                o["steps"][i]["achieved_ops_s"] for o in outs), 1),
+            "completed": sum(o["steps"][i]["completed"] for o in outs),
+            "errors": sum(o["steps"][i]["errors"] for o in outs),
+            "shed": sum(o["steps"][i]["shed"] for o in outs),
+            "latency_p50_ms": round(max(
+                o["steps"][i]["latency_ms"]["p50"] for o in outs), 3),
+            "latency_p99_ms": round(max(
+                o["steps"][i]["latency_ms"]["p99"] for o in outs), 3),
+        }
+        cls = {}
+        for c in ("hot", "warm", "cold"):
+            rows = [o["steps"][i]["key_class_latency"][c]
+                    for o in outs
+                    if c in o["steps"][i].get("key_class_latency", {})]
+            if rows:
+                cls[c] = {
+                    "n": sum(x["n"] for x in rows),
+                    "p50_ms": round(max(x["p50_ms"] for x in rows), 3),
+                    "p99_ms": round(max(x["p99_ms"] for x in rows), 3),
+                }
+        if cls:
+            row["key_class_latency"] = cls
+        steps.append(row)
+    return [{"phase": "hot", "workload": wl.name, "steps": steps,
+             "anomalies": None,
+             "peak_ops_s": max(s["achieved_ops_s"] for s in steps)}]
+
+
 def _counter(snap: Dict, name: str) -> int:
     return sum(c["value"] for c in snap.get("counters", [])
                if c["name"] == name)
+
+
+def _group_counters(snap: Dict) -> Dict[str, int]:
+    """Per-group routed-command totals keyed by the ``group`` label."""
+    out: Dict[str, int] = {}
+    for c in snap.get("counters", []):
+        if c["name"] == "paxi_router_group_commands_total":
+            g = c.get("labels", {}).get("group", "?")
+            out[g] = out.get(g, 0) + c["value"]
+    return out

@@ -98,6 +98,15 @@ class ShardRouter:
             "paxi_router_stale_reroutes_total")
         self._map_swaps = self.metrics.counter(
             "paxi_router_map_swaps_total")
+        # per-group routed-command load: the skew evidence for
+        # workload-driven runs (a hot key range shows up as one group's
+        # counter racing ahead of the rest) — same registry path as
+        # every other series, so /metrics and shard/bench.py read it
+        # without a side channel
+        self._group_fwd = [
+            self.metrics.counter("paxi_router_group_commands_total",
+                                 group=str(g))
+            for g in range(len(group_urls))]
         self.coord = ShardCoordinator(self._tpc_submit, lease_s=lease_s,
                                       metrics=self.metrics)
 
@@ -135,6 +144,7 @@ class ShardRouter:
             g = m.group_of(key)
             self._pending[g].append(_RoutedOp(key, frame, slot,
                                               m.version))
+        self._group_fwd[g].inc()
         return slot
 
     async def flush(self) -> None:
@@ -160,7 +170,9 @@ class ShardRouter:
             batches[g] = keep
         for op in moved:
             self._stale_total.inc()
-            batches[m.group_of(op.key)].append(op)
+            g_new = m.group_of(op.key)
+            self._group_fwd[g_new].inc()   # load lands on the new owner
+            batches[g_new].append(op)
         await asyncio.gather(*[
             self._ship(g, ops) for g, ops in enumerate(batches) if ops])
 

@@ -108,6 +108,13 @@ def init_carry(proto: SimProtocol, cfg: SimConfig, fuzz: FuzzConfig,
         return (state, wheel, fs, k_run)
     state = jax.vmap(lambda k: proto.init_state(cfg, k))(
         jr.split(k_state, n_groups))
+    if isinstance(state, dict) and "wl_gid" in state:
+        # workload runs key their counter-based draws on the GLOBAL
+        # group id; per-group init_state emits a scalar placeholder
+        # (it cannot see its own batch index under vmap) — patch the
+        # vmapped plane to the real ids so the per-group lowering
+        # draws the exact command planes of the lane-major one
+        state["wl_gid"] = jnp.arange(n_groups, dtype=jnp.int32)
     wheel = jax.tree.map(
         lambda x: jnp.broadcast_to(x, (n_groups,) + x.shape),
         mb.empty_wheel(spec, cfg.n_replicas, fuzz))

@@ -75,6 +75,15 @@ class SimConfig:
     sw_down_start: int = -1    # first down window start (-1: never)
     sw_down_period: int = 0    # steps between window starts (0: one-shot)
     sw_down_for: int = 0       # steps each window lasts
+    # traffic workload (paxi_tpu/workload/spec.Workload; Any-typed to
+    # keep this module import-cycle-free, like FuzzConfig.scenario
+    # below).  When set, kernels that serve a command stream derive
+    # per-slot key ids / read flags / key classes from the spec's
+    # counter-based draws (workload/compile.py) instead of hashing the
+    # command word, and accumulate per-class latency histograms.
+    # Frozen + hashable, so it rides the jit static arg and the trace
+    # ``sim_cfg`` meta exactly like the geometry knobs.
+    workload: Any = None
 
     @property
     def majority(self) -> int:

@@ -1,0 +1,31 @@
+"""Production workload engine: key-popularity skew, read mixes and
+flash crowds as data.
+
+Declarative ``Workload`` specs (spec.py) compiled onto the two
+runtimes' command paths from stateless counter-based draws
+(compile.py): the sim kernels derive per-step key/read/class planes
+from (spec seed, global group id, absolute slot) hashes — identical
+across lane-major, per-group and sharded lowerings, bit-for-bit under
+pinned replay — and the host generators (``OpenLoopBenchmark``/
+``Benchmark``) derive the same spec's per-op keys, write flags and
+flash-crowd rate multipliers from the same hash family.  Key classes
+(hot/warm/cold) label per-class latency histograms on both sides.
+The environment sibling of ``paxi_tpu/scenarios``; see README
+"Workloads".
+"""
+
+from paxi_tpu.workload.spec import CLASSES, FlashCrowd, Workload
+from paxi_tpu.workload.compile import (FLASH, HOTRANGE, MIGRATE, NAMED,
+                                       UNIFORM, ZIPF99, apply_workload,
+                                       class_cuts, class_plane, class_split,
+                                       demand_gate, describe, flash_on,
+                                       host_rates, host_sampler, icdf_table,
+                                       key_plane, named_workload, rank_plane,
+                                       rank_pmf, read_plane, surge_steps)
+
+__all__ = ["Workload", "FlashCrowd", "CLASSES", "NAMED", "UNIFORM",
+           "ZIPF99", "FLASH", "HOTRANGE", "MIGRATE",
+           "named_workload", "describe", "apply_workload", "class_cuts",
+           "icdf_table", "rank_pmf", "key_plane", "rank_plane",
+           "read_plane", "class_plane", "flash_on", "demand_gate",
+           "class_split", "host_sampler", "host_rates", "surge_steps"]

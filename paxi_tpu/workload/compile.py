@@ -1,0 +1,421 @@
+"""Compile Workloads onto the two runtimes + the named-workload registry.
+
+Sim side: a workload rides INSIDE the SimConfig (``apply_workload``) —
+static, so the jit cache, ``continue_run``'s carry cache and a trace's
+``sim_cfg`` meta all pin it like the geometry.  Kernels derive each
+command's key id, read flag and key class from **counter-based
+draws**: a pure integer hash of (spec seed, GLOBAL group id, absolute
+slot/step, channel).  Nothing is drawn ahead of time and nothing is
+shaped over the whole batch, so
+
+- lane-major and per-group lowerings of the same spec produce
+  bit-identical command planes (the hash doesn't know the layout),
+- a sharded mesh re-derives its group slice exactly (each shard
+  offsets its local group ids to global ones — parallel/mesh.py),
+- pinned replay is bit-for-bit: the plane is a function, not state.
+
+The popularity distribution itself is lowered once per (spec, K) into
+a quantized inverse-CDF rank table (``icdf_table``, pure python,
+lru-cached) that embeds as a jnp constant: a draw is hash -> quantile
+-> table[quantile] -> popularity rank, then hot-key migration rotates
+rank->key id by epoch.  Key CLASSES (hot/warm/cold) are rank ranges,
+so the class label follows the popular keys through a migration.
+
+Host side: ``host_sampler`` derives the i-th op of a generator stream
+from the same hash family (python ints, no ``random``), and
+``host_rates``/``surge_steps`` lower a FlashCrowd onto the open-loop
+Poisson ramp as per-step rate multipliers.  ``OpenLoopBenchmark``/
+``Benchmark`` consume these via their ``workload=`` hook and label
+per-op latency histograms with ``key_class`` so /metrics snapshots and
+bench rows report per-class p50/p99.
+
+paxi-lint family PXW12x (analysis/workload.py) pins the purity
+contract for this package: no ``random``/``np.random``/``jax.random``
+anywhere — counter-based draws only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import lru_cache
+from typing import Dict, Optional, Sequence, Tuple
+
+from paxi_tpu.workload.spec import CLASSES, FlashCrowd, Workload
+
+# quantized inverse-CDF resolution: draws use the hash's top _QBITS
+# bits, so every rank with probability >= 1/Q is representable and
+# frequency error per rank is <= 1/Q
+_QBITS = 12
+Q = 1 << _QBITS
+_QSHIFT = 32 - _QBITS
+
+# draw channels: each derived quantity hashes a distinct channel so
+# key/read/gate draws at the same (group, slot) are independent.
+# Channel values are spaced so per-replica offsets (wpaxos demand adds
+# the replica index) cannot collide across channels.
+CH_KEY = 0x000      # key-popularity rank
+CH_READ = 0x100     # read-vs-write coin
+CH_GATE = 0x200     # flash-crowd demand duty cycle
+CH_DEMAND = 0x300   # wpaxos per-replica object demand (+ replica idx)
+CH_FOCUS = 0x400    # host: surge hot-focus coin
+CH_HOT = 0x500      # host: surge hot-rank choice
+
+# mix multipliers (odd 32-bit constants; the avalanche is _h32's job)
+_C_GID = 0x9E3779B1
+_C_SLOT = 0x85EBCA77
+_C_CHAN = 0xC2B2AE3D
+_C_SEED = 0x27D4EB2F
+
+
+# ---- the popularity table (pure python, shared by both runtimes) ---------
+
+@lru_cache(maxsize=None)
+def icdf_table(wl: Workload, n_keys: int) -> Tuple[int, ...]:
+    """Quantized inverse CDF: ``table[q]`` is the popularity rank drawn
+    at quantile ``(q + 0.5) / Q``.  Rank 0 is the most popular."""
+    K = max(int(n_keys), 1)
+    if wl.dist == "zipf":
+        w = [1.0 / math.pow(r + 1, wl.theta) for r in range(K)]
+    elif wl.dist == "hotset":
+        h = min(wl.hot_keys, K)
+        if h >= K:
+            w = [1.0] * K
+        else:
+            hw = wl.hot_weight
+            w = [hw / h] * h + [(1.0 - hw) / (K - h)] * (K - h)
+    else:
+        w = [1.0] * K
+    total = sum(w)
+    acc, cdf = 0.0, []
+    for x in w:
+        acc += x / total
+        cdf.append(acc)
+    table = []
+    r = 0
+    for q in range(Q):
+        target = (q + 0.5) / Q
+        while r < K - 1 and cdf[r] < target:
+            r += 1
+        table.append(r)
+    return tuple(table)
+
+
+def rank_pmf(wl: Workload, n_keys: int) -> Tuple[float, ...]:
+    """The per-rank probability the quantized table actually realizes
+    (uniform draws over table entries) — the reference distribution
+    for frequency tests."""
+    counts = [0] * max(int(n_keys), 1)
+    for r in icdf_table(wl, n_keys):
+        counts[r] += 1
+    return tuple(c / Q for c in counts)
+
+
+def class_cuts(wl: Workload, n_keys: int) -> Tuple[int, int]:
+    """Rank thresholds of the hot/warm/cold split: ranks below
+    ``n_hot`` are hot, below ``n_warm`` warm, the rest cold."""
+    K = max(int(n_keys), 1)
+    if wl.dist == "hotset":
+        n_hot = min(wl.hot_keys, K)
+    else:
+        n_hot = min(max(1, math.ceil(wl.hot_cut * K)), K)
+    n_warm = min(max(n_hot, math.ceil(wl.warm_cut * K)), K)
+    return n_hot, n_warm
+
+
+def class_of_rank(wl: Workload, n_keys: int, rank: int) -> int:
+    n_hot, n_warm = class_cuts(wl, n_keys)
+    return 0 if rank < n_hot else (1 if rank < n_warm else 2)
+
+
+@lru_cache(maxsize=None)
+def obj_class_table(wl: Workload, n_keys: int,
+                    n_objects: int) -> Tuple[int, ...]:
+    """Key class per wpaxos OBJECT: demand maps key -> object by
+    ``key % n_objects``, so object ``o``'s most popular resident at
+    epoch 0 is rank ``o`` and its class labels the object.  Static —
+    a migration rotates key ids, not ranks, so a migrating spec's
+    per-object labels drift by design (documented in the README)."""
+    return tuple(class_of_rank(wl, n_keys, min(o, n_keys - 1))
+                 for o in range(n_objects))
+
+
+def _frac_thr(frac: float) -> int:
+    """uint32 threshold with P(u < thr) = frac (clamped)."""
+    return max(0, min(int(frac * 4294967296.0), 0xFFFFFFFF))
+
+
+# ---- sim lowering (jnp; deferred import like metrics/lathist.py) ---------
+
+def _h32(x):
+    """lowbias32-style avalanche on uint32 planes."""
+    import jax.numpy as jnp
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _draw_u(wl: Workload, gid, slot, chan):
+    """One uint32 per (spec seed, group id, slot/step, channel) —
+    the counter-based draw every derived plane starts from.  ``gid``/
+    ``slot``/``chan`` broadcast like jnp operands."""
+    import jax.numpy as jnp
+    x = (jnp.asarray(gid).astype(jnp.uint32) * jnp.uint32(_C_GID)
+         ^ jnp.asarray(slot).astype(jnp.uint32) * jnp.uint32(_C_SLOT)
+         ^ jnp.asarray(chan).astype(jnp.uint32) * jnp.uint32(_C_CHAN)
+         ^ jnp.uint32(wl.seed & 0xFFFFFFFF) * jnp.uint32(_C_SEED))
+    return _h32(x)
+
+
+def rank_plane(wl: Workload, n_keys: int, gid, slot, chan=CH_KEY):
+    """Popularity ranks (int32) drawn at (group, absolute slot)."""
+    import jax.numpy as jnp
+    u = _draw_u(wl, gid, slot, chan)
+    q = (u >> jnp.uint32(_QSHIFT)).astype(jnp.int32)
+    table = jnp.asarray(icdf_table(wl, n_keys), jnp.int32)
+    return table[q]
+
+
+def key_plane(wl: Workload, n_keys: int, gid, slot, chan=CH_KEY):
+    """Key ids (int32) at (group, absolute slot): rank draw + hot-key
+    migration (the rank->key rotation advances one hot-set width per
+    ``migrate_every`` steps, every replica deriving it identically
+    from the absolute slot — nothing rides the wire)."""
+    import jax.numpy as jnp
+    rank = rank_plane(wl, n_keys, gid, slot, chan)
+    if wl.migrate_every <= 0:
+        return rank
+    epoch = jnp.floor_divide(jnp.asarray(slot).astype(jnp.int32),
+                             wl.migrate_every)
+    n_hot, _ = class_cuts(wl, n_keys)
+    return jnp.remainder(rank + epoch * n_hot, n_keys)
+
+
+def read_plane(wl: Workload, gid, slot):
+    """Read flags (bool) at (group, absolute slot)."""
+    import jax.numpy as jnp
+    if wl.read_frac <= 0.0:
+        return jnp.zeros(jnp.broadcast_shapes(jnp.shape(gid),
+                                              jnp.shape(slot)), bool)
+    if wl.read_frac >= 1.0:
+        return jnp.ones(jnp.broadcast_shapes(jnp.shape(gid),
+                                             jnp.shape(slot)), bool)
+    u = _draw_u(wl, gid, slot, CH_READ)
+    return u < jnp.uint32(_frac_thr(wl.read_frac))
+
+
+def class_plane(wl: Workload, n_keys: int, gid, slot, chan=CH_KEY):
+    """Key-class ids (int32; 0/1/2 = hot/warm/cold, spec.CLASSES
+    order) of the commands at (group, absolute slot) — the rank-range
+    label, so it tracks the popular keys through migrations."""
+    import jax.numpy as jnp
+    rank = rank_plane(wl, n_keys, gid, slot, chan)
+    n_hot, n_warm = class_cuts(wl, n_keys)
+    return jnp.where(rank < n_hot, 0,
+                     jnp.where(rank < n_warm, 1, 2)).astype(jnp.int32)
+
+
+def flash_on(wl: Workload, t):
+    """Traced bool: is sim step ``t`` inside a surge window?  None for
+    flashless specs (static python decision — the kernel specializes)."""
+    import jax.numpy as jnp
+    fl = wl.flash
+    if fl is None:
+        return None
+    t = jnp.asarray(t).astype(jnp.int32)
+    if fl.period > 0:
+        ph = jnp.remainder(t - fl.start, fl.period)
+        return (t >= fl.start) & (ph < fl.duration)
+    return (t >= fl.start) & (t < fl.start + fl.duration)
+
+
+def demand_gate(wl: Workload, gid, t, chan=CH_GATE):
+    """Flash-crowd lowering for the sim's closed proposer loop: the
+    sim cannot over-offer like the host's open loop, so OUTSIDE surge
+    windows new proposals run a ``1/mult`` duty cycle (counter-based
+    coin per (group, step)) and surges lift the gate — a window offers
+    ``mult``x the baseline demand.  None when the spec has no flash
+    component (the kernel keeps its always-on propose path)."""
+    import jax.numpy as jnp
+    fl = wl.flash
+    if fl is None:
+        return None
+    u = _draw_u(wl, gid, t, chan)
+    duty = u < jnp.uint32(_frac_thr(1.0 / fl.mult))
+    return flash_on(wl, t) | duty
+
+
+# ---- SimConfig plumbing --------------------------------------------------
+
+def apply_workload(cfg, wl: Optional[Workload]):
+    """The SimConfig that serves ``wl``'s traffic (validated against
+    the config's key space).  No-op for ``wl=None``."""
+    if wl is None:
+        return cfg
+    return cfg.with_(workload=wl.validate(cfg.n_keys))
+
+
+def class_split(state) -> Dict[str, Dict]:
+    """Fold the kernels' per-class ``m_wl_hist_*``/``m_wl_sum_*``
+    measurement planes (group-major final state) into per-class
+    latency summaries — the bench-row / CLI form of the per-key-class
+    split.  Empty dict when the run was workloadless."""
+    import numpy as np
+
+    from paxi_tpu.metrics import lathist
+
+    out: Dict[str, Dict] = {}
+    if not isinstance(state, dict):
+        return out
+    for nm in CLASSES:
+        h = state.get(f"m_wl_hist_{nm}")
+        if h is None:
+            continue
+        counts = lathist.plane_total(h)
+        sums = int(np.asarray(state.get(f"m_wl_sum_{nm}", 0),
+                              dtype=np.int64).sum())
+        out[nm] = lathist.summarize(counts, sums)
+    return out
+
+
+# ---- host lowering (python ints; same hash family, no random) ------------
+
+def _h32i(x: int) -> int:
+    x &= 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * 0x7FEB352D) & 0xFFFFFFFF
+    x ^= x >> 15
+    x = (x * 0x846CA68B) & 0xFFFFFFFF
+    x ^= x >> 16
+    return x
+
+
+def _draw_ui(wl: Workload, stream: int, i: int, chan: int) -> int:
+    return _h32i((stream * _C_GID) ^ (i * _C_SLOT) ^ (chan * _C_CHAN)
+                 ^ ((wl.seed & 0xFFFFFFFF) * _C_SEED))
+
+
+def host_sampler(wl: Workload, n_keys: int, stream: int = 0):
+    """The host generators' per-op derivation: ``sample(i, surge=...,
+    epoch=...)`` -> ``(key, write, key_class)`` for the i-th op of
+    generator stream ``stream`` — deterministic in (spec, stream, i),
+    mirroring the sim's (group, slot) counter draws.  ``surge`` applies
+    the FlashCrowd ``focus`` re-aim; ``epoch`` is the migration epoch
+    (the host driver derives it from its own clock/ramp position)."""
+    K = max(int(n_keys), 1)
+    table = icdf_table(wl, K)
+    n_hot, n_warm = class_cuts(wl, K)
+    fl = wl.flash
+    focus_thr = _frac_thr(fl.focus) if fl is not None else 0
+    read_thr = _frac_thr(wl.read_frac)
+    always_read = wl.read_frac >= 1.0
+    never_read = wl.read_frac <= 0.0
+
+    def sample(i: int, surge: bool = False,
+               epoch: int = 0) -> Tuple[int, bool, str]:
+        rank = table[_draw_ui(wl, stream, i, CH_KEY) >> _QSHIFT]
+        if surge and focus_thr \
+                and _draw_ui(wl, stream, i, CH_FOCUS) < focus_thr:
+            rank = _draw_ui(wl, stream, i, CH_HOT) % n_hot
+        key = rank
+        if wl.migrate_every > 0 and epoch:
+            key = (rank + epoch * n_hot) % K
+        if always_read:
+            write = False
+        elif never_read:
+            write = True
+        else:
+            write = _draw_ui(wl, stream, i, CH_READ) >= read_thr
+        cls = CLASSES[0 if rank < n_hot else (1 if rank < n_warm else 2)]
+        return key, write, cls
+
+    return sample
+
+
+def surge_steps(wl: Workload, n_steps: int) -> Tuple[bool, ...]:
+    """FlashCrowd window membership per host ramp step (python twin of
+    ``flash_on`` over step indices 0..n_steps-1)."""
+    fl = wl.flash
+    if fl is None:
+        return tuple(False for _ in range(n_steps))
+    out = []
+    for t in range(n_steps):
+        if t < fl.start:
+            out.append(False)
+        elif fl.period > 0:
+            out.append((t - fl.start) % fl.period < fl.duration)
+        else:
+            out.append(t < fl.start + fl.duration)
+    return tuple(out)
+
+
+def host_rates(wl: Workload, rates: Sequence[float]) -> Tuple[float, ...]:
+    """The effective offered-rate ramp: surge steps multiply the
+    target rate by ``mult`` (the host half of the flash lowering —
+    the Poisson arrival process itself is the generator's)."""
+    fl = wl.flash
+    if fl is None:
+        return tuple(float(r) for r in rates)
+    on = surge_steps(wl, len(rates))
+    return tuple(float(r) * (fl.mult if s else 1.0)
+                 for r, s in zip(rates, on))
+
+
+# ---- named workloads -----------------------------------------------------
+# The built-in catalog (CLI `workload list|run -workload NAME`,
+# bench-host's -workload flag, bench_all's workload axis).  All entries
+# share the read mix so distribution is the only axis that moves
+# between a row and its uniform control.
+UNIFORM = Workload(name="uniform", dist="uniform", read_frac=0.5)
+
+ZIPF99 = Workload(name="zipf99", dist="zipf", theta=0.99,
+                  read_frac=0.5)
+
+# zipf skew + periodic surges that re-aim half the draws at the hot
+# ranks (the celebrity-event shape)
+FLASH = Workload(name="flash", dist="zipf", theta=0.99, read_frac=0.5,
+                 flash=FlashCrowd(start=30, period=60, duration=12,
+                                  mult=4.0, focus=0.5))
+
+# explicit hot set: the shard router's hot-range adversary and the
+# ownership-steal stress shape
+HOTRANGE = Workload(name="hotrange", dist="hotset", hot_keys=8,
+                    hot_weight=0.9, read_frac=0.2)
+
+# zipf whose popular key ids rotate mid-run — the migration adversary
+# for ownership/steal policies
+MIGRATE = Workload(name="migrate", dist="zipf", theta=0.99,
+                   read_frac=0.5, migrate_every=40)
+
+NAMED: Dict[str, Workload] = {w.name: w for w in (
+    UNIFORM, ZIPF99, FLASH, HOTRANGE, MIGRATE)}
+
+
+def named_workload(name: str) -> Workload:
+    if name not in NAMED:
+        raise KeyError(f"unknown workload {name!r}; "
+                       f"have {sorted(NAMED)}")
+    return NAMED[name]
+
+
+def describe(wl: Workload, n_keys: int = 64) -> Dict:
+    """One-line-able summary for `workload list`."""
+    n_hot, n_warm = class_cuts(wl, n_keys)
+    out: Dict = {"name": wl.name, "dist": wl.dist,
+                 "read_frac": wl.read_frac,
+                 "classes": {"hot_ranks": n_hot,
+                             "warm_ranks": n_warm - n_hot,
+                             "at_keys": n_keys}}
+    if wl.dist == "zipf":
+        out["theta"] = wl.theta
+    if wl.dist == "hotset":
+        out["hot_keys"] = wl.hot_keys
+        out["hot_weight"] = wl.hot_weight
+    if wl.flash is not None:
+        out["flash"] = dataclasses.asdict(wl.flash)
+    if wl.migrate_every:
+        out["migrate_every"] = wl.migrate_every
+    return out

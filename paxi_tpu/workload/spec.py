@@ -1,0 +1,159 @@
+"""The declarative workload vocabulary: key popularity, read mixes and
+arrival bursts as data.
+
+A ``Workload`` describes the *traffic* a protocol serves — the axis
+"Practical Experience Report: The Performance of Paxos in the Cloud"
+(PAPERS.md) measures and the uniform closed/open-loop generators
+cannot express:
+
+- **distribution**: which keys the offered commands touch — uniform,
+  Zipf(θ) (rank r drawn ∝ 1/(r+1)^θ, the canonical web-traffic skew),
+  or an explicit hot set (``hot_keys`` keys soaking up ``hot_weight``
+  of the draws — paxi's conflict-ratio knob, generalized).
+- **read mix**: ``read_frac`` of commands are reads (no state
+  mutation) — the lever leader_reads and per-key registers care about.
+- **flash crowd**: timed arrival surges.  On the host the Poisson
+  ramp's offered rate is multiplied by ``mult`` inside each surge
+  window; in the sim the proposer's demand gate runs a ``1/mult`` duty
+  cycle OUTSIDE windows so a surge offers ``mult``× demand.  ``focus``
+  optionally concentrates surge draws onto the hot ranks (the
+  celebrity-event shape).
+- **hot-key migration**: ``migrate_every`` rotates which key IDS are
+  popular every N steps (popularity RANKS are stable; the rank→key
+  mapping shifts) — the adversary for ownership/steal policies.
+
+Draws are **counter-based**: every sample is a pure integer hash of
+(spec seed, group id, step/slot, lane) — no PRNG state, no shaped
+whole-batch draws.  That is what lets the same spec lower onto the
+lane-major sim kernels, the per-group kernels, a sharded device mesh
+(each shard re-derives its slice bit-for-bit) and the host generators,
+all agreeing deterministically (paxi-lint rule family PXW12x pins
+this; see analysis/workload.py).
+
+Everything is a frozen dataclass of ints/floats: hashable (a Workload
+rides inside ``SimConfig``, a jit static argument), trivially
+serializable (``dataclasses.asdict`` -> JSON), and reconstructible via
+``from_dict``.  Like scenarios/spec.py — the environment sibling of
+this module — it is dependency-free on purpose: ``sim/types.py``
+carries a ``Workload`` by duck type and ``workload/compile.py`` lowers
+it onto both runtimes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+# key-class label order: class id 0/1/2 = hot/warm/cold everywhere
+# (kernel planes, bench rows, host histogram labels)
+CLASSES = ("hot", "warm", "cold")
+
+
+@dataclass(frozen=True)
+class FlashCrowd:
+    """Arrival surges: windows ``[start + k*period, .. + duration)``
+    (``period=0``: a single window).  Times are sim steps on the sim
+    runtime and rate-ramp step indices on the host."""
+
+    start: int = 20
+    period: int = 0       # steps between window starts (0: one-shot)
+    duration: int = 10    # steps each surge lasts
+    mult: float = 4.0     # arrival-rate multiplier during a surge
+    focus: float = 0.0    # extra P(draw lands on the hot ranks) inside
+    # a surge window (0 = the surge keeps the base distribution)
+
+
+@dataclass(frozen=True)
+class Workload:
+    """A key-popularity / read-mix / burst workload (module docstring).
+
+    ``hot_cut``/``warm_cut`` split popularity RANKS into the hot/warm/
+    cold classes whose latency is reported separately: ranks below
+    ``ceil(hot_cut*K)`` are hot, below ``ceil(warm_cut*K)`` warm, the
+    rest cold (``dist="hotset"`` pins the hot class to its explicit
+    ``hot_keys`` instead)."""
+
+    name: str = "workload"
+    dist: str = "uniform"      # uniform | zipf | hotset
+    theta: float = 0.99        # zipf: P(rank r) ∝ 1/(r+1)^theta
+    hot_keys: int = 4          # hotset: size of the hot set
+    hot_weight: float = 0.9    # hotset: P(draw lands in the hot set)
+    read_frac: float = 0.0     # fraction of commands that are reads
+    flash: Optional[FlashCrowd] = None
+    migrate_every: int = 0     # rotate the hot key ids every N steps
+    hot_cut: float = 0.05      # class split: top ranks -> "hot"
+    warm_cut: float = 0.30     # next ranks -> "warm"; rest "cold"
+    seed: int = 0              # spec-level salt folded into every draw
+
+    # ---- validation -----------------------------------------------------
+    def validate(self, n_keys: int) -> "Workload":
+        """Raise ValueError on an inconsistent spec; returns self so
+        call sites can chain."""
+        if n_keys < 1:
+            raise ValueError(f"workload {self.name!r}: n_keys must be "
+                             f">= 1, got {n_keys}")
+        if self.dist not in ("uniform", "zipf", "hotset"):
+            raise ValueError(f"workload {self.name!r}: unknown dist "
+                             f"{self.dist!r}")
+        if self.dist == "zipf" and self.theta <= 0:
+            raise ValueError(f"workload {self.name!r}: zipf theta must "
+                             f"be > 0, got {self.theta}")
+        if self.dist == "hotset":
+            if not 1 <= self.hot_keys <= n_keys:
+                raise ValueError(
+                    f"workload {self.name!r}: hot_keys={self.hot_keys} "
+                    f"outside 1..{n_keys}")
+            if not 0.0 < self.hot_weight <= 1.0:
+                raise ValueError(f"workload {self.name!r}: hot_weight "
+                                 "must be in (0, 1]")
+        if not 0.0 <= self.read_frac <= 1.0:
+            raise ValueError(f"workload {self.name!r}: read_frac must "
+                             "be in [0, 1]")
+        if not 0.0 < self.hot_cut <= self.warm_cut <= 1.0:
+            raise ValueError(f"workload {self.name!r}: need 0 < hot_cut"
+                             f"={self.hot_cut} <= warm_cut="
+                             f"{self.warm_cut} <= 1")
+        if self.migrate_every < 0:
+            raise ValueError(f"workload {self.name!r}: migrate_every "
+                             "must be >= 0")
+        if self.flash is not None:
+            fl = self.flash
+            if fl.start < 0 or fl.duration < 1 or fl.period < 0:
+                raise ValueError(f"workload {self.name!r}: flash needs "
+                                 "start >= 0, duration >= 1 and "
+                                 "period >= 0")
+            if fl.period and fl.duration > fl.period:
+                raise ValueError(f"workload {self.name!r}: flash "
+                                 f"duration={fl.duration} must be <= "
+                                 f"period={fl.period}")
+            if fl.mult < 1.0:
+                raise ValueError(f"workload {self.name!r}: flash mult "
+                                 "must be >= 1")
+            if not 0.0 <= fl.focus <= 1.0:
+                raise ValueError(f"workload {self.name!r}: flash focus "
+                                 "must be in [0, 1]")
+        return self
+
+    # ---- (de)serialization ----------------------------------------------
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Workload":
+        """Rebuild from ``dataclasses.asdict`` output after a JSON
+        round-trip — the trace-meta / artifact path."""
+        fl = d.get("flash")
+        flash = FlashCrowd(start=int(fl["start"]),
+                           period=int(fl.get("period", 0)),
+                           duration=int(fl.get("duration", 1)),
+                           mult=float(fl.get("mult", 1.0)),
+                           focus=float(fl.get("focus", 0.0))) \
+            if fl else None
+        return Workload(name=str(d.get("name", "workload")),
+                        dist=str(d.get("dist", "uniform")),
+                        theta=float(d.get("theta", 0.99)),
+                        hot_keys=int(d.get("hot_keys", 4)),
+                        hot_weight=float(d.get("hot_weight", 0.9)),
+                        read_frac=float(d.get("read_frac", 0.0)),
+                        flash=flash,
+                        migrate_every=int(d.get("migrate_every", 0)),
+                        hot_cut=float(d.get("hot_cut", 0.05)),
+                        warm_cut=float(d.get("warm_cut", 0.30)),
+                        seed=int(d.get("seed", 0)))

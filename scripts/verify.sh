@@ -38,14 +38,89 @@
 #                                # the artifact schema, committed > 0,
 #                                # anomalies == 0 and a clean
 #                                # cross-shard 2PC atomicity verdict
+#   scripts/verify.sh --workload # prepend the workload-engine smoke:
+#                                # one zipf99 spec compiled onto BOTH
+#                                # sim lowerings (lane-major paxos vs
+#                                # per-group paxos_pg must agree
+#                                # bit-for-bit on the kv plane, clean
+#                                # oracle, populated per-class split)
+#                                # plus a tiny open-loop host ramp
+#                                # driven by the same spec (anomalies
+#                                # 0, per-class latency in the step
+#                                # rows) and the PXW purity lint
 # Stage flags stack: `verify.sh --lint --metrics --hunt` runs all.
 set -o pipefail
 cd "$(dirname "$0")/.."
 
 while [ "${1:-}" = "--lint" ] || [ "${1:-}" = "--metrics" ] \
     || [ "${1:-}" = "--hunt" ] || [ "${1:-}" = "--bench" ] \
-    || [ "${1:-}" = "--host-bench" ] || [ "${1:-}" = "--shard" ]; do
-  if [ "$1" = "--shard" ]; then
+    || [ "${1:-}" = "--host-bench" ] || [ "${1:-}" = "--shard" ] \
+    || [ "${1:-}" = "--workload" ]; do
+  if [ "$1" = "--workload" ]; then
+    shift
+    echo "== workload smoke (one spec, both sim lowerings) =="
+    # the engine's core promise at a toy shape: the SAME zipf99 spec
+    # compiled onto the lane-major kernel and the per-group kernel
+    # must agree bit-for-bit on the kv plane (counter-based draws are
+    # a pure function of (group, slot, channel, seed) — no lowering
+    # may perturb them), with the oracle clean and the per-class
+    # latency split populated on both sides
+    timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'PYEOF' || exit $?
+import numpy as np
+from paxi_tpu.protocols import sim_protocol
+from paxi_tpu.sim import SimConfig, simulate
+from paxi_tpu.workload import ZIPF99, apply_workload, class_split
+cfg = apply_workload(SimConfig(n_replicas=3, n_slots=16, n_keys=64),
+                     ZIPF99)
+runs = {}
+for name in ("paxos", "paxos_pg"):
+    r = simulate(sim_protocol(name), cfg, 8, 80, seed=3)
+    assert int(r.violations) == 0, (name, int(r.violations))
+    assert r.inscan_violations == 0, (name, r.inscan_violations)
+    assert int(r.metrics["committed_slots"]) > 0, name
+    split = class_split(r.state)
+    assert all(split[c]["n"] > 0 for c in ("hot", "warm", "cold")), \
+        (name, split)
+    runs[name] = r
+kv_lm = np.asarray(runs["paxos"].state["kv"])
+kv_pg = np.asarray(runs["paxos_pg"].state["kv"])
+assert kv_lm.shape == kv_pg.shape and (kv_lm == kv_pg).all(), \
+    "zipf99 kv planes diverge between lowerings"
+r2 = simulate(sim_protocol("paxos"), cfg, 8, 80, seed=3)
+assert (np.asarray(r2.state["kv"]) == kv_lm).all(), \
+    "zipf99 kv plane not deterministic across runs"
+n = {c: class_split(runs["paxos"].state)[c]["n"]
+     for c in ("hot", "warm", "cold")}
+print(f"workload sim smoke OK: kv bit-identical across lowerings "
+      f"and reruns, violations=0, class split {n}")
+PYEOF
+    echo "== workload smoke (host open-loop, same spec family) =="
+    WL_OUT=$(mktemp /tmp/paxi_workload.XXXXXX.json)
+    timeout -k 10 180 env JAX_PLATFORMS=cpu python -m paxi_tpu \
+      bench-host --open-loop -workload zipf99 -rates 300,800 \
+      -step_s 1.5 -conns 2 -K 64 -base_port 18120 -out "$WL_OUT" \
+      >/dev/null || exit $?
+    WL_OUT="$WL_OUT" python - <<'PYEOF' || exit $?
+import json, os
+with open(os.environ["WL_OUT"]) as f:
+    r = json.load(f)
+assert r.get("workload") == "zipf99", r.get("workload")
+assert r["total_completed"] > 0, "no ops completed"
+assert (r["anomalies"] or 0) == 0, f"linearizability: {r['anomalies']}"
+for s in r["steps"]:
+    cls = s.get("key_class_latency")
+    assert cls and set(cls) == {"hot", "warm", "cold"}, s
+    assert sum(c["n"] for c in cls.values()) == s["completed"], s
+hot = sum(s["key_class_latency"]["hot"]["n"] for s in r["steps"])
+cold = sum(s["key_class_latency"]["cold"]["n"] for s in r["steps"])
+assert hot > cold, f"zipf skew missing: hot={hot} cold={cold}"
+print(f"workload host smoke OK: {r['total_completed']} ops, "
+      f"hot={hot} > cold={cold}, anomalies={r['anomalies']}")
+PYEOF
+    rm -f "$WL_OUT"
+    echo "== workload purity lint (PXW) =="
+    timeout -k 10 120 python -m paxi_tpu lint --rule PXW || exit $?
+  elif [ "$1" = "--shard" ]; then
     shift
     echo "== shard smoke (G=2 ramp through the router + 2PC) =="
     # the sharded serving tier end-to-end at a toy rate: router ->
@@ -297,7 +372,7 @@ for v in r["violations"] + r["suppressed"]:
     for k in ("rule", "code", "path", "line", "col", "message"):
         assert k in v, (k, v)
 known = ("PXK", "PXH", "PXT", "PXC", "PXQ", "PXB", "PXS", "PXF", "PXA",
-         "PXM", "PXL")
+         "PXM", "PXL", "PXW")
 for s in r["suppressed"]:
     assert s["code"].startswith(known), s["code"]
     assert s.get("suppressed_by"), s

@@ -1,0 +1,36 @@
+"""Seeded PXW12x violations — workload-purity fixture (never imported).
+
+Each block below breaks the counter-based draw contract one way; the
+test asserts every code fires exactly where seeded.
+"""
+
+import random                                # PXW121: random import
+from secrets import token_hex                # PXW121: secrets import
+
+import numpy as np
+
+
+def bad_key_draw(n_keys):
+    return random.randrange(n_keys)          # PXW122: random.* call
+
+
+def bad_plane(shape):
+    return np.random.rand(*shape)            # PXW122: np.random.* call
+
+
+def bad_sim_draw(jr, key):
+    return jr.split(key)                     # PXW122: jr.* call
+
+
+def bad_schedule():
+    import time
+    return time.time()                       # PXW123: wall clock
+
+
+def bad_epoch():
+    import datetime
+    return datetime.datetime.now()           # PXW123: wall clock
+
+
+def unused():
+    return token_hex(4)

@@ -11,6 +11,7 @@ from paxi_tpu.sim.runner import init_carry
 PAXOS = sim_protocol("paxos")
 
 
+@pytest.mark.slow   # heavy compile; demoted to keep the 870 s tier-1 gate
 def test_resume_equals_straight_run(tmp_path):
     cfg = SimConfig(n_replicas=3, n_slots=64)
     fuzz = FuzzConfig(p_drop=0.1, max_delay=2)   # fuzzed: rng must carry

@@ -52,6 +52,7 @@ def test_committed_instances_agree():
                         assert agreed.setdefault(key, v) == v, key
 
 
+@pytest.mark.slow   # heavy compile; demoted to keep the 870 s tier-1 gate
 def test_conflict_heavy_small_keyspace():
     # tiny key space => most commands conflict => deps + SCC execution
     res, _ = run(groups=2, steps=50, n_keys=1, seed=3)
@@ -107,6 +108,7 @@ def test_perm_crash_owner_recovery():
     assert bool(dead_committed.all())
 
 
+@pytest.mark.slow   # heavy compile; demoted to keep the 870 s tier-1 gate
 def test_long_horizon_ring():
     """Instance rings recycle executed prefixes: a horizon well past the
     window size runs with zero violations (SURVEY §7 slot recycling —

@@ -96,12 +96,17 @@ def assert_equivalent(name, fuzz, groups=6, steps=80, seed=11):
     assert int(r_new.metrics["committed_slots"]) > 0
 
 
-@pytest.mark.parametrize("name", sorted(RING_PLANES))
+@pytest.mark.parametrize("name", [
+    n if n == "paxos" else pytest.param(n, marks=pytest.mark.slow)
+    for n in sorted(RING_PLANES)])
 def test_drop_fuzzed_equivalence(name):
-    """One drop/delay-fuzzed pair per kernel in tier-1: elections,
-    retries, re-proposals, snapshots and ring recycling all fire at
-    steps >> n_slots, and the fixed-cell kernel must match its frozen
-    sliding-window reference bit-canonically."""
+    """Drop/delay-fuzzed pair per kernel: elections, retries,
+    re-proposals, snapshots and ring recycling all fire at steps >>
+    n_slots, and the fixed-cell kernel must match its frozen
+    sliding-window reference bit-canonically.  paxos stays tier-1 as
+    the representative of the axis; the heavier kernels (each still
+    covered by its own tier-1 fuzzed_safety variant) run in the slow
+    tier to keep the 870 s gate."""
     assert_equivalent(name, DROP)
 
 

@@ -6,6 +6,8 @@ is what makes the ``--backend pallas`` fast path trustworthy before
 the TPU tunnel ever compiles it for real.
 """
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import jax.random as jr
@@ -67,6 +69,7 @@ def test_insert_matches_dense():
     _assert_tree_equal(new_p, new_d)
 
 
+@pytest.mark.slow   # heavy compile; demoted to keep the 870 s tier-1 gate
 def test_run_with_pallas_exchange_is_bit_identical():
     """End to end: a lane-major run under ``exchange="pallas"`` equals
     the dense run exactly (the exchange draws no randomness, so the

@@ -4,6 +4,8 @@ FuzzConfig.perm_crash never heals, unlike the resampled p_crash
 windows).
 """
 
+import pytest
+
 import jax.numpy as jnp
 
 from paxi_tpu.protocols import sim_protocol
@@ -30,6 +32,7 @@ def test_paxos_leader_kill_reelection():
     assert (exec_[:, 0] <= 25).all(), exec_[:, 0]
 
 
+@pytest.mark.slow   # heavy compile; demoted to keep the 870 s tier-1 gate
 def test_wpaxos_owner_kill_steal_takeover():
     """Replica 0 owns objects o % R == 0; killing it permanently must
     make a survivor steal object 0 (grid phase-1 among survivors) and

@@ -93,6 +93,7 @@ def test_long_horizon_ring():
     assert (res.state["base"] > 0).all()
 
 
+@pytest.mark.slow   # heavy compile; demoted to keep the 870 s tier-1 gate
 def test_body_gating_under_asymmetric_drops():
     """Heavy loss on the C-plane must stall execution (body-gated), not
     reorder it: safety holds and exec_c never outruns c_stored by more

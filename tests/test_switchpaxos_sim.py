@@ -154,6 +154,7 @@ def test_nogap_witness_classifies_reproduced(nogap_witness):
     assert c.host["oracle_violations"] > 0
 
 
+@pytest.mark.slow   # heavy compile; demoted to keep the 870 s tier-1 gate
 def test_real_kernel_safe_under_the_twin_schedule():
     """The same churn+drops schedule on the REAL kernel: the witness
     is the seeded gap-skip, not the scenario or the tier."""

@@ -1,0 +1,390 @@
+"""Workload engine (paxi_tpu/workload/): spec validation, the
+counter-based draw contract (bit-identical command planes across
+lowerings and reruns), distribution/schedule shape, the per-key-class
+measurement split, both host generator hooks, the shard router's
+per-group load counters, and the PXW purity lint family."""
+
+import asyncio
+import dataclasses
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from paxi_tpu.protocols import sim_protocol
+from paxi_tpu.sim import SimConfig, simulate
+from paxi_tpu.workload import (FLASH, MIGRATE, ZIPF99, FlashCrowd,
+                               Workload, apply_workload, class_cuts,
+                               class_split, demand_gate, flash_on,
+                               host_rates, host_sampler, key_plane,
+                               named_workload, rank_pmf, read_plane,
+                               surge_steps)
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---- spec validation / (de)serialization ---------------------------------
+def test_spec_validation_rejects_inconsistent_specs():
+    with pytest.raises(ValueError):
+        Workload(dist="pareto").validate(16)
+    with pytest.raises(ValueError):
+        Workload(dist="zipf", theta=0.0).validate(16)
+    with pytest.raises(ValueError):
+        Workload(read_frac=1.5).validate(16)
+    with pytest.raises(ValueError):
+        Workload(hot_cut=0.5, warm_cut=0.2).validate(16)
+    with pytest.raises(ValueError):
+        Workload(flash=FlashCrowd(period=10, duration=12)).validate(16)
+    with pytest.raises(ValueError):
+        # the hotset spec's hot_keys must fit the key space
+        named_workload("hotrange").validate(4)
+    with pytest.raises(KeyError):
+        named_workload("nope")
+    # apply_workload validates against the config's key space
+    with pytest.raises(ValueError):
+        apply_workload(SimConfig(n_keys=4), named_workload("hotrange"))
+
+
+def test_spec_json_round_trip():
+    for wl in (ZIPF99, FLASH, MIGRATE):
+        assert Workload.from_dict(dataclasses.asdict(wl)) == wl
+
+
+# ---- distribution shape --------------------------------------------------
+def test_zipf_pmf_decreasing_and_normalized():
+    pmf = rank_pmf(ZIPF99, 16)
+    assert abs(sum(pmf) - 1.0) < 1e-9
+    assert all(a >= b for a, b in zip(pmf, pmf[1:]))
+    assert pmf[0] > 4 * pmf[15]
+
+
+@pytest.mark.jax
+def test_zipf_key_plane_frequencies_match_pmf():
+    """Empirical key frequencies over many counter draws track the
+    quantized inverse-CDF pmf."""
+    K = 16
+    gid = np.arange(64)[:, None]
+    slot = np.arange(512)[None, :]
+    keys = np.asarray(key_plane(ZIPF99, K, gid, slot))
+    n = keys.size
+    pmf = rank_pmf(ZIPF99, K)
+    for r in range(K):
+        emp = float((keys == r).sum()) / n
+        assert abs(emp - pmf[r]) < 0.02, (r, emp, pmf[r])
+
+
+@pytest.mark.jax
+def test_read_plane_static_branches_and_coin():
+    gid = np.arange(8)[:, None]
+    slot = np.arange(256)[None, :]
+    never = Workload(read_frac=0.0)
+    allr = Workload(read_frac=1.0)
+    assert not np.asarray(read_plane(never, gid, slot)).any()
+    assert np.asarray(read_plane(allr, gid, slot)).all()
+    frac = float(np.asarray(read_plane(ZIPF99, gid, slot)).mean())
+    assert 0.45 < frac < 0.55, frac
+
+
+# ---- host/sim draw agreement ---------------------------------------------
+def test_host_sampler_matches_sim_planes():
+    """The host generator's i-th op for stream g equals the sim's
+    (group g, slot i) derivation — the same hash family on both
+    runtimes, python ints vs jnp uint32."""
+    K = 64
+    slots = np.arange(96)
+    for g in (0, 3):
+        sim_keys = np.asarray(key_plane(ZIPF99, K, g, slots))
+        sim_reads = np.asarray(read_plane(ZIPF99, g, slots))
+        sample = host_sampler(ZIPF99, K, stream=g)
+        for i in range(96):
+            key, write, cls = sample(i)
+            assert key == sim_keys[i], (g, i)
+            assert write == (not sim_reads[i]), (g, i)
+            assert cls in ("hot", "warm", "cold")
+
+
+def test_host_sampler_deterministic_and_surge_focus():
+    sample = host_sampler(FLASH, 64, stream=2)
+    seq = [sample(i) for i in range(256)]
+    assert seq == [sample(i) for i in range(256)]
+    hot_base = sum(1 for _, _, c in seq if c == "hot")
+    hot_surge = sum(1 for i in range(256)
+                    if sample(i, surge=True)[2] == "hot")
+    # focus=0.5 re-aims about half the surge draws at the hot ranks
+    assert hot_surge > hot_base + 40, (hot_base, hot_surge)
+
+
+# ---- flash-crowd schedule ------------------------------------------------
+def test_flash_schedule_shape():
+    on = surge_steps(FLASH, 120)
+    fl = FLASH.flash
+    for t in range(120):
+        expect = t >= fl.start and (t - fl.start) % fl.period \
+            < fl.duration
+        assert on[t] == expect, t
+    assert surge_steps(ZIPF99, 10) == (False,) * 10
+    # the sim twin agrees step for step
+    sim_on = [bool(flash_on(FLASH, t)) for t in range(120)]
+    assert tuple(sim_on) == on
+    # host rate lowering multiplies surge steps only
+    rates = host_rates(FLASH, [100.0] * 120)
+    assert all(r == (400.0 if s else 100.0)
+               for r, s in zip(rates, on))
+
+
+@pytest.mark.jax
+def test_demand_gate_duty_cycle():
+    gids = np.arange(256)
+    fl = FLASH.flash
+    off_t = fl.start + fl.duration + 5          # outside every window
+    on_t = fl.start + 1
+    gate_off = np.asarray(demand_gate(FLASH, gids, off_t))
+    gate_on = np.asarray(demand_gate(FLASH, gids, on_t))
+    assert gate_on.all()
+    duty = float(gate_off.mean())               # ~1/mult = 0.25
+    assert 0.15 < duty < 0.35, duty
+    assert demand_gate(ZIPF99, gids, 0) is None
+
+
+# ---- migration -----------------------------------------------------------
+@pytest.mark.jax
+def test_migration_rotates_key_ids_not_classes():
+    from paxi_tpu.workload import class_plane, rank_plane
+    K = 32
+    gid = np.arange(4)[:, None]
+    slot = np.arange(120)[None, :]
+    rank = np.asarray(rank_plane(MIGRATE, K, gid, slot))
+    key = np.asarray(key_plane(MIGRATE, K, gid, slot))
+    n_hot, _ = class_cuts(MIGRATE, K)
+    epoch = np.asarray(slot) // MIGRATE.migrate_every
+    assert (key == (rank + epoch * n_hot) % K).all()
+    # epoch 0 is the identity mapping; later epochs genuinely move ids
+    assert (key[:, :40] == rank[:, :40]).all()
+    assert (key[:, 40:80] != rank[:, 40:80]).any()
+    # class labels follow RANKS (popularity), not key ids
+    cls = np.asarray(class_plane(MIGRATE, K, gid, slot))
+    assert ((cls == 0) == (rank < n_hot)).all()
+
+
+# ---- sim kernels: determinism, lowering parity, class split --------------
+def _zipf_cfg():
+    return apply_workload(
+        SimConfig(n_replicas=3, n_slots=16, n_keys=64), ZIPF99)
+
+
+@pytest.mark.slow   # heavy compile; verify.sh --workload smokes the same pin
+@pytest.mark.jax
+def test_sim_zipf_pinned_replay_and_lowering_parity():
+    """The engine's core promise: the SAME spec on the lane-major
+    kernel and the per-group kernel, same seed -> bit-identical kv
+    planes and per-class counts; a rerun is bit-identical too."""
+    cfg = _zipf_cfg()
+    res = {name: simulate(sim_protocol(name), cfg, 8, 80, seed=3)
+           for name in ("paxos", "paxos_pg")}
+    for name, r in res.items():
+        assert int(r.violations) == 0, name
+        assert r.inscan_violations == 0, name
+        assert int(r.metrics["committed_slots"]) > 0, name
+    kv_lm = np.asarray(res["paxos"].state["kv"])
+    kv_pg = np.asarray(res["paxos_pg"].state["kv"])
+    assert kv_lm.shape == kv_pg.shape
+    assert (kv_lm == kv_pg).all(), "kv planes diverge across lowerings"
+    for c in ("hot", "warm", "cold"):
+        assert int(res["paxos"].metrics[f"wl_{c}_n"]) \
+            == int(res["paxos_pg"].metrics[f"wl_{c}_n"]), c
+    rerun = simulate(sim_protocol("paxos"), cfg, 8, 80, seed=3)
+    assert (np.asarray(rerun.state["kv"]) == kv_lm).all()
+    # per-class split populated and consistent with the commit count
+    split = class_split(res["paxos"].state)
+    assert set(split) == {"hot", "warm", "cold"}
+    assert all(split[c]["n"] > 0 for c in split)
+    assert sum(split[c]["n"] for c in split) \
+        == res["paxos"].latency_summary()["n"]
+    assert split["hot"]["n"] > split["cold"]["n"], split
+
+
+@pytest.mark.slow   # heavy compile; verify.sh --workload smokes the same pin
+@pytest.mark.jax
+def test_sim_flash_gates_demand_on_both_lowerings():
+    """FLASH's demand gate throttles the proposer loop identically in
+    both lowerings (committed counts and the oracle agree; kv is NOT
+    compared — the idle opening window legitimately elects different
+    leaders per layout, an election-jitter artifact, not a workload
+    one)."""
+    cfg = apply_workload(
+        SimConfig(n_replicas=3, n_slots=16, n_keys=64), FLASH)
+    res = {name: simulate(sim_protocol(name), cfg, 8, 80, seed=3)
+           for name in ("paxos", "paxos_pg")}
+    com = {}
+    for name, r in res.items():
+        assert int(r.violations) == 0, name
+        assert r.inscan_violations == 0, name
+        com[name] = int(r.metrics["committed_slots"])
+    assert com["paxos"] == com["paxos_pg"] > 0, com
+    # the gate visibly throttles vs the ungated zipf twin
+    full = simulate(sim_protocol("paxos"), _zipf_cfg(), 8, 80, seed=3)
+    assert com["paxos"] < int(full.metrics["committed_slots"])
+
+
+@pytest.mark.jax
+def test_sim_pure_read_workload_never_mutates_kv():
+    wl = Workload(name="allreads", dist="zipf", theta=0.99,
+                  read_frac=1.0)
+    cfg = apply_workload(
+        SimConfig(n_replicas=3, n_slots=16, n_keys=16), wl)
+    r = simulate(sim_protocol("paxos"), cfg, 4, 60, seed=1)
+    assert int(r.violations) == 0
+    assert int(r.metrics["committed_slots"]) > 0
+    assert not np.asarray(r.state["kv"]).any(), \
+        "reads mutated the kv plane"
+
+
+@pytest.mark.slow   # heavy compile; verify.sh --workload smokes the same pin
+@pytest.mark.jax
+def test_wpaxos_zipf_demand_and_class_split():
+    wl_cfg = apply_workload(
+        SimConfig(n_replicas=6, n_zones=2, n_slots=8, n_keys=16,
+                  n_objects=8, steal_threshold=3, locality=0.8),
+        ZIPF99)
+    r = simulate(sim_protocol("wpaxos"), wl_cfg, 4, 60, seed=0)
+    assert int(r.violations) == 0
+    assert r.inscan_violations == 0
+    assert int(r.metrics["committed_slots"]) > 0
+    split = class_split(r.state)
+    assert split and split["hot"]["n"] > 0, split
+    assert int(r.metrics["wl_hot_n"]) == split["hot"]["n"]
+
+
+@pytest.mark.slow
+@pytest.mark.jax
+def test_wpaxos_skew_drives_object_stealing():
+    """The BENCH_WORKLOAD contrast as a regression: zipf skew
+    concentrates remote demand and churns ownership; the same-shape
+    uniform control barely steals."""
+    base = SimConfig(n_replicas=9, n_zones=3, n_slots=16, n_keys=32,
+                     n_objects=16, steal_threshold=4, locality=0.8)
+    steals = {}
+    for wl_name in ("uniform", "zipf99"):
+        cfg = apply_workload(base, named_workload(wl_name))
+        r = simulate(sim_protocol("wpaxos"), cfg, 8, 120, seed=0)
+        assert int(r.violations) == 0, wl_name
+        steals[wl_name] = int(r.metrics["steals"])
+    assert steals["zipf99"] >= steals["uniform"] + 10, steals
+
+
+# ---- host generators -----------------------------------------------------
+@pytest.mark.host
+def test_open_loop_workload_linearizable_with_class_split():
+    from paxi_tpu.core.config import local_config
+    from paxi_tpu.host.benchmark import OpenLoopBenchmark
+    from paxi_tpu.host.simulation import Cluster
+
+    async def main():
+        cfg = local_config(3, base_port=18940)
+        cfg.addrs = {i: f"chan://olwl/{i}" for i in cfg.addrs}
+        c = Cluster("paxos", cfg=cfg, http=True)
+        await c.start()
+        try:
+            bench = OpenLoopBenchmark(cfg, rates=[400], step_s=1.5,
+                                      conns=2, seed=3, K=64,
+                                      workload=ZIPF99)
+            rep = await bench.run()
+            assert rep["workload"] == "zipf99"
+            s = rep["steps"][0]
+            assert s["errors"] == 0 and s["completed"] > 0, s
+            assert rep["anomalies"] == 0
+            cls = s["key_class_latency"]
+            assert set(cls) == {"hot", "warm", "cold"}
+            assert sum(v["n"] for v in cls.values()) == s["completed"]
+            assert cls["hot"]["n"] > cls["cold"]["n"], cls
+        finally:
+            await c.stop()
+    run(main())
+
+
+@pytest.mark.host
+def test_closed_loop_workload_class_histograms():
+    from paxi_tpu.core.config import Bconfig, local_config
+    from paxi_tpu.host.benchmark import Benchmark
+    from paxi_tpu.host.simulation import Cluster
+
+    async def main():
+        cfg = local_config(3, base_port=18960)
+        cfg.addrs = {i: f"chan://clwl/{i}" for i in cfg.addrs}
+        cfg.benchmark = Bconfig(T=1.5, K=16, W=0.5, concurrency=4,
+                                warmup=0.0)
+        c = Cluster("paxos", cfg=cfg, http=True)
+        await c.start()
+        try:
+            bench = Benchmark(cfg, cfg.benchmark, seed=1,
+                              workload=named_workload("hotrange"))
+            stats = await bench.run()
+            assert stats.ops > 0 and stats.errors == 0
+            assert stats.anomalies == 0
+            by_cls = {}
+            for h in bench.metrics.snapshot()["histograms"]:
+                kc = h.get("labels", {}).get("key_class")
+                if kc is not None:       # one histogram per stream
+                    by_cls[kc] = by_cls.get(kc, 0) + h["count"]
+            assert sum(by_cls.values()) == stats.ops + stats.warmup_ops
+            assert by_cls.get("hot", 0) > by_cls.get("cold", 0), by_cls
+        finally:
+            await c.stop()
+    run(main())
+
+
+# ---- shard router: per-group load counters -------------------------------
+@pytest.mark.host
+def test_router_per_group_command_counters():
+    from paxi_tpu.shard.router import ShardRouter
+    from paxi_tpu.shard.shardmap import ShardMap
+
+    async def main():
+        m = ShardMap.static(2, span=1 << 10)
+        router = ShardRouter(m, ["http://127.0.0.1:1",
+                                 "http://127.0.0.1:2"])
+        try:
+            loop = asyncio.get_running_loop()
+            for key in (1, 2, 3, 600):      # 3 -> group 0, 1 -> group 1
+                router.route_kv(key, b"", loop)
+            snap = router.metrics.snapshot()
+            by_group = {
+                c["labels"]["group"]: c["value"]
+                for c in snap["counters"]
+                if c["name"] == "paxi_router_group_commands_total"}
+            assert by_group == {"0": 3, "1": 1}, by_group
+            total = sum(
+                c["value"] for c in snap["counters"]
+                if c["name"] == "paxi_router_forwards_total")
+            assert total == 4
+        finally:
+            router.close()
+    run(main())
+
+
+# ---- PXW purity lint family ----------------------------------------------
+def test_pxw_fixture_catches_each_check():
+    from paxi_tpu.analysis import workload as wl_lint
+    vs = wl_lint.check(
+        ROOT, files=[ROOT / "tests/fixtures/lint/fixture_workload.py"])
+    assert sorted({v.code for v in vs}) \
+        == ["PXW121", "PXW122", "PXW123"]
+    assert len([v for v in vs if v.code == "PXW121"]) == 2
+    assert len([v for v in vs if v.code == "PXW122"]) == 3
+    assert len([v for v in vs if v.code == "PXW123"]) == 2
+
+
+def test_pxw_repo_tree_is_clean():
+    from paxi_tpu.analysis import workload as wl_lint
+    assert wl_lint.check(ROOT) == []
+
+
+def test_pxw_registered_with_linter():
+    from paxi_tpu.analysis import CODE_PREFIXES, RULES, resolve_rules
+    assert CODE_PREFIXES["PXW"] == "workload-purity"
+    assert "workload-purity" in RULES
+    assert resolve_rules(["PXW"]) == ["workload-purity"]

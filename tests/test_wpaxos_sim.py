@@ -53,6 +53,7 @@ def test_grid_3x3():
     assert int(res.metrics["committed_slots"]) > 0
 
 
+@pytest.mark.slow   # heavy compile; demoted to keep the 870 s tier-1 gate
 def test_grid_3x3_q2():
     # widen the phase-2 grid (q2=2 zones => phase-1 needs Z-q2+1=2):
     # commits now require zone-majorities in TWO zones; safety and
