@@ -38,6 +38,11 @@ engine; guards the PR-16 cross-runtime pinned replay):
 
 - ``workload-purity``      (workload.py,    PXW12x)
 
+Span isolation (taint walk over the protocol host modules; guards the
+obs/ tracing layer's write-only contract):
+
+- ``span-isolation``       (spanrule.py,    PXO13x)
+
 Entry points: ``python -m paxi_tpu lint [--rule ...] [--json]`` (cli.py;
 ``--rule`` takes family names or code prefixes like ``PXQ,PXB``) and
 :func:`run_lint` for tests/tooling.  Intentional exceptions live in
@@ -54,7 +59,7 @@ from typing import Dict, List, Optional, Sequence
 
 from paxi_tpu.analysis import astutil, asyncflow, ballots, concurrency, \
     crossflow, handlers, layout, measure, parity, purity, quorum, \
-    tracemap, workload
+    spanrule, tracemap, workload
 from paxi_tpu.analysis.model import (LintReport, Suppression, Violation,
                                      apply_suppressions, inline_disables,
                                      load_baseline)
@@ -77,6 +82,7 @@ RULES = {
     measure.RULE: measure,
     layout.RULE: layout,
     workload.RULE: workload,
+    spanrule.RULE: spanrule,
 }
 
 # violation-code prefix -> rule family, the CLI's short spelling
@@ -95,6 +101,7 @@ CODE_PREFIXES = {
     "PXM": measure.RULE,
     "PXL": layout.RULE,
     "PXW": workload.RULE,
+    "PXO": spanrule.RULE,
 }
 
 # pair-driven rules (registry-derived sim/host pairs instead of globs)

@@ -130,6 +130,12 @@ def cmd_bench_host(args) -> int:
     cfg.leader_reads = args.leader_reads
     rates = [float(r) for r in args.rates.split(",") if r]
 
+    if args.trace_sample > 0:
+        # head-based sampling at the node HTTP entry; subprocess
+        # clusters inherit the rate via PAXI_TRACE_SAMPLE below
+        from paxi_tpu.obs import set_sample_rate
+        set_sample_rate(args.trace_sample)
+
     wl = None
     if getattr(args, "workload", ""):
         from paxi_tpu.workload import named_workload
@@ -175,6 +181,19 @@ def cmd_bench_host(args) -> int:
         finally:
             conn.close()
 
+    async def scrape_spans(target_cfg):
+        """Every node's GET /spans, merged and reduced to the
+        five-phase decomposition (queue/batch/quorum/exec/writeback)
+        — the bench-row payload that measures where a command's time
+        went instead of inferring it."""
+        from paxi_tpu.host.client import Client
+        from paxi_tpu.obs import aggregate_phases
+        cl = Client(target_cfg)
+        try:
+            return aggregate_phases(await cl.spans_all())
+        finally:
+            cl.close()
+
     def wait_http(url, timeout_s=20.0):
         return asyncio.run(wait_listening(url, timeout_s=timeout_s))
 
@@ -199,7 +218,8 @@ def cmd_bench_host(args) -> int:
         proc = subprocess.Popen(
             [sys.executable, "-m", "paxi_tpu", "server", "-simulation",
              "-algorithm", args.algorithm, "-config", cfg_path],
-            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PAXI_TRACE_SAMPLE": str(args.trace_sample)})
         try:
             if not wait_http(cfg.http_addrs[cfg.ids[0]]):
                 print("bench-host: cluster subprocess never came up",
@@ -213,6 +233,8 @@ def cmd_bench_host(args) -> int:
                 out["cluster_metrics"] = asyncio.run(scrape_metrics(cfg))
             else:
                 out = asyncio.run(_closed_loop(args, cfg))
+            if args.trace_sample > 0:
+                out["span_phases"] = asyncio.run(scrape_spans(cfg))
         finally:
             proc.terminate()
             try:
@@ -239,6 +261,11 @@ def cmd_bench_host(args) -> int:
                 from paxi_tpu.metrics import merge_snapshots
                 out["cluster_metrics"] = merge_snapshots(
                     r.metrics.snapshot() for r in c.replicas.values())
+                if args.trace_sample > 0:
+                    from paxi_tpu.obs import aggregate_phases, merge
+                    out["span_phases"] = aggregate_phases(merge(
+                        [r.spans.export()
+                         for r in c.replicas.values()]))
                 return out
             finally:
                 await c.stop()
@@ -842,6 +869,74 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_spans(args) -> int:
+    """Span timelines: render (ASCII) or export (Chrome trace-event
+    JSON for chrome://tracing / Perfetto).
+
+    Sources: ``-url`` scrapes a node's or the shard router's
+    ``GET /spans``; ``-file`` reads a JSON artifact and collects every
+    span list inside it (a raw ``[{span}, ...]`` dump, a ``{"spans":
+    [...]}`` scrape, or a bench/replay artifact embedding one)."""
+    import urllib.request
+
+    from paxi_tpu.obs import (ascii_timeline, chrome_trace, merge,
+                              orphans, stitched_traces, validate_spans)
+
+    def _find_spans(doc, out):
+        if isinstance(doc, dict):
+            s = doc.get("spans")
+            if (isinstance(s, list)
+                    and all(isinstance(d, dict) and "sid" in d
+                            for d in s)):
+                out.append(s)
+                doc = {k: v for k, v in doc.items() if k != "spans"}
+            for v in doc.values():
+                _find_spans(v, out)
+        elif isinstance(doc, list):
+            if doc and all(isinstance(d, dict) and "sid" in d
+                           and "trace" in d for d in doc):
+                out.append(doc)
+            else:
+                for v in doc:
+                    _find_spans(v, out)
+
+    if args.url:
+        base = args.url.rstrip("/")
+        with urllib.request.urlopen(base + "/spans", timeout=10) as r:
+            lists = [json.load(r)["spans"]]
+    else:
+        if not args.file:
+            print("spans: need -url or -file", file=sys.stderr)
+            return 2
+        with open(args.file) as f:
+            doc = json.load(f)
+        lists = []
+        _find_spans(doc, lists)
+        if not lists:
+            print(f"spans: no span lists found in {args.file}",
+                  file=sys.stderr)
+            return 1
+    spans = merge(lists)
+    errs = validate_spans(spans)
+    if errs:
+        print("spans: schema violations:\n  " + "\n  ".join(errs[:20]),
+              file=sys.stderr)
+        return 1
+    if args.spans_cmd == "export":
+        text = json.dumps(chrome_trace(spans), indent=1)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text + "\n")
+        return 0
+    sys.stdout.write(ascii_timeline(spans, width=args.width))
+    print(f"{len(spans)} spans, "
+          f"{len(stitched_traces(spans))} stitched traces, "
+          f"{len(orphans(spans))} orphans")
+    return 0
+
+
 def cmd_lint(args) -> int:
     """paxi-lint: the protocol-aware static analyzer (paxi_tpu/analysis).
 
@@ -1013,6 +1108,11 @@ def main(argv=None) -> int:
     bh.add_argument("-txns", "--txns", type=int, default=8,
                     help="cross-shard 2PC transactions fired after "
                          "the ramp (atomicity oracle)")
+    bh.add_argument("-trace_sample", "--trace-sample",
+                    dest="trace_sample", type=float, default=0.0,
+                    help="span sampling rate 0..1 (0 = tracing off); "
+                         "adds the five-phase latency decomposition "
+                         "(span_phases) to the artifact")
     bh.set_defaults(fn=cmd_bench_host)
 
     r = sub.add_parser("cmd", help="admin REPL")
@@ -1236,6 +1336,26 @@ def main(argv=None) -> int:
     me.add_argument("-p_dup", type=float, default=0.0)
     me.add_argument("-max_delay", type=int, default=1)
     me.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("spans",
+                        help="span timelines: ASCII render or Chrome "
+                             "trace-event export (paxi_tpu/obs)")
+    spsub = sp.add_subparsers(dest="spans_cmd", required=True)
+    for name, desc in (("render", "ASCII timeline per trace"),
+                       ("export", "Chrome trace-event JSON "
+                                  "(chrome://tracing / Perfetto)")):
+        ssp = spsub.add_parser(name, help=desc)
+        ssp.add_argument("-url", "--url", default="",
+                         help="a node's or the shard router's HTTP "
+                              "base (scrapes GET /spans)")
+        ssp.add_argument("-file", "--file", default="",
+                         help="a JSON artifact with embedded span "
+                              "lists (scrape dump, bench artifact)")
+        ssp.add_argument("-out", "--out", default="",
+                         help="write output here instead of stdout")
+        ssp.add_argument("-width", "--width", type=int, default=48,
+                         help="render: bar width in characters")
+    sp.set_defaults(fn=cmd_spans)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -33,6 +33,7 @@ import threading
 from typing import Any, Callable, List, Optional
 
 from paxi_tpu.metrics import Registry
+from paxi_tpu.obs import ctx_of
 
 
 class BatchBuffer:
@@ -40,13 +41,19 @@ class BatchBuffer:
 
     def __init__(self, flush_fn: Callable[[List[Any]], None],
                  max_size: int = 64, max_wait: float = 0.0,
-                 metrics: Optional[Registry] = None, **labels: str):
+                 metrics: Optional[Registry] = None, spans=None,
+                 **labels: str):
         """``labels`` become extra metric dimensions — the commit path
         uses none (its metric identity predates them), the forwarding
         path tags ``path="forward"`` so the two pipelines stay
-        separable in /metrics."""
+        separable in /metrics.  ``spans`` (an obs.SpanCollector) makes
+        residency observable: each *traced* item opens a ``batch`` span
+        on add and closes it on flush — the batch-wait phase of the
+        five-phase latency decomposition."""
         self._lock = threading.Lock()
         self._flush_fn = flush_fn
+        self._spans = spans
+        self._span_labels = dict(labels)
         self._items: List[Any] = []
         self._handle = None          # scheduled tick/timer flush
         self._loop = None            # cached on first add (one loop)
@@ -87,6 +94,9 @@ class BatchBuffer:
                         self.max_wait, self._flush, "timer")
                 else:
                     self._handle = loop.call_soon(self._flush, "tick")
+        if self._spans is not None:
+            self._spans.open(("batch", id(item)), "batch",
+                             ctx_of(item), **self._span_labels)
         if fire:
             self._flush("size")
 
@@ -104,6 +114,9 @@ class BatchBuffer:
             handle.cancel()   # no-op for the handle that fired us
         if not items:
             return
+        if self._spans is not None:
+            for it in items:
+                self._spans.close(("batch", id(it)))
         self._flush_counters[cause].inc()
         self._cmds_total.inc(len(items))
         self._fill_hist.observe(float(len(items)))

@@ -251,6 +251,33 @@ class Client:
             raise IOError(headers.get("err", f"http {status}"))
         return payload
 
+    async def spans(self, id: Optional[ID] = None,
+                    clear: bool = False) -> list:
+        """Scrape one node's span export (``GET /spans``) — the raw
+        per-node list; callers stitch fleet-wide views with
+        ``obs.merge`` / ``obs.trees``."""
+        path = "/spans?clear=1" if clear else "/spans"
+        status, headers, payload = await self._conn(ID(id) if id else
+                                                    self.id).request(
+            "GET", path, {}, b"")
+        if status != 200:
+            raise IOError(headers.get("err", f"http {status}"))
+        return json.loads(payload.decode())["spans"]
+
+    async def spans_all(self, clear: bool = False) -> list:
+        """Every configured node's spans, merged into one canonically
+        ordered list (obs.stitch.merge)."""
+        from paxi_tpu.obs import merge
+        lists = []
+        for i in self.cfg.ids:
+            if i not in self.cfg.http_addrs:
+                continue
+            try:
+                lists.append(await self.spans(i, clear=clear))
+            except (IOError, OSError):
+                pass
+        return merge(lists)
+
     async def transaction(self, ops, id: Optional[ID] = None) -> list:
         """msg.go Transaction: [(key, value), ...] packed into one
         protocol-ordered command and applied atomically by the state

@@ -21,6 +21,14 @@ backed-up outbound queue pays one length header + one write syscall for
 the whole burst instead of one per message.  ``decode_all`` is the
 receive-side inverse: it yields every message in a body whichever kind
 it is, so listeners handle plain and coalesced frames uniformly.
+
+Trace-context pass-through contract (paxi_tpu/obs): a sampled request's
+context rides ``properties["trace"]`` on ``WireRequest`` — there is no
+new wire frame for tracing.  Both codecs and BATCH coalescing must
+round-trip a message's ``properties`` dict EXACTLY (str keys, str
+values); ``roundtrip`` below is the helper the obs tests pin this with,
+so a codec change that drops or reorders properties fails loudly
+instead of silently orphaning span trees.
 """
 
 from __future__ import annotations
@@ -170,6 +178,17 @@ class Codec:
 
 def encode_stream(codec: Codec, msg: Any) -> bytes:
     return codec.encode(msg)
+
+
+def roundtrip(codec: Codec, *msgs: Any) -> list:
+    """Encode ``msgs`` (BATCH-coalesced when several) and decode them
+    back through the full framing path — the contract-pinning helper
+    for pass-through fields like the obs trace context."""
+    if len(msgs) == 1:
+        frame = codec.encode(msgs[0])
+    else:
+        frame = codec.encode_batch(msgs)
+    return codec.decode_all(frame[4:])
 
 
 def decode_from(codec: Codec, buf: bytes) -> Tuple[Any, bytes]:

@@ -113,6 +113,13 @@ class VirtualClockFabric:
         the settle — the fabric's analog of the sim's workload draw)."""
         self._on_step.append(fn)
 
+    def clock(self) -> float:
+        """The observability timestamp domain under replay: span
+        collectors (obs/collect.py) stamp t0/t1 with the current
+        logical step, so two replays of one schedule emit
+        byte-identical span timelines."""
+        return float(self.step)
+
     def install_switch(self, tier) -> None:
         """Interpose a switchnet ``SwitchTier`` on the wire (see
         ``__init__``; paxi_tpu/switchnet/switch.py)."""

@@ -50,6 +50,8 @@ if TYPE_CHECKING:
     from paxi_tpu.host.node import Node
 
 from paxi_tpu.host.transport import parse_addr
+from paxi_tpu.obs import TRACE_PROP, TraceCtx, new_trace_id, \
+    process_sampler
 
 
 def _response(status: int, body: bytes = b"",
@@ -103,6 +105,11 @@ class HTTPServer:
     def __init__(self, node: "Node"):
         self.node = node
         self._node_id = str(node.id)
+        # head-based sampling happens HERE when this server is the
+        # entry tier (obs/sample.py): one decide() per command, and a
+        # command arriving with an upstream trace context (router-
+        # sampled, Property-Trace) is never re-sampled
+        self._sampler = process_sampler()
         self._server = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         # (deadline, response-slot) in deadline order, reaped by ONE
@@ -294,9 +301,11 @@ class HTTPServer:
             return None
         part = path.strip("/")
         if part == "transaction" and method == "POST":
+            props = {k[9:]: headers[k] for k in headers
+                     if k[:9] == "property-"}
             return self._enqueue_txn(
                 body, headers.get("client-id", ""),
-                int(headers.get("command-id", "0")))
+                int(headers.get("command-id", "0")), props)
         if not part or "/" in part:
             return None
         try:
@@ -333,6 +342,13 @@ class HTTPServer:
             else:
                 _slot.set_result(_OK_EMPTY)   # write ack: prebuilt
 
+        sp = self._entry_span(props, "key", str(key))
+        if sp is not None:
+            props = dict(props or {})
+            props[TRACE_PROP] = sp.child().encode()
+            spans = self.node.spans
+            slot.add_done_callback(
+                lambda _s, _sp=sp: spans.finish(_sp))
         self._timeouts.append((loop.time() + self.REQUEST_TIMEOUT, slot))
         self.node.handle_client_request(Request(
             command=Command(key, value, client_id, command_id),
@@ -340,8 +356,21 @@ class HTTPServer:
             node_id=self._node_id, reply_to=reply_cb))
         return slot
 
+    def _entry_span(self, props: Optional[dict], lk: str, lv: str):
+        """Root or serve span for one inbound command: an upstream
+        context (router-sampled) opens a ``serve`` child; otherwise the
+        sampler decides once and a hit opens a ``request`` root.  None
+        == unsampled (the common case: one dict lookup + one compare)."""
+        tc = TraceCtx.decode(props.get(TRACE_PROP)) if props else None
+        if tc is not None:
+            return self.node.spans.start("serve", tc, **{lk: lv})
+        if self._sampler.decide():
+            return self.node.spans.start(
+                "request", TraceCtx(new_trace_id()), **{lk: lv})
+        return None
+
     def _enqueue_txn(self, body: bytes, client_id: str,
-                     command_id: int):
+                     command_id: int, props: Optional[dict] = None):
         """Non-blocking Transaction dispatch (msg.go Transaction; see
         _transaction's docstring for semantics/caveats): the batch
         packs into ONE command/slot and the response slot resolves on
@@ -374,12 +403,19 @@ class HTTPServer:
                  "values": [v.decode("latin1") for v in values]}).encode()
             _slot.set_result(_OK_TMPL % len(out) + out)
 
+        sp = self._entry_span(props, "txn", str(len(cmds)))
+        if sp is not None:
+            props = dict(props or {})
+            props[TRACE_PROP] = sp.child().encode()
+            spans = self.node.spans
+            slot.add_done_callback(
+                lambda _s, _sp=sp: spans.finish(_sp))
         self._timeouts.append((loop.time() + self.REQUEST_TIMEOUT, slot))
         self.node.handle_client_request(Request(
             command=Command(cmds[0].key, pack_transaction(cmds),
                             client_id, command_id),
-            timestamp=time.time(), node_id=self._node_id,
-            reply_to=reply_cb))
+            properties=props or {}, timestamp=time.time(),
+            node_id=self._node_id, reply_to=reply_cb))
         return slot
 
     async def _route(self, method: str, path: str,
@@ -403,6 +439,19 @@ class HTTPServer:
                 200, self.node.metrics.prometheus().encode(),
                 {"Content-Type":
                  "text/plain; version=0.0.4; charset=utf-8"})
+        if parts and parts[0] == "spans":
+            # causal-span scrape surface (paxi_tpu/obs/): the finished-
+            # span ring as JSON; ?clear=1 drains it (benches scrape
+            # once per run).  The sibling of GET /metrics.
+            if method != "GET":
+                return _response(405, b"", {"Err": "GET only"})
+            q = parse_qs(url.query)
+            doc = {"node": self._node_id,
+                   "spans": self.node.spans.export()}
+            if q.get("clear", [""])[0] in ("1", "true"):
+                self.node.spans.clear()
+            return _response(200, json.dumps(doc).encode(),
+                             {"Content-Type": "application/json"})
         if parts and parts[0] == "local" and len(parts) == 2:
             # msg.go Read: a raw non-linearized probe of the local store
             if method != "GET":
@@ -522,14 +571,26 @@ class HTTPServer:
             key = int(doc.get("key", 0))
         except (ValueError, KeyError, TypeError, AttributeError) as e:
             return _response(400, b"", {"Err": repr(e)})
+        # participant-side span: the coordinator's record context rides
+        # doc["trace"], so the group's replication of this record (and
+        # its own batch/quorum/exec children) stitches into the one
+        # cross-shard transaction tree
+        sp = self.node.spans.start(
+            "tpc", TraceCtx.decode(doc.get("trace")),
+            record=doc["kind"], txid=doc["txid"])
+        props = ({TRACE_PROP: sp.child().encode()} if sp is not None
+                 else {})
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self.node.handle_client_request(Request(
-            command=Command(key, value), timestamp=time.time(),
+            command=Command(key, value), properties=props,
+            timestamp=time.time(),
             node_id=self._node_id, reply_to=fut))
         try:
             rep = await asyncio.wait_for(fut, timeout=10.0)
         except asyncio.TimeoutError:
             return _response(500, b"", {"Err": "2pc record timed out"})
+        finally:
+            self.node.spans.finish(sp)
         if rep.err:
             return _response(500, b"", {"Err": str(rep.err)})
         return _response(200, rep.value or b"")

@@ -32,6 +32,7 @@ from paxi_tpu.host.codec import Codec, register_message
 from paxi_tpu.host.http import HTTPServer
 from paxi_tpu.host.socket import Socket
 from paxi_tpu.metrics import Registry
+from paxi_tpu.obs import SpanCollector
 
 
 @register_message
@@ -95,6 +96,11 @@ class Node:
         # use_fabric() context so replica factories need no new argument
         self.socket = Socket(self.id, cfg, codec, metrics=self.metrics,
                              fabric=fabric)
+        # per-node span ring (paxi_tpu/obs/): clocked by the socket's
+        # resolved fabric under replay, perf_counter live; exported as
+        # GET /spans next to the registry's GET /metrics
+        self.spans = SpanCollector(node=str(self.id),
+                                   fabric=self.socket.fabric)
         self.db = Database(cfg.multi_version)
         self.handles: Dict[type, Callable[[Any], None]] = {}
         self.http: Optional[HTTPServer] = None
@@ -215,7 +221,7 @@ class Node:
             buf = self._fwd_buf[to] = BatchBuffer(
                 lambda items, _to=to: self._flush_forwards(_to, items),
                 max_size=self.cfg.batch_size, max_wait=0.0,
-                metrics=self.metrics, path="forward")
+                metrics=self.metrics, spans=self.spans, path="forward")
         buf.add(wr)
 
     def _flush_forwards(self, to: ID, items: list) -> None:

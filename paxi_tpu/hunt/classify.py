@@ -53,13 +53,24 @@ class HostOutcome:
     ops_failed: int = 0
     steps: int = 0
     fabric_stats: Dict[str, int] = field(default_factory=dict)
+    # merged span timeline of the replay (obs/): fabric-clock
+    # timestamps + deterministic trace/span ids, so two replays of one
+    # witness produce byte-identical timelines (render with
+    # ``python -m paxi_tpu spans render``)
+    spans: list = field(default_factory=list)
 
     @property
     def violated(self) -> bool:
         return self.anomalies > 0 or self.oracle_violations > 0
 
     def to_json(self) -> dict:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        # the timeline is an artifact, not a verdict: classification
+        # JSON carries the count; callers render the full timeline off
+        # the outcome object (cli `spans render`)
+        d.pop("spans")
+        d["span_count"] = len(self.spans)
+        return d
 
 
 @dataclass
@@ -225,6 +236,7 @@ async def replay_schedule(algorithm: str, scfg, sched, *, cfg=None,
     from paxi_tpu.host.history import History
     from paxi_tpu.host.simulation import Cluster, chan_config
     from paxi_tpu.core.command import Command, Request
+    from paxi_tpu.obs import TRACE_PROP, SpanCollector, TraceCtx, merge
     from paxi_tpu.protocols import _HOST_MODULES
 
     if cfg is None:
@@ -245,6 +257,7 @@ async def replay_schedule(algorithm: str, scfg, sched, *, cfg=None,
     out = HostOutcome(steps=sched.n_steps)
     history = None
     ops: list = []
+    col = None
     try:
         driver = getattr(host_mod, "HUNT_DRIVER", None)
         if driver is not None:
@@ -258,18 +271,28 @@ async def replay_schedule(algorithm: str, scfg, sched, *, cfg=None,
             rng = random.Random(seed)
             ids = sorted(cluster.ids)
             n_keys = max(1, min(scfg.n_keys, 4))
+            # harness-side collector: every injected op opens a root
+            # span with a DETERMINISTIC trace id (h<op#>) on the
+            # fabric clock — no sampler, no pid — so the stitched
+            # timeline of a witness replay is itself replayable
+            col = SpanCollector(node="client", fabric=fabric)
 
-            async def one_op(replica, key: int, value: bytes):
+            async def one_op(replica, key: int, value: bytes, sp):
                 fut = asyncio.get_running_loop().create_future()
                 start = time.monotonic()
+                props = ({TRACE_PROP: sp.child().encode()}
+                         if sp is not None else {})
                 cluster[replica].handle_client_request(Request(
                     command=Command(key, value, "hunt",
-                                    len(ops)), reply_to=fut))
+                                    len(ops)), properties=props,
+                    reply_to=fut))
                 try:
                     rep = await asyncio.wait_for(fut, op_timeout)
                 except asyncio.TimeoutError:
                     out.ops_failed += 1
                     return
+                finally:
+                    col.finish(sp)
                 end = time.monotonic()
                 if rep.err is not None:
                     out.ops_failed += 1
@@ -287,8 +310,11 @@ async def replay_schedule(algorithm: str, scfg, sched, *, cfg=None,
                 key = rng.randrange(n_keys)
                 write = rng.random() < 0.6
                 value = f"w{t}".encode() if write else b""
+                sp = col.start("request", TraceCtx(f"h{len(ops)}"),
+                               key=str(key),
+                               op="w" if write else "r")
                 ops.append(asyncio.ensure_future(
-                    one_op(replica, key, value)))
+                    one_op(replica, key, value, sp)))
 
             fabric.on_step(issue)
 
@@ -309,6 +335,11 @@ async def replay_schedule(algorithm: str, scfg, sched, *, cfg=None,
         if oracle is not None:
             out.oracle_violations = int(oracle(cluster))
         out.fabric_stats = dict(fabric.stats)
+        span_lists = [r.spans.export()
+                      for r in cluster.replicas.values()]
+        if col is not None:
+            span_lists.append(col.export())
+        out.spans = merge(span_lists)
     finally:
         await cluster.stop()
     return out

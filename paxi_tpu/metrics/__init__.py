@@ -22,9 +22,11 @@ vocabulary:
   pinned replay is a determinism check on top of the state hash.
 """
 
-from paxi_tpu.metrics.registry import (HIST_BOUNDS, Counter, Histogram,
-                                       Registry, merge_snapshots,
-                                       parse_prometheus, pretty)
+from paxi_tpu.metrics.registry import (HIST_BOUNDS, Counter, Gauge,
+                                       Histogram, Registry,
+                                       merge_snapshots, parse_prometheus,
+                                       pretty, render_prometheus)
 
-__all__ = ["Counter", "Histogram", "Registry", "HIST_BOUNDS",
-           "merge_snapshots", "parse_prometheus", "pretty"]
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "HIST_BOUNDS",
+           "merge_snapshots", "parse_prometheus", "pretty",
+           "render_prometheus"]

@@ -42,6 +42,29 @@ class Counter:
         self.value += n
 
 
+class Gauge:
+    """A settable instantaneous level (queue depth, in-flight count).
+
+    Merge semantics across nodes is SUM: the fleet-level depth is the
+    sum of per-node depths, the same way Prometheus users sum gauge
+    series — a last-writer-wins merge would be meaningless for
+    scrape-skewed snapshots."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def inc(self, n: float = 1) -> None:
+        self.value += n
+
+    def dec(self, n: float = 1) -> None:
+        self.value -= n
+
+
 class Histogram:
     """Fixed-bucket log-spaced histogram; merge is exact (see module
     docstring).  Tracks exact sum/min/max alongside bucket counts."""
@@ -145,6 +168,7 @@ class Registry:
         self.labels = {k: str(v) for k, v in labels.items()}
         self._counters: Dict[Tuple[str, tuple], Counter] = {}
         self._hists: Dict[Tuple[str, tuple], Histogram] = {}
+        self._gauges: Dict[Tuple[str, tuple], Gauge] = {}
 
     def counter(self, name: str, **labels: str) -> Counter:
         key = (name, _label_key(labels))
@@ -152,6 +176,13 @@ class Registry:
         if c is None:
             c = self._counters[key] = Counter()
         return c
+
+    def gauge(self, name: str, **labels: str) -> Gauge:
+        key = (name, _label_key(labels))
+        g = self._gauges.get(key)
+        if g is None:
+            g = self._gauges[key] = Gauge()
+        return g
 
     def histogram(self, name: str, **labels: str) -> Histogram:
         key = (name, _label_key(labels))
@@ -171,6 +202,10 @@ class Registry:
                 {"name": n, "labels": self._full_labels(lk),
                  "value": c.value}
                 for (n, lk), c in self._counters.items()],
+            "gauges": [
+                {"name": n, "labels": self._full_labels(lk),
+                 "value": g.value}
+                for (n, lk), g in self._gauges.items()],
             "histograms": [
                 {"name": n, "labels": self._full_labels(lk),
                  **h.to_snapshot()}
@@ -186,6 +221,7 @@ def merge_snapshots(snaps: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     """Aggregate snapshots: counters with identical (name, labels) add;
     histograms bucket-merge exactly (shared bounds)."""
     counters: Dict[Tuple[str, tuple], int] = {}
+    gauges: Dict[Tuple[str, tuple], float] = {}
     hists: Dict[Tuple[str, tuple], Histogram] = {}
     labels: Dict[Tuple[str, tuple], Dict[str, str]] = {}
     for snap in snaps:
@@ -193,6 +229,10 @@ def merge_snapshots(snaps: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
             key = (c["name"], _label_key(c.get("labels", {})))
             counters[key] = counters.get(key, 0) + int(c["value"])
             labels[key] = dict(c.get("labels", {}))
+        for g in snap.get("gauges", []):
+            key = (g["name"], _label_key(g.get("labels", {})))
+            gauges[key] = gauges.get(key, 0.0) + float(g["value"])
+            labels[key] = dict(g.get("labels", {}))
         for hs in snap.get("histograms", []):
             key = (hs["name"], _label_key(hs.get("labels", {})))
             h = Histogram.from_snapshot(hs)
@@ -204,6 +244,8 @@ def merge_snapshots(snaps: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     return {
         "counters": [{"name": n, "labels": labels[(n, lk)], "value": v}
                      for (n, lk), v in counters.items()],
+        "gauges": [{"name": n, "labels": labels[(n, lk)], "value": v}
+                   for (n, lk), v in gauges.items()],
         "histograms": [{"name": n, "labels": labels[(n, lk)],
                         **h.to_snapshot()}
                        for (n, lk), h in hists.items()],
@@ -226,6 +268,12 @@ def render_prometheus(snap: Dict[str, Any]) -> str:
             out.append(f"# TYPE {c['name']} counter")
             seen_type.add(c["name"])
         out.append(f"{c['name']}{_fmt_labels(c['labels'])} {c['value']}")
+    for g in snap.get("gauges", []):
+        if g["name"] not in seen_type:
+            out.append(f"# TYPE {g['name']} gauge")
+            seen_type.add(g["name"])
+        out.append(f"{g['name']}{_fmt_labels(g['labels'])} "
+                   f"{g['value']:.9g}")
     for hs in snap.get("histograms", []):
         name = hs["name"]
         if name not in seen_type:
@@ -284,6 +332,15 @@ def pretty(snap: Dict[str, Any]) -> str:
         for c in counters:
             tag = c["name"] + _fmt_labels(c["labels"])
             lines.append(f"  {tag:<{width}}  {c['value']}")
+    gauges = sorted(snap.get("gauges", []),
+                    key=lambda g: (g["name"], sorted(g["labels"].items())))
+    if gauges:
+        lines.append("gauges:")
+        width = max(len(g["name"] + _fmt_labels(g["labels"]))
+                    for g in gauges)
+        for g in gauges:
+            tag = g["name"] + _fmt_labels(g["labels"])
+            lines.append(f"  {tag:<{width}}  {g['value']:g}")
     hists = sorted(snap.get("histograms", []),
                    key=lambda h: (h["name"], sorted(h["labels"].items())))
     if hists:

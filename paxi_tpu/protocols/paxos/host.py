@@ -36,6 +36,7 @@ from paxi_tpu.core.quorum import Quorum
 from paxi_tpu.host.batch import BatchBuffer
 from paxi_tpu.host.codec import register_message
 from paxi_tpu.host.node import Node
+from paxi_tpu.obs import ctx_of
 
 
 def _wire_cmds(cmds: List[Command]) -> List[list]:
@@ -190,7 +191,7 @@ class PaxosReplica(Node):
             self._flush_batch, max_size=cfg.batch_size,
             max_wait=0.0 if self.socket.fabric is not None
             else cfg.batch_wait,
-            metrics=self.metrics)
+            metrics=self.metrics, spans=self.spans)
         self.register(Request, self.handle_request)
         self.register(P1a, self.handle_p1a)
         self.register(P1b, self.handle_p1b)
@@ -331,6 +332,13 @@ class PaxosReplica(Node):
         q.ack(self.id)
         self.log[slot] = Entry(self.ballot, cmds, requests=reqs, quorum=q,
                                timestamp=time.time())
+        # quorum spans for traced requests: opened per batch member at
+        # P2a broadcast, closed as one group on majority (_commit).
+        # Write-only span traffic — PXO13x pins that no span value ever
+        # flows back into protocol state or decisions.
+        for i, r in enumerate(reqs):
+            self.spans.open(("q", slot, i), "quorum", ctx_of(r),
+                            slot=str(slot))
         self.socket.broadcast(self._make_p2a(slot, cmds))
         if q.majority():  # single-replica cluster
             self._commit(slot)
@@ -526,6 +534,7 @@ class PaxosReplica(Node):
     def _commit(self, slot: int) -> None:
         e = self.log[slot]
         e.commit = True
+        self.spans.close_group(("q", slot))
         self._renew_lease(e.timestamp)   # quorum round started then
         self.socket.broadcast(self.P3_CLS(self.ballot, slot, _wire_cmds(e.cmds)))
         self._exec()
@@ -573,12 +582,18 @@ class PaxosReplica(Node):
                         # reply with the recorded outcome, never re-apply
                         value = last[1] if cmd.command_id == last[0] else b""
                     else:
+                        self.spans.open(("x", self.execute, i), "exec",
+                                        ctx_of(req))
                         value = self.db.execute(cmd)
+                        self.spans.close(("x", self.execute, i))
                         if cmd.client_id:
                             self.ctab[cmd.client_id] = (cmd.command_id,
                                                         value)
                     if req is not None:
+                        self.spans.open(("w", self.execute, i),
+                                        "writeback", ctx_of(req))
                         req.reply(Reply(cmd, value=value))
+                        self.spans.close(("w", self.execute, i))
                 elif req is not None:
                     req.reply(Reply(cmd, err="noop"))
             e.requests = []

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 from typing import Dict, List, Optional
 
 from paxi_tpu.core.config import Config
@@ -161,14 +162,24 @@ async def shard_ramp(algorithm: str = "paxos", shards: int = 2,
         maps = worker_key_maps(sc.map, G, workers, K)
 
         async def phase(name: str) -> List[Dict]:
-            outs = await asyncio.gather(*[
-                OpenLoopBenchmark(
-                    rcfg, rates=[r / workers for r in rates],
-                    step_s=step_s, seed=seed + 101 * w, conns=conns,
-                    W=W, K=K, client_tag=f"{name[:1]}{w}w",
-                    linearizability_check=lin, drain_s=drain_s,
-                    key_map=maps[w][name]).run()
-                for w in range(workers)])
+            traj: List[Dict] = []
+            sampler = asyncio.ensure_future(
+                _gauge_sampler(sc.router, traj))
+            try:
+                outs = await asyncio.gather(*[
+                    OpenLoopBenchmark(
+                        rcfg, rates=[r / workers for r in rates],
+                        step_s=step_s, seed=seed + 101 * w, conns=conns,
+                        W=W, K=K, client_tag=f"{name[:1]}{w}w",
+                        linearizability_check=lin, drain_s=drain_s,
+                        key_map=maps[w][name]).run()
+                    for w in range(workers)])
+            finally:
+                sampler.cancel()
+                try:
+                    await sampler
+                except asyncio.CancelledError:
+                    pass
             steps = []
             for i, r in enumerate(rates):
                 steps.append({
@@ -192,7 +203,8 @@ async def shard_ramp(algorithm: str = "paxos", shards: int = 2,
                      "anomalies": (sum(o["anomalies"] or 0
                                        for o in outs) if lin else None),
                      "peak_ops_s": max(s["achieved_ops_s"]
-                                       for s in steps)}]
+                                       for s in steps),
+                     "router_gauges": _traj_report(traj)}]
 
         phases = await phase("disjoint") + await phase("crossing")
         group_fwd_base: Dict[str, int] = {}
@@ -218,6 +230,11 @@ async def shard_ramp(algorithm: str = "paxos", shards: int = 2,
             "map_swaps": _counter(router_metrics,
                                   "paxi_router_map_swaps_total"),
             "group_commands": _group_counters(router_metrics),
+            # drained endpoint: both gauges must have settled to zero
+            "pending_depth": _gauge_values(
+                router_metrics, "paxi_router_pending_depth"),
+            "inflight": _gauge_values(router_metrics,
+                                      "paxi_router_inflight"),
         }
         if workload:
             total = _group_counters(router_metrics)
@@ -302,6 +319,55 @@ async def _hot_phase(wl_name: str, rcfg: Config, shard_map,
     return [{"phase": "hot", "workload": wl.name, "steps": steps,
              "anomalies": None,
              "peak_ops_s": max(s["achieved_ops_s"] for s in steps)}]
+
+
+def _gauge_values(snap: Dict, name: str) -> Dict[str, float]:
+    """Per-group gauge values keyed by the ``group`` label."""
+    out: Dict[str, float] = {}
+    for g in snap.get("gauges", []):
+        if g["name"] == name:
+            k = g.get("labels", {}).get("group", "?")
+            out[k] = out.get(k, 0) + g["value"]
+    return out
+
+
+async def _gauge_sampler(router, out: List[Dict],
+                         interval: float = 0.4) -> None:
+    """Poll the router-tier gauges (per-group pending-queue depth +
+    in-flight commands) while a phase's workers run, building the
+    queue-trajectory evidence for WHERE the bottleneck sits: depth
+    growing on one group = that group's leader saturating; depth flat
+    while in-flight climbs = the shared router/serving tier."""
+    t0 = time.monotonic()
+    while True:
+        snap = await router.metrics_snapshot()
+        out.append({
+            "t_s": round(time.monotonic() - t0, 2),
+            "pending_depth": _gauge_values(
+                snap, "paxi_router_pending_depth"),
+            "inflight": _gauge_values(snap, "paxi_router_inflight"),
+        })
+        await asyncio.sleep(interval)
+
+
+def _traj_report(traj: List[Dict], keep: int = 24) -> Dict:
+    """Gauge trajectory -> artifact row: per-group maxima plus the
+    (thinned) time series."""
+    if not traj:
+        return {"samples": 0}
+    maxes: Dict[str, Dict[str, float]] = {"pending_depth": {},
+                                          "inflight": {}}
+    for s in traj:
+        for kind in ("pending_depth", "inflight"):
+            for g, v in s[kind].items():
+                maxes[kind][g] = max(maxes[kind].get(g, 0), v)
+    step = max(1, len(traj) // keep)
+    return {"samples": len(traj),
+            "max_pending_depth": {g: maxes["pending_depth"][g]
+                                  for g in sorted(maxes["pending_depth"])},
+            "max_inflight": {g: maxes["inflight"][g]
+                             for g in sorted(maxes["inflight"])},
+            "trajectory": traj[::step]}
 
 
 def _counter(snap: Dict, name: str) -> int:

@@ -121,7 +121,8 @@ class ShardedCluster:
             self.router = ShardRouter(
                 self.map, urls,
                 lease_s=self.cfgs[0].lease_s,
-                group_scrape=self._scrape_groups)
+                group_scrape=self._scrape_groups,
+                group_scrape_spans=self._scrape_spans)
             self.server = RouterServer(
                 self.router, f"http://127.0.0.1:{self.router_port}")
             await self.server.start()
@@ -183,4 +184,31 @@ class ShardedCluster:
                 out.append([])
             finally:
                 conn.close()
+        return out
+
+    async def _scrape_spans(self) -> List[List[Dict]]:
+        """Per-group span exports for the router's /spans stitching —
+        the span twin of ``_scrape_groups`` (the router stamps the
+        ``group`` label before merging)."""
+        if self.clusters:
+            return [[d for r in c.replicas.values()
+                     for d in r.spans.export()]
+                    for c in self.clusters]
+        from paxi_tpu.host.client import _Conn
+        out: List[List[Dict]] = []
+        for cfg in self.cfgs:
+            group: List[Dict] = []
+            for i in cfg.ids:
+                conn = _Conn(cfg.http_addrs[i])
+                try:
+                    status, _, payload = await conn.request(
+                        "GET", "/spans", {}, b"")
+                    if status == 200:
+                        group.extend(
+                            json.loads(payload.decode())["spans"])
+                except (IOError, OSError):
+                    pass
+                finally:
+                    conn.close()
+            out.append(group)
         return out

@@ -52,21 +52,28 @@ from paxi_tpu.host.client import _Conn
 from paxi_tpu.host.http import _OK_TMPL, _response, read_request
 from paxi_tpu.metrics import Registry, merge_snapshots
 from paxi_tpu.metrics.registry import render_prometheus
+from paxi_tpu.obs import (SpanCollector, TraceCtx, new_trace_id,
+                          process_sampler)
+from paxi_tpu.obs import merge as merge_spans
+from paxi_tpu.obs import label_group as label_group_spans
 from paxi_tpu.shard.shardmap import ShardMap
 from paxi_tpu.shard.txn import ShardCoordinator, TxnOutcome, partition_ops
 
 
 class _RoutedOp:
     """One forwarded KV request: the backend frame, the response slot,
-    and the map epoch it was routed under."""
+    the map epoch it was routed under, and the pending-queue ``route``
+    span when the request is traced."""
 
-    __slots__ = ("key", "frame", "slot", "epoch")
+    __slots__ = ("key", "frame", "slot", "epoch", "span")
 
-    def __init__(self, key: int, frame: bytes, slot, epoch: int):
+    def __init__(self, key: int, frame: bytes, slot, epoch: int,
+                 span=None):
         self.key = key
         self.frame = frame
         self.slot = slot
         self.epoch = epoch
+        self.span = span
 
 
 class ShardRouter:
@@ -75,7 +82,7 @@ class ShardRouter:
     def __init__(self, shard_map: ShardMap, group_urls: List[str],
                  lease_s: float = 0.2,
                  metrics: Optional[Registry] = None,
-                 group_scrape=None):
+                 group_scrape=None, group_scrape_spans=None):
         if shard_map.n_groups > len(group_urls):
             raise ValueError(
                 f"map names group {shard_map.n_groups - 1} but only "
@@ -90,8 +97,16 @@ class ShardRouter:
             else Registry(tier="router")
         # async callable returning per-group registry snapshots for
         # /metrics aggregation (injected by ShardedCluster: in-proc
-        # reads replica registries, subprocess mode scrapes HTTP)
+        # reads replica registries, subprocess mode scrapes HTTP);
+        # _group_scrape_spans is the same shape for GET /spans
         self._group_scrape = group_scrape
+        self._group_scrape_spans = group_scrape_spans
+        # the router is the entry tier of sharded serving: head-based
+        # sampling happens here (obs/sample.py), once per command, and
+        # the decision propagates to the backend group as a
+        # Property-Trace header — backend nodes never re-sample
+        self.sampler = process_sampler()
+        self.spans = SpanCollector(node="router")
         self._fwd_total = self.metrics.counter(
             "paxi_router_forwards_total")
         self._stale_total = self.metrics.counter(
@@ -107,8 +122,19 @@ class ShardRouter:
             self.metrics.counter("paxi_router_group_commands_total",
                                  group=str(g))
             for g in range(len(group_urls))]
+        # router-tier levels, per group: how deep the pending queue is
+        # right now and how many shipped commands await group replies —
+        # the "router-capped past G=2" claim as scrapeable numbers
+        self._g_depth = [
+            self.metrics.gauge("paxi_router_pending_depth",
+                               group=str(g))
+            for g in range(len(group_urls))]
+        self._g_inflight = [
+            self.metrics.gauge("paxi_router_inflight", group=str(g))
+            for g in range(len(group_urls))]
         self.coord = ShardCoordinator(self._tpc_submit, lease_s=lease_s,
-                                      metrics=self.metrics)
+                                      metrics=self.metrics,
+                                      spans=self.spans)
 
     # ---- map snapshot / swap (the lockset-checked pair) ----------------
     @property
@@ -134,17 +160,38 @@ class ShardRouter:
         self._map_swaps.inc()
 
     # ---- KV forwarding --------------------------------------------------
-    def route_kv(self, key: int, frame: bytes, loop) -> asyncio.Future:
+    def sample_entry(self, kind: str, **labels):
+        """The once-per-command sampling decision: a hit opens (and
+        returns) the trace's root span; None == unsampled."""
+        if not self.sampler.decide():
+            return None
+        return self.spans.start(kind, TraceCtx(new_trace_id()),
+                                **labels)
+
+    def route_kv(self, key: int, frame: bytes, loop,
+                 span=None) -> asyncio.Future:
         """Enqueue one KV request for its owning group; the returned
-        future resolves to response BYTES for the router's client."""
+        future resolves to response BYTES for the router's client.
+        ``span`` is the traced request's root (sample_entry): its
+        pending-queue wait becomes a ``route`` child span and the root
+        finishes when the response slot resolves."""
         slot: asyncio.Future = loop.create_future()
         self._fwd_total.inc()
+        op = _RoutedOp(key, frame, slot, 0)
         with self._lock:
             m = self._map
             g = m.group_of(key)
-            self._pending[g].append(_RoutedOp(key, frame, slot,
-                                              m.version))
+            op.epoch = m.version
+            self._pending[g].append(op)
+            depth = len(self._pending[g])
+        self._g_depth[g].set(depth)
         self._group_fwd[g].inc()
+        if span is not None:
+            op.span = self.spans.start("route", span.child(),
+                                       group=str(g))
+            spans = self.spans
+            slot.add_done_callback(
+                lambda _s, _sp=span: spans.finish(_sp))
         return slot
 
     async def flush(self) -> None:
@@ -156,6 +203,8 @@ class ShardRouter:
             m = self._map
             batches = self._pending
             self._pending = [[] for _ in self._conns]
+        for gd in self._g_depth:
+            gd.set(0)
         moved: List[_RoutedOp] = []
         for g, ops in enumerate(batches):
             if not ops:
@@ -182,10 +231,13 @@ class ShardRouter:
             await conn.ensure()
         except OSError as e:
             for op in ops:
+                self.spans.finish(op.span)
                 self._fail_slot(op.slot, e)
             return
+        self._g_inflight[g].inc(len(ops))
         for op in ops:
-            conn.submit_raw(op.frame, self._make_done(op.slot))
+            self.spans.finish(op.span)   # queue wait ends at the wire
+            conn.submit_raw(op.frame, self._make_done(op.slot, g))
         try:
             await conn.flush()
         except (ConnectionError, OSError):
@@ -198,9 +250,11 @@ class ShardRouter:
             slot.set_result(_response(
                 500, b"", {"Err": f"group unreachable: {exc!r}"}))
 
-    @staticmethod
-    def _make_done(slot: asyncio.Future):
+    def _make_done(self, slot: asyncio.Future, g: int):
+        inflight = self._g_inflight[g]
+
         def done(status, headers, payload, exc, _slot=slot):
+            inflight.dec()
             if _slot.done():
                 return
             if exc is not None:
@@ -224,6 +278,10 @@ class ShardRouter:
             doc["ops"] = [[k, v.decode("latin1")] for k, v in rec["ops"]]
         if rec.get("outcome"):
             doc["outcome"] = rec["outcome"]
+        if rec.get("trace"):
+            # the coordinator's record-span context: the participant
+            # group's tpc/batch/quorum/exec spans stitch under it
+            doc["trace"] = rec["trace"]
         body = json.dumps(doc).encode()
         conn = self._tpc_conns[group]
         try:
@@ -234,10 +292,13 @@ class ShardRouter:
             return False, repr(e).encode()
 
     async def run_transaction(self, ops, client_id: str,
-                              command_id: int) -> bytes:
+                              command_id: int, trace=None) -> bytes:
         """POST /transaction: partition by the current map; one group
         -> forward the packed transaction unchanged (single-log
-        atomicity); several -> 2PC."""
+        atomicity); several -> 2PC.  ``trace`` is the sampled
+        transaction's root context — single-group it rides the
+        Property-Trace header, cross-group the coordinator parents its
+        per-record spans under it."""
         m = self.shard_map
         parts = partition_ops(m, ops)
         if len(parts) == 1:
@@ -245,12 +306,14 @@ class ShardRouter:
             body = json.dumps([
                 {"key": k, "value": v.decode("latin1")}
                 for k, v in gops]).encode()
+            hdrs = {"Client-Id": client_id,
+                    "Command-Id": str(command_id)}
+            if trace is not None:
+                hdrs["Property-Trace"] = trace.encode()
             conn = self._tpc_conns[g]
             try:
                 status, headers, payload = await conn.request(
-                    "POST", "/transaction",
-                    {"Client-Id": client_id,
-                     "Command-Id": str(command_id)}, body)
+                    "POST", "/transaction", hdrs, body)
             except (IOError, OSError) as e:
                 return _response(500, b"", {"Err": repr(e)})
             if status != 200:
@@ -258,7 +321,8 @@ class ShardRouter:
                                  {"Err": headers.get("err", "")})
             return _OK_TMPL % len(payload) + payload
         try:
-            out: TxnOutcome = await self.coord.run_txn(parts)
+            out: TxnOutcome = await self.coord.run_txn(parts,
+                                                       trace=trace)
         except (IOError, OSError) as e:
             # decide unreachable: the outcome is UNKNOWN (participants
             # may hold stages until a recover() pass) — answer 500
@@ -286,6 +350,19 @@ class ShardRouter:
                     snaps.append(label_group(s, g))
         return merge_snapshots(snaps)
 
+    async def spans_snapshot(self) -> List[Dict]:
+        """Router spans + every group's node spans, each group's spans
+        stamped ``group=<g>`` — the span analog of metrics_snapshot,
+        and where a cross-shard 2PC becomes ONE stitched tree: the
+        coordinator's record spans (here) and the participant spans
+        (scraped) share the transaction's trace id."""
+        lists = [self.spans.export()]
+        if self._group_scrape_spans is not None:
+            per_group = await self._group_scrape_spans()
+            for g, gspans in enumerate(per_group):
+                lists.append(label_group_spans(gspans, g))
+        return merge_spans(lists)
+
     def close(self) -> None:
         for c in self._conns + self._tpc_conns:
             c.close()
@@ -298,6 +375,9 @@ def label_group(snap: Dict, group: int) -> Dict:
     return {
         "counters": [dict(c, labels={**c.get("labels", {}), "group": g})
                      for c in snap.get("counters", [])],
+        "gauges": [dict(gg, labels={**gg.get("labels", {}),
+                                    "group": g})
+                   for gg in snap.get("gauges", [])],
         "histograms": [dict(h, labels={**h.get("labels", {}),
                                        "group": g})
                        for h in snap.get("histograms", [])],
@@ -408,8 +488,16 @@ class RouterServer:
                     f"Content-Length: {len(value)}",
                     f"Client-Id: {headers.get('client-id', '')}",
                     f"Command-Id: {headers.get('command-id', '0')}"]
+            sp = self.router.sample_entry("request", key=str(key))
+            if sp is not None:
+                # the one place sampling costs anything: the extra
+                # header pushes the backend frame off its 4-line fast
+                # parse onto the (still cheap) slow path — for sampled
+                # requests only
+                head.append(f"Property-Trace: {sp.child().encode()}")
             frame = ("\r\n".join(head) + "\r\n\r\n").encode() + value
-            return self.router.route_kv(key, frame, self._loop)
+            return self.router.route_kv(key, frame, self._loop,
+                                        span=sp)
         return await self._route_slow(method, url, parts, headers, body)
 
     async def _route_slow(self, method: str, url, parts,
@@ -436,8 +524,14 @@ class RouterServer:
             except (ValueError, KeyError, TypeError,
                     AttributeError) as e:
                 return _response(400, b"", {"Err": repr(e)})
-            return await r.run_transaction(
-                ops, headers.get("client-id", self._txn_cid), cmd_id)
+            sp = r.sample_entry("txn", ops=str(len(ops)))
+            try:
+                return await r.run_transaction(
+                    ops, headers.get("client-id", self._txn_cid),
+                    cmd_id,
+                    trace=None if sp is None else sp.child())
+            finally:
+                r.spans.finish(sp)
         if parts and parts[0] == "shardmap":
             if len(parts) == 1 and method == "GET":
                 return _response(
@@ -468,4 +562,17 @@ class RouterServer:
                 200, render_prometheus(snap).encode(),
                 {"Content-Type":
                  "text/plain; version=0.0.4; charset=utf-8"})
+        if parts and parts[0] == "spans":
+            # one stitched scrape: router roots + coordinator records
+            # + every group's node spans, group-labeled (obs/stitch.py)
+            if method != "GET":
+                return _response(405, b"", {"Err": "GET only"})
+            spans = await r.spans_snapshot()
+            if parse_qs(url.query).get("clear", [""])[0] in ("1",
+                                                             "true"):
+                r.spans.clear()
+            return _response(
+                200, json.dumps({"node": "router",
+                                 "spans": spans}).encode(),
+                {"Content-Type": "application/json"})
         return _response(404)

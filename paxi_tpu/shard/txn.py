@@ -94,10 +94,14 @@ class ShardCoordinator:
     FINISH_RETRIES = 3
 
     def __init__(self, submit, lease_s: float = 0.2,
-                 metrics=None, tag: str = "c"):
+                 metrics=None, tag: str = "c", spans=None):
         self._submit = submit
         self.lease_s = lease_s
         self._tag = tag
+        # obs.SpanCollector (or None): a traced transaction opens one
+        # span per 2PC record, and the record's child context rides
+        # rec["trace"] to the participant — the cross-shard stitch
+        self._spans = spans
         reg = metrics
         self._m = {
             k: (reg.counter(f"paxi_tpc_{k}_total") if reg is not None
@@ -119,17 +123,29 @@ class ShardCoordinator:
 
     async def _record(self, group: int, key: int, kind: str, txid: str,
                       ops: Optional[GroupOps] = None,
-                      outcome: str = "") -> Tuple[bool, bytes]:
+                      outcome: str = "",
+                      trace=None) -> Tuple[bool, bytes]:
         rec: dict = {"kind": kind, "txid": txid}
         if ops is not None:
             rec["ops"] = ops
         if outcome:
             rec["outcome"] = outcome
-        return await self._submit(group, key, rec)
+        sp = None
+        if self._spans is not None and trace is not None:
+            sp = self._spans.start(kind, trace, group=str(group),
+                                   txid=txid)
+            if sp is not None:
+                rec["trace"] = sp.child().encode()
+        try:
+            return await self._submit(group, key, rec)
+        finally:
+            if self._spans is not None:
+                self._spans.finish(sp)
 
     async def run_txn(self, parts: Dict[int, GroupOps],
                       txid: Optional[str] = None,
-                      crash_at: Optional[str] = None) -> TxnOutcome:
+                      crash_at: Optional[str] = None,
+                      trace=None) -> TxnOutcome:
         """One 2PC round over ``parts`` (group -> its ops).
 
         ``crash_at`` (tests only): ``"mid_prepare"`` dies with only
@@ -144,20 +160,21 @@ class ShardCoordinator:
         groups = sorted(parts)
         if crash_at == "mid_prepare":
             await self._record(home, parts[home][0][0], "prepare",
-                               txid, ops=parts[home])
+                               txid, ops=parts[home], trace=trace)
             raise CoordinatorKilled(txid, parts, crash_at)
         votes = await asyncio.gather(*[
             self._record(g, parts[g][0][0], "prepare", txid,
-                         ops=parts[g]) for g in groups])
+                         ops=parts[g], trace=trace) for g in groups])
         yes = all(ok and payload.startswith(b"yes:")
                   for ok, payload in votes)
         if crash_at == "after_prepare":
             raise CoordinatorKilled(txid, parts, crash_at)
-        outcome = await self._decide(parts, txid, "c" if yes else "a")
+        outcome = await self._decide(parts, txid, "c" if yes else "a",
+                                     trace=trace)
         if crash_at == "after_decide":
             raise CoordinatorKilled(txid, parts, crash_at)
         stragglers = await self._finish(parts, txid, outcome,
-                                        crash_at=crash_at)
+                                        crash_at=crash_at, trace=trace)
         if outcome != "c":
             self._count("aborted")
             return TxnOutcome(txid, False, err="aborted (conflict)"
@@ -175,12 +192,13 @@ class ShardCoordinator:
         return TxnOutcome(txid, True, values=values, err=err)
 
     async def _decide(self, parts: Dict[int, GroupOps], txid: str,
-                      want: str) -> str:
+                      want: str, trace=None) -> str:
         """Write the decide record to the home group; the reply is the
         WINNING outcome (first decide in the home log wins)."""
         home = self.home_of(parts)
         ok, payload = await self._record(home, parts[home][0][0],
-                                         "decide", txid, outcome=want)
+                                         "decide", txid, outcome=want,
+                                         trace=trace)
         if not ok:
             raise IOError(f"2pc decide({txid}) unreachable: "
                           f"{payload!r}")
@@ -188,7 +206,8 @@ class ShardCoordinator:
 
     async def _finish(self, parts: Dict[int, GroupOps], txid: str,
                       outcome: str,
-                      crash_at: Optional[str] = None) -> List[int]:
+                      crash_at: Optional[str] = None,
+                      trace=None) -> List[int]:
         """Fan the outcome record to every participant, retrying each
         failed delivery ``FINISH_RETRIES`` times.  Returns the groups
         still unreached (counted; the caller reports them — the
@@ -196,14 +215,15 @@ class ShardCoordinator:
         kind = "commit" if outcome == "c" else "abort"
         home = self.home_of(parts)
         if crash_at == "mid_commit":
-            await self._record(home, parts[home][0][0], kind, txid)
+            await self._record(home, parts[home][0][0], kind, txid,
+                               trace=trace)
             raise CoordinatorKilled(txid, parts, crash_at)
         left = sorted(parts)
         for _ in range(1 + self.FINISH_RETRIES):
             if not left:
                 break
             results = await asyncio.gather(*[
-                self._record(g, parts[g][0][0], kind, txid)
+                self._record(g, parts[g][0][0], kind, txid, trace=trace)
                 for g in left])
             left = [g for g, (ok, _) in zip(left, results) if not ok]
         if left:
@@ -211,7 +231,8 @@ class ShardCoordinator:
         return left
 
     async def recover(self, txid: str,
-                      parts: Dict[int, GroupOps]) -> str:
+                      parts: Dict[int, GroupOps],
+                      trace=None) -> str:
         """Take over an in-doubt txn after a coordinator death: fence
         out the (possibly still live) coordinator's decide window,
         force a decide(abort) — first-wins reports the truth — and
@@ -220,8 +241,8 @@ class ShardCoordinator:
         fence = self.lease_s
         if fence > 0:
             await asyncio.sleep(fence)
-        outcome = await self._decide(parts, txid, "a")
-        await self._finish(parts, txid, outcome)
+        outcome = await self._decide(parts, txid, "a", trace=trace)
+        await self._finish(parts, txid, outcome, trace=trace)
         self._count("recovered")
         self._count("committed" if outcome == "c" else "aborted")
         return outcome

@@ -38,6 +38,16 @@
 #                                # the artifact schema, committed > 0,
 #                                # anomalies == 0 and a clean
 #                                # cross-shard 2PC atomicity verdict
+#   scripts/verify.sh --spans    # prepend the causal-tracing smoke:
+#                                # a tiny 100%-sampled ramp through the
+#                                # batched commit path (span schema gate
+#                                # + nonzero five-phase decomposition),
+#                                # a double fabric replay that must
+#                                # render byte-identical timelines, a
+#                                # cross-shard 2PC txn whose spans must
+#                                # stitch into ONE tree across >= 2
+#                                # groups, and the PXO span-isolation
+#                                # lint family
 #   scripts/verify.sh --workload # prepend the workload-engine smoke:
 #                                # one zipf99 spec compiled onto BOTH
 #                                # sim lowerings (lane-major paxos vs
@@ -55,8 +65,134 @@ cd "$(dirname "$0")/.."
 while [ "${1:-}" = "--lint" ] || [ "${1:-}" = "--metrics" ] \
     || [ "${1:-}" = "--hunt" ] || [ "${1:-}" = "--bench" ] \
     || [ "${1:-}" = "--host-bench" ] || [ "${1:-}" = "--shard" ] \
-    || [ "${1:-}" = "--workload" ]; do
-  if [ "$1" = "--workload" ]; then
+    || [ "${1:-}" = "--workload" ] || [ "${1:-}" = "--spans" ]; do
+  if [ "$1" = "--spans" ]; then
+    shift
+    echo "== spans smoke (100%-sampled ramp, five-phase rows) =="
+    # the live path end-to-end: subprocess servers inherit
+    # PAXI_TRACE_SAMPLE=1.0, the bench scrapes GET /spans from every
+    # node, and the artifact row must carry the five-phase
+    # decomposition with nonzero coverage
+    SP_OUT=$(mktemp /tmp/paxi_spans.XXXXXX.json)
+    timeout -k 10 180 env JAX_PLATFORMS=cpu python -m paxi_tpu \
+      bench-host --open-loop -rates 300 -step_s 1.5 -conns 2 \
+      -trace_sample 1.0 -base_port 18350 -out "$SP_OUT" \
+      >/dev/null || exit $?
+    SP_OUT="$SP_OUT" python - <<'PYEOF' || exit $?
+import json, os
+from paxi_tpu.obs import PHASES
+with open(os.environ["SP_OUT"]) as f:
+    r = json.load(f)
+assert r["total_completed"] > 0, "no ops completed"
+ph = r.get("span_phases")
+assert ph and ph["traces"] > 0, f"no sampled traces: {ph}"
+assert set(ph["phase_mean"]) == set(PHASES) | {"other"}, ph
+assert 0 < ph["coverage"] <= 1.0, ph
+assert ph["e2e_mean"] > 0, ph
+print(f"spans ramp OK: {ph['traces']} traces, "
+      f"coverage {ph['coverage']:.2f}, e2e {ph['e2e_mean']*1e3:.2f} ms")
+PYEOF
+    rm -f "$SP_OUT"
+    echo "== spans smoke (fabric double replay + 2PC stitch) =="
+    # determinism + stitching: two fabric replays of one traced
+    # workload must export identical spans (schema-clean, stitched,
+    # byte-identical rendered timelines), and a traced cross-shard 2PC
+    # txn must stitch into one tree spanning >= 2 groups, no orphans
+    timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'PYEOF' || exit $?
+import asyncio
+from paxi_tpu.core.command import Command, Request
+from paxi_tpu.host.fabric import VirtualClockFabric
+from paxi_tpu.host.simulation import Cluster, chan_config
+from paxi_tpu.obs import (TRACE_PROP, SpanCollector, TraceCtx,
+                          ascii_timeline, groups_of, label_group,
+                          merge, orphans, phases, stitched_traces,
+                          validate_spans)
+from paxi_tpu.shard import ShardCoordinator, ShardedCluster
+from tests.test_shard_txn import drive, traced_submit
+
+
+async def workload():
+    fab = VirtualClockFabric()
+    c = Cluster("paxos", cfg=chan_config(3, tag="spsm"), http=False,
+                fabric=fab)
+    await c.start()
+    col = SpanCollector(node="client", fabric=fab)
+    try:
+        for i in range(4):
+            sp = col.start("request", TraceCtx(f"w{i}"), key=str(i))
+            fut = asyncio.get_running_loop().create_future()
+            c["1.1"].handle_client_request(Request(
+                command=Command(i, f"v{i}".encode(), "vfy", i),
+                properties={TRACE_PROP: sp.child().encode()},
+                reply_to=fut))
+            task = asyncio.ensure_future(fut)
+            for _ in range(300):
+                if task.done():
+                    break
+                await fab.run(1)
+            assert task.done() and task.result().err is None
+            col.finish(sp)
+        lists = [r.spans.export() for r in c.replicas.values()]
+        lists.append(col.export())
+        return merge(lists)
+    finally:
+        await c.stop()
+
+
+a = asyncio.run(workload())
+b = asyncio.run(workload())
+errs = validate_spans(a)
+assert not errs, errs[:5]
+stitched = stitched_traces(a)
+assert len(stitched) == 4, stitched
+for t in stitched:
+    ph = phases(a, t)
+    assert ph is not None and ph["e2e"] > 0, (t, ph)
+assert a == b and ascii_timeline(a) == ascii_timeline(b), \
+    "fabric replays diverged"
+
+
+async def twopc():
+    fab = VirtualClockFabric()
+    sc = ShardedCluster("paxos", groups=2, n=3, http=False, fabric=fab,
+                        tag="sp2pc")
+    await sc.start()
+    try:
+        col = SpanCollector(node="client", fabric=fab)
+        cspans = SpanCollector(node="coord", fabric=fab)
+        coord = ShardCoordinator(traced_submit(sc), lease_s=0.0,
+                                 spans=cspans)
+        gsize = sc.map.span // 2
+        parts = {g: [(g * gsize + 7, f"t{g}".encode())]
+                 for g in range(2)}
+        root = col.start("txn", TraceCtx("t2pc"))
+        task = await drive(fab, coord.run_txn(
+            parts, txid="tx-vfy", trace=root.child()))
+        assert task.result().committed, task.result()
+        col.finish(root)
+        lists = [cspans.export(), col.export()]
+        for g in range(2):
+            gl = [d for r in sc.group(g).replicas.values()
+                  for d in r.spans.export()]
+            lists.append(label_group(gl, g))
+        return merge(lists)
+    finally:
+        await sc.stop()
+
+
+spans = asyncio.run(twopc())
+assert orphans(spans) == [], orphans(spans)[:5]
+assert "t2pc" in stitched_traces(spans), "2PC trace did not stitch"
+gs = groups_of(spans, "t2pc")
+assert len(gs) >= 2, gs
+print(f"spans fabric smoke OK: {len(stitched)} stitched traces "
+      f"byte-identical across replays; 2PC tree spans groups {gs} "
+      f"({len([d for d in spans if d['trace'] == 't2pc'])} spans, "
+      f"0 orphans)")
+PYEOF
+    echo "== span isolation lint (PXO) =="
+    timeout -k 10 120 python -m paxi_tpu lint --rule PXO || exit $?
+  elif [ "$1" = "--workload" ]; then
     shift
     echo "== workload smoke (one spec, both sim lowerings) =="
     # the engine's core promise at a toy shape: the SAME zipf99 spec
@@ -372,7 +508,7 @@ for v in r["violations"] + r["suppressed"]:
     for k in ("rule", "code", "path", "line", "col", "message"):
         assert k in v, (k, v)
 known = ("PXK", "PXH", "PXT", "PXC", "PXQ", "PXB", "PXS", "PXF", "PXA",
-         "PXM", "PXL", "PXW")
+         "PXM", "PXL", "PXW", "PXO")
 for s in r["suppressed"]:
     assert s["code"].startswith(known), s["code"]
     assert s.get("suppressed_by"), s

@@ -1,0 +1,62 @@
+"""Seeded violations for the span-isolation family (PXO13x).
+
+A miniature protocol-host-shaped module: handlers that leak span state
+into protocol state, a call argument, a branch, and a return — each a
+seeded mutant the rule must catch — while ``clean_commit`` does
+everything the real instrumented hosts do (statement-tier opens/closes
+keyed off the command's trace context, ``spans=`` wiring, a
+``_sp``-quarantined local) and must stay green.  Never imported;
+driven via ``spanrule.check(root, files=[...])`` in
+tests/test_lint.py.
+"""
+
+
+def ctx_of(obj):
+    return getattr(obj, "trace", None)
+
+
+def record_metric(value):
+    return value
+
+
+class Host:
+
+    def handle_store(self, req, slot):
+        # MUTANT 1 (PXO131): span state stored into protocol state
+        self.last_span = self.spans.start("exec", ctx_of(req))
+        self.log[slot] = req
+
+    def handle_leak_arg(self, req):
+        # MUTANT 2 (PXO131): span value fed into a non-collector call
+        record_metric(self.spans)
+        self.execute(req)
+
+    def handle_branch(self, req):
+        # MUTANT 3 (PXO132): a protocol decision keyed off span state
+        if len(self.spans.export()) > 10:
+            return
+        self.execute(req)
+
+    def handle_return(self, req):
+        # MUTANT 4 (PXO133): span value escapes through return
+        _sp = self.spans.start("exec", ctx_of(req))
+        return _sp
+
+    def clean_commit(self, reqs, slot):
+        # the sanctioned patterns: statement-tier writes, spans=
+        # wiring, a _sp*-quarantined local handed back to the
+        # collector — everything the instrumented hosts do
+        for i, r in enumerate(reqs):
+            self.spans.open(("q", slot, i), "quorum", ctx_of(r),
+                            slot=str(slot))
+        self.buf = BatchBuffer(self.flush, spans=self.spans)
+        _sp = self.spans.start("exec", ctx_of(reqs[0]))
+        self.execute(reqs)
+        self.spans.finish(_sp)
+        self.spans.close_group(("q", slot))
+
+
+class BatchBuffer:
+
+    def __init__(self, flush, spans=None):
+        self.flush = flush

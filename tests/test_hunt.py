@@ -217,3 +217,28 @@ def test_micro_campaign_is_clean_and_resumable(tmp_path):
 def test_campaign_rejects_unknown_protocol(tmp_path):
     with pytest.raises(KeyError, match="no hunt cases"):
         Campaign(tmp_path / "h", protocols=["nope"], log=lambda m: None)
+
+
+@pytest.mark.host
+def test_witness_replay_span_timelines_byte_identical():
+    """The tracing acceptance pin: the harness opens a root span per
+    injected op under a deterministic trace id, every replica stamps
+    fabric-step times, and two replays of one schedule must export
+    identical spans — so a rendered timeline diffs clean byte for
+    byte."""
+    import asyncio
+
+    from paxi_tpu.hunt.classify import replay_schedule
+    from paxi_tpu.obs import ascii_timeline, stitched_traces
+    from paxi_tpu.trace.host import SeqSchedule
+
+    outs = [asyncio.run(replay_schedule(
+        "paxos", CFG, SeqSchedule(n_steps=30), seed=0))
+        for _ in range(2)]
+    a, b = outs
+    assert a.spans, "replay produced no spans"
+    assert a.spans == b.spans
+    assert ascii_timeline(a.spans) == ascii_timeline(b.spans)
+    assert stitched_traces(a.spans), "no trace stitched into a tree"
+    assert a.to_json()["span_count"] == len(a.spans)
+    assert "spans" not in a.to_json()

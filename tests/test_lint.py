@@ -18,7 +18,7 @@ import pytest
 from paxi_tpu import analysis
 from paxi_tpu.analysis import (asyncflow, ballots, concurrency, crossflow,
                                handlers, layout, measure, parity, purity,
-                               quorum, tracemap)
+                               quorum, spanrule, tracemap)
 from paxi_tpu.analysis.model import (Suppression, Violation,
                                      apply_suppressions, inline_disables,
                                      load_baseline)
@@ -713,6 +713,32 @@ def test_asyncflow_repo_tree_is_clean():
     duplicate-dial and the fabric clock write-back — are fixed with
     regression tests in tests/test_async_races.py)."""
     assert asyncflow.check(ROOT) == []
+
+
+# ---- span isolation ------------------------------------------------------
+def test_spanrule_fixture_catches_each_mutant():
+    """PXO13x: the four seeded leaks (protocol-state store, call-arg
+    escape, branch test, return escape) all fire; the clean control
+    (``clean_commit``: statement-tier opens/closes, ``spans=`` wiring,
+    a ``_sp``-quarantined local) stays green."""
+    vs = spanrule.check(ROOT, files=[FIX / "fixture_spanhost.py"])
+    assert set(codes(vs)) == {"PXO131", "PXO132", "PXO133"}
+    src = (FIX / "fixture_spanhost.py").read_text().splitlines()
+    clean_start = next(i for i, l in enumerate(src, 1)
+                       if l.strip().startswith("def clean_commit"))
+    assert all(v.line < clean_start for v in vs), \
+        "the sanctioned statement-tier/wiring patterns must not flag"
+    # mutants 1+2 are distinct PXO131 sites; 3 branches; 4 returns
+    assert len({v.line for v in vs if v.code == "PXO131"}) >= 2
+    assert any(v.code == "PXO132" for v in vs)
+    assert any(v.code == "PXO133" for v in vs)
+
+
+def test_spanrule_repo_tree_is_clean():
+    """Every instrumented protocol host module respects span
+    isolation: spans are written through the collector's statement
+    tier and never feed a protocol decision (tier-1, no baseline)."""
+    assert spanrule.check(ROOT) == []
 
 
 # ---- the repo-wide gate --------------------------------------------------

@@ -10,7 +10,8 @@ import random
 import pytest
 
 from paxi_tpu.metrics import (HIST_BOUNDS, Histogram, Registry,
-                              merge_snapshots, parse_prometheus, pretty)
+                              merge_snapshots, parse_prometheus, pretty,
+                              render_prometheus)
 
 
 # ---- histogram model ----------------------------------------------------
@@ -106,6 +107,33 @@ def test_merge_snapshots_aggregates_series():
     assert merged["histograms"][0]["count"] == 2
     out = pretty(merged)
     assert "ops" in out and "lat" in out
+
+
+def test_gauge_set_inc_dec_snapshot_and_merge():
+    """Gauges (the router-tier depth/in-flight satellites): last-write
+    value semantics per registry, SUM across merged snapshots (per-
+    group series stay distinct under their labels)."""
+    reg = Registry(node="r")
+    g = reg.gauge("paxi_router_pending_depth", group="0")
+    g.set(7)
+    g.inc(2)
+    g.dec()
+    assert g.value == 8.0
+    reg.gauge("paxi_router_pending_depth", group="1").set(3)
+    snap = reg.snapshot()
+    got = {(s["name"], s["labels"]["group"]): s["value"]
+           for s in snap["gauges"]}
+    assert got[("paxi_router_pending_depth", "0")] == 8.0
+    assert got[("paxi_router_pending_depth", "1")] == 3.0
+    merged = merge_snapshots([snap, snap])
+    assert {(s["labels"]["group"], s["value"])
+            for s in merged["gauges"]} == {("0", 16.0), ("1", 6.0)}
+    text = render_prometheus(merged)
+    assert "# TYPE paxi_router_pending_depth gauge" in text
+    samples = parse_prometheus(text)
+    assert ("paxi_router_pending_depth",
+            {"group": "0", "node": "r"}, 16.0) in samples
+    assert "paxi_router_pending_depth" in pretty(merged)
 
 
 # ---- the /metrics endpoint on a live cluster ----------------------------

@@ -1,0 +1,320 @@
+"""Causal tracing subsystem (paxi_tpu/obs): span model + wire
+context, deterministic head sampling, the two collector tiers, stitch
+math (trees / orphans / five-phase decomposition), canonical
+rendering, codec pass-through, and the flagship property — two fabric
+replays of one workload export byte-identical timelines."""
+
+import asyncio
+
+import pytest
+
+from paxi_tpu.core.command import Command, Request
+from paxi_tpu.host.codec import Codec, roundtrip
+from paxi_tpu.host.fabric import VirtualClockFabric
+from paxi_tpu.host.node import WireRequest
+from paxi_tpu.host.simulation import Cluster, chan_config
+from paxi_tpu.obs import (PHASES, TRACE_PROP, Sampler, Span,
+                          SpanCollector, TraceCtx, aggregate_phases,
+                          ascii_timeline, chrome_trace, ctx_of,
+                          first_ctx, groups_of, label_group, merge,
+                          new_trace_id, orphans, phases,
+                          process_sampler, sample_rate,
+                          set_sample_rate, stitched_traces, trees,
+                          validate_spans)
+from paxi_tpu.obs.stitch import sid_key
+
+
+# ---- span model / wire context -----------------------------------------
+
+def test_trace_ctx_encode_decode():
+    ctx = TraceCtx("t7", "n-3")
+    assert TraceCtx.decode(ctx.encode()) == ctx
+    # root position: empty span id survives the round trip
+    assert TraceCtx.decode(TraceCtx("t7").encode()) == TraceCtx("t7", "")
+    assert TraceCtx.decode(None) is None
+    assert TraceCtx.decode("") is None
+    assert TraceCtx.decode(":orphan") is None
+    assert TraceCtx.decode("bare") == TraceCtx("bare", "")
+
+
+def test_ctx_of_and_first_ctx():
+    class Obj:
+        def __init__(self, props):
+            self.properties = props
+
+    assert ctx_of(object()) is None
+    assert ctx_of(Obj({})) is None
+    assert ctx_of(Obj({TRACE_PROP: "t1:n-1"})) == TraceCtx("t1", "n-1")
+    batch = [Obj({}), Obj({TRACE_PROP: "t2:"}), Obj({TRACE_PROP: "t3:x"})]
+    assert first_ctx(batch) == TraceCtx("t2", "")
+    assert first_ctx([Obj({})]) is None
+    assert first_ctx(None) is None
+
+
+def test_span_child_and_dur():
+    sp = Span(trace="t", sid="n-1", parent="", kind="request",
+              node="n", t0=3.0)
+    assert sp.child() == TraceCtx("t", "n-1")
+    assert sp.dur == 0.0            # still open
+    sp.t1 = 5.5
+    assert sp.dur == 2.5
+    assert Span.from_json(sp.to_json()) == sp
+
+
+def test_validate_spans_gate():
+    good = Span(trace="t", sid="n-1", parent="", kind="exec",
+                node="n", t0=1.0, t1=2.0, labels={"k": "v"}).to_json()
+    assert validate_spans([good]) == []
+    bad_missing = {k: v for k, v in good.items() if k != "kind"}
+    bad_time = dict(good, t0=5.0, t1=1.0)
+    bad_label = dict(good, labels={"k": 3})
+    errs = validate_spans([bad_missing, bad_time, bad_label, "nope"])
+    assert any("missing 'kind'" in e for e in errs)
+    assert any("t1 < t0" in e for e in errs)
+    assert any("labels" in e for e in errs)
+    assert any("not an object" in e for e in errs)
+
+
+# ---- sampling ----------------------------------------------------------
+
+def test_sampler_is_a_deterministic_accumulator():
+    s = Sampler(0.25)
+    got = [s.decide() for _ in range(8)]
+    assert got == [False, False, False, True] * 2
+    s.reset()
+    assert [s.decide() for _ in range(8)] == got  # replayable
+    assert all(Sampler(1.0).decide() for _ in range(5))
+    assert not any(Sampler(0.0).decide() for _ in range(5))
+    assert Sampler(7.0).rate == 1.0 and Sampler(-1.0).rate == 0.0
+
+
+def test_process_sampler_shared_and_settable():
+    old = sample_rate()
+    try:
+        set_sample_rate(1.0)
+        assert process_sampler().decide()
+        assert sample_rate() == 1.0
+        set_sample_rate(0.0)
+        assert not process_sampler().decide()
+    finally:
+        set_sample_rate(old)
+
+
+def test_new_trace_id_salted_and_unique():
+    a, b = new_trace_id("z"), new_trace_id("z")
+    assert a.startswith("tz-") and b.startswith("tz-") and a != b
+
+
+# ---- collector ---------------------------------------------------------
+
+def test_collector_value_tier_and_wall_clock():
+    col = SpanCollector(node="n")
+    assert col.start("exec", None) is None      # unsampled: no branch
+    col.finish(None)                            # and finish(None) no-ops
+    sp = col.start("exec", TraceCtx("t"), key="5")
+    assert sp is not None and sp.sid == "n-1" and sp.parent == ""
+    assert col.export() == []                   # open spans not exported
+    col.finish(sp)
+    (doc,) = col.export()
+    assert doc["t1"] >= doc["t0"] and doc["labels"] == {"key": "5"}
+
+
+def test_collector_statement_tier_close_group():
+    col = SpanCollector(node="n")
+    col.open(("q", 1, 0), "quorum", None)       # unsampled: no-op
+    col.close(("q", 1, 0))
+    assert len(col) == 0
+    ctx = TraceCtx("t", "root")
+    col.open(("q", 1, 0), "quorum", ctx, slot="1")
+    col.open(("q", 1, 1), "quorum", ctx, slot="1")
+    col.open(("q", 2, 0), "quorum", ctx, slot="2")
+    col.close_group(("q", 1))
+    docs = col.export()
+    assert len(docs) == 2
+    assert {d["labels"]["slot"] for d in docs} == {"1"}
+    assert all(d["parent"] == "root" for d in docs)
+    col.close(("q", 2, 0))
+    assert len(col) == 3
+    col.clear()
+    assert len(col) == 0
+
+
+def test_collector_ring_cap_and_open_shed():
+    col = SpanCollector(node="n", cap=3)
+    for i in range(5):
+        col.finish(col.start("exec", TraceCtx(f"t{i}")))
+    assert [d["trace"] for d in col.export()] == ["t2", "t3", "t4"]
+    col2 = SpanCollector(node="m", cap=2)
+    for i in range(4):
+        col2.open(("k", i), "quorum", TraceCtx("t"))
+    for i in range(4):
+        col2.close(("k", i))
+    assert len(col2) == 2                       # opens beyond cap shed
+
+
+def test_collector_fabric_clock_is_the_step_counter():
+    fab = VirtualClockFabric()
+    col = SpanCollector(node="n", fabric=fab)
+    assert col.now() == fab.clock() == 0.0
+
+
+# ---- stitching ---------------------------------------------------------
+
+def _doc(trace, sid, parent, kind, t0, t1, node="n", **labels):
+    return {"trace": trace, "sid": sid, "parent": parent, "kind": kind,
+            "node": node, "t0": float(t0), "t1": float(t1),
+            "labels": {k: str(v) for k, v in labels.items()}}
+
+
+def test_merge_orders_canonically_with_numeric_sids():
+    assert sid_key("1.1-10") > sid_key("1.1-9")
+    a = [_doc("t", "n-10", "n-9", "exec", 5, 6)]
+    b = [_doc("t", "n-9", "", "request", 5, 7),
+         _doc("t", "n-2", "", "request", 1, 2)]
+    merged = merge([a, b])
+    assert [d["sid"] for d in merged] == ["n-2", "n-9", "n-10"]
+
+
+def test_trees_orphans_and_stitched_traces():
+    spans = [
+        _doc("t1", "c-1", "", "request", 0, 10),
+        _doc("t1", "n-1", "c-1", "quorum", 2, 6),
+        _doc("t1", "n-2", "n-1", "exec", 6, 7),
+        _doc("t2", "c-2", "", "request", 0, 4),        # lone root
+        _doc("t3", "n-5", "gone-1", "exec", 1, 2),      # orphan
+    ]
+    forest = trees(spans)
+    (root,) = forest["t1"]
+    assert root["span"]["sid"] == "c-1"
+    (q,) = root["children"]
+    assert [c["span"]["sid"] for c in q["children"]] == ["n-2"]
+    assert [d["sid"] for d in orphans(spans)] == ["n-5"]
+    # t1 stitches; a lone root and an orphaned trace do not
+    assert stitched_traces(spans) == ["t1"]
+
+
+def test_groups_of_and_label_group():
+    spans = [_doc("t", "c-1", "", "txn", 0, 9, group="7"),
+             _doc("t", "a-1", "c-1", "tpc", 1, 2)]
+    label_group([spans[1]], 3)
+    assert groups_of(spans, "t") == ["3", "7"]
+    # pre-existing labels (coordinator records) are kept
+    label_group([spans[0]], 3)
+    assert spans[0]["labels"]["group"] == "7"
+
+
+def test_phases_sum_exactly_to_e2e():
+    spans = [
+        _doc("t", "c-1", "", "request", 0, 10, node="client"),
+        _doc("t", "n-1", "c-1", "batch", 2, 3),
+        _doc("t", "n-2", "c-1", "quorum", 3, 6),
+        _doc("t", "n-3", "c-1", "exec", 6, 7),
+        _doc("t", "n-4", "c-1", "writeback", 7, 8),
+    ]
+    ph = phases(spans, "t")
+    assert ph == {"queue": 2.0, "batch": 1.0, "quorum": 3.0,
+                  "exec": 1.0, "writeback": 1.0, "other": 2.0,
+                  "e2e": 10.0}
+    assert sum(ph[p] for p in PHASES) + ph["other"] == ph["e2e"]
+    agg = aggregate_phases(spans)
+    assert agg["traces"] == 1 and agg["e2e_mean"] == 10.0
+    assert agg["coverage"] == pytest.approx(0.8)
+    assert phases(spans, "missing") is None
+    assert aggregate_phases([]) == {"traces": 0}
+
+
+# ---- rendering ---------------------------------------------------------
+
+def test_ascii_timeline_canonical():
+    spans = [
+        _doc("t", "c-1", "", "request", 0, 10, node="client"),
+        _doc("t", "n-1", "c-1", "quorum", 2, 6, slot="3"),
+    ]
+    out = ascii_timeline(spans)
+    assert out == ascii_timeline(list(reversed(spans)))  # content-only
+    assert "trace t  [0..10]  2 spans" in out
+    assert "request" in out and ". quorum" in out and "#" in out
+    assert "slot=3" in out
+    assert "phases:" in out and "e2e=10" in out
+
+
+def test_chrome_trace_events():
+    spans = [_doc("t", "c-1", "", "request", 0, 10, node="client"),
+             _doc("t", "n-1", "c-1", "exec", 2, 6, node="1.1")]
+    doc = chrome_trace(spans)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert [e["name"] for e in xs] == ["request", "exec"]
+    assert xs[0]["ts"] == 0 and xs[0]["dur"] == 10e6
+    assert xs[0]["pid"] == xs[1]["pid"]         # same trace, one pid
+    assert xs[0]["tid"] != xs[1]["tid"]         # distinct node rows
+    assert xs[1]["args"]["parent"] == "c-1"
+
+
+# ---- codec pass-through ------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["json", "pickle"])
+def test_trace_context_survives_codec_batch(kind):
+    c = Codec(kind)
+    reqs = [WireRequest(key=i, value=b"v", client_id="c", command_id=i,
+                        properties={TRACE_PROP: f"t1:n-{i}"})
+            for i in range(3)]
+    got = roundtrip(c, *reqs)                   # BATCH frame
+    assert [ctx_of(g) for g in got] == \
+        [TraceCtx("t1", f"n-{i}") for i in range(3)]
+    (one,) = roundtrip(c, reqs[0])              # bare frame
+    assert ctx_of(one) == TraceCtx("t1", "n-0")
+    (plain,) = roundtrip(c, WireRequest(key=9, value=b"", client_id="c",
+                                        command_id=9))
+    assert ctx_of(plain) is None                # unsampled stays bare
+
+
+# ---- fabric end-to-end: byte-identical replay --------------------------
+
+async def _traced_fabric_workload(tag):
+    """3-replica Paxos on a virtual-clock fabric; four writes injected
+    with harness root spans under fixed trace ids; returns the merged
+    span export."""
+    fab = VirtualClockFabric()
+    c = Cluster("paxos", cfg=chan_config(3, tag=tag), http=False,
+                fabric=fab)
+    await c.start()
+    col = SpanCollector(node="client", fabric=fab)
+    try:
+        for i in range(4):
+            sp = col.start("request", TraceCtx(f"w{i}"), key=str(i))
+            fut = asyncio.get_running_loop().create_future()
+            c["1.1"].handle_client_request(Request(
+                command=Command(i, f"v{i}".encode(), "obs", i),
+                properties={TRACE_PROP: sp.child().encode()},
+                reply_to=fut))
+            task = asyncio.ensure_future(fut)
+            for _ in range(300):
+                if task.done():
+                    break
+                await fab.run(1)
+            assert task.done(), "fabric steps exhausted"
+            assert task.result().err is None
+            col.finish(sp)
+        lists = [r.spans.export() for r in c.replicas.values()]
+        lists.append(col.export())
+        return merge(lists)
+    finally:
+        await c.stop()
+
+
+@pytest.mark.host
+def test_two_fabric_replays_render_byte_identical():
+    spans_a = asyncio.run(_traced_fabric_workload("obsfa"))
+    spans_b = asyncio.run(_traced_fabric_workload("obsfa"))
+    assert validate_spans(spans_a) == []
+    assert spans_a == spans_b                       # span-for-span
+    assert ascii_timeline(spans_a) == ascii_timeline(spans_b)
+    stitched = stitched_traces(spans_a)
+    assert stitched == [f"w{i}" for i in range(4)]
+    # the tree decomposes: every trace carries a quorum + exec chain
+    for t in stitched:
+        kinds = {d["kind"] for d in spans_a if d["trace"] == t}
+        assert {"request", "quorum", "exec"} <= kinds
+        ph = phases(spans_a, t)
+        assert ph is not None and ph["e2e"] > 0
+        assert sum(ph[p] for p in PHASES) + ph["other"] == ph["e2e"]

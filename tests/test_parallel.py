@@ -53,6 +53,9 @@ def test_sharded_fuzzed_safety():
     assert int(metrics["committed_slots"]) > 0
 
 
+@pytest.mark.slow  # tier-1 budget audit (PR 17): ~30s — compiles both a
+# sharded and an unsharded fuzzed pg run; the fault-free exact-metrics
+# sharding pin below stays in tier-1
 def test_pg_sharded_is_bit_identical_to_single_device():
     """Per-group kernels init the full carry outside the shard_map with
     the single-device PRNG layout, so a sharded fuzzed run must equal
@@ -108,6 +111,9 @@ def test_indivisible_groups_pad_and_subtract():
     assert int(m8["has_leader"]) == 12
 
 
+@pytest.mark.slow  # tier-1 budget audit (PR 17): ~44s, the suite's
+# heaviest test — two sharded compiles; the capture/replay logic keeps
+# tier-1 coverage via the single-device pins in test_trace.py
 def test_sharded_pinned_replay_reproduces_capture():
     """The carried-forward ROADMAP item: a captured trace replays
     inside a sharded batch with the state-hash + counter check intact

@@ -11,6 +11,9 @@ import pytest
 from paxi_tpu.core.command import Command, Request, pack_tpc
 from paxi_tpu.host.fabric import VirtualClockFabric
 from paxi_tpu.hunt.cases import SHARD_ROUTER_CASES
+from paxi_tpu.obs import (TRACE_PROP, SpanCollector, TraceCtx,
+                          ascii_timeline, groups_of, label_group, merge,
+                          orphans, stitched_traces)
 from paxi_tpu.shard import (CoordinatorKilled, ShardCoordinator,
                             ShardedCluster, atomic_check)
 
@@ -35,6 +38,36 @@ def direct_submit(sc):
                                  or (rep.err or "").encode()))
         sc.leader_node(group).handle_client_request(Request(
             command=Command(int(key), value), reply_to=cb))
+        return await fut
+    return submit
+
+
+def traced_submit(sc):
+    """direct_submit plus the router's participant tracing hop: a
+    record carrying ``rec["trace"]`` opens a ``tpc`` span on the
+    group's entry node and threads the span's child context into the
+    Request properties, so the group-internal batch/quorum/exec spans
+    parent under it — the cross-shard stitch the router performs."""
+    async def submit(group, key, rec):
+        value = pack_tpc(rec["kind"], rec["txid"],
+                         ops=rec.get("ops"),
+                         outcome=rec.get("outcome", ""))
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        node = sc.leader_node(group)
+        _sp = node.spans.start("tpc", TraceCtx.decode(rec.get("trace")),
+                               record=rec["kind"], txid=rec["txid"])
+        props = ({TRACE_PROP: _sp.child().encode()}
+                 if _sp is not None else {})
+
+        def cb(rep, _fut=fut):
+            node.spans.finish(_sp)
+            if not _fut.done():
+                _fut.set_result((not rep.err, rep.value
+                                 or (rep.err or "").encode()))
+        node.handle_client_request(Request(
+            command=Command(int(key), value), properties=props,
+            reply_to=cb))
         return await fut
     return submit
 
@@ -176,6 +209,77 @@ def test_coordinator_kill_matrix(point, groups, n, seeds):
         for seed in seeds:
             await one(seed)
     asyncio.run(main())
+
+
+def _traced_kill_run(point, groups, n, seed):
+    """One coordinator-kill case with full tracing — harness root span,
+    traced coordinator + recovery, participant ``tpc`` spans — and the
+    merged, group-labeled span export.  Everything runs on the fabric
+    clock with ``lease_s=0.0`` (no wall-time sleeps), so two calls are
+    step-for-step identical."""
+    async def main():
+        fab, sc = _fabric_cluster(groups=groups, n=n)
+        await sc.start()
+        try:
+            submit = traced_submit(sc)
+            col = SpanCollector(node="client", fabric=fab)
+            cspans = SpanCollector(node="coord", fabric=fab)
+            rspans = SpanCollector(node="rec", fabric=fab)
+            coord = ShardCoordinator(submit, lease_s=0.0, spans=cspans)
+            parts = fresh_parts(sc.map.span, groups, 500 + seed)
+            root = col.start("txn", TraceCtx(f"t2pc-{point}"))
+            task = await drive(fab, coord.run_txn(
+                parts, txid=f"tx-{point}", crash_at=point,
+                trace=root.child()))
+            exc = task.exception()
+            assert isinstance(exc, CoordinatorKilled), exc
+            rec = ShardCoordinator(submit, lease_s=0.0, tag="r",
+                                   spans=rspans)
+            rtask = await drive(fab, rec.recover(exc.txid, parts,
+                                                 trace=root.child()))
+            outcome = rtask.result()
+            col.finish(root)
+            lists = [cspans.export(), rspans.export(), col.export()]
+            for g in range(groups):
+                gl = [d for r in sc.group(g).replicas.values()
+                      for d in r.spans.export()]
+                lists.append(label_group(gl, g))
+            return outcome, merge(lists)
+        finally:
+            await sc.stop()
+    return asyncio.run(main())
+
+
+@pytest.mark.parametrize("point,groups,n,seeds",
+                         SHARD_ROUTER_CASES,
+                         ids=[c[0] for c in SHARD_ROUTER_CASES])
+def test_kill_matrix_span_trees_stitch(point, groups, n, seeds):
+    """Trace propagation through the 2PC kill matrix: whatever the
+    kill point, the surviving spans — coordinator records up to the
+    crash, recovery's decide/outcome records, participant tpc + group
+    pipelines — stitch into ONE tree under the harness root, with no
+    orphan participant spans and >= 2 shard groups in the tree."""
+    outcome, spans = _traced_kill_run(point, groups, n, seeds[0])
+    want = "c" if point in ("after_decide", "mid_commit") else "a"
+    assert outcome == want, (point, outcome)
+    trace = f"t2pc-{point}"
+    assert orphans(spans) == [], (point, orphans(spans))
+    assert trace in stitched_traces(spans), point
+    assert len(groups_of(spans, trace)) >= 2, point
+    kinds = {d["kind"] for d in spans if d["trace"] == trace}
+    assert "tpc" in kinds
+    assert ("commit" if want == "c" else "abort") in kinds, kinds
+
+
+def test_kill_matrix_replay_timelines_byte_identical():
+    """The determinism flank: replaying one kill case on a fresh
+    fabric yields the same spans — and the same rendered timeline,
+    byte for byte."""
+    a = _traced_kill_run("mid_commit", 2, 3, 0)
+    b = _traced_kill_run("mid_commit", 2, 3, 0)
+    assert a[0] == b[0] == "c"
+    assert a[1] == b[1]
+    assert ascii_timeline(a[1]) == ascii_timeline(b[1])
 
 
 def test_recovery_is_idempotent_against_live_coordinator():
