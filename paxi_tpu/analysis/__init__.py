@@ -43,6 +43,16 @@ obs/ tracing layer's write-only contract):
 
 - ``span-isolation``       (spanrule.py,    PXO13x)
 
+Stage 4 — replay-soundness proofs over the serving stack (the
+determinism the whole replay/span/hunt story depends on):
+
+- ``replay-determinism``   (determinism.py, PXD14x) — interprocedural
+  clock/order/ambient taint over host/shard/switchnet/obs, sanctioned
+  only by the documented fabric-resolution guards
+- ``epoch-fence``          (epochfence.py,  PXE15x) — ShardMap fence
+  proof: every map read fenced, every swap monotone (the migration
+  precondition)
+
 Entry points: ``python -m paxi_tpu lint [--rule ...] [--json]`` (cli.py;
 ``--rule`` takes family names or code prefixes like ``PXQ,PXB``) and
 :func:`run_lint` for tests/tooling.  Intentional exceptions live in
@@ -57,9 +67,11 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence
 
+import time
+
 from paxi_tpu.analysis import astutil, asyncflow, ballots, concurrency, \
-    crossflow, handlers, layout, measure, parity, purity, quorum, \
-    spanrule, tracemap, workload
+    crossflow, determinism, epochfence, handlers, layout, measure, \
+    parity, purity, quorum, spanrule, tracemap, workload
 from paxi_tpu.analysis.model import (LintReport, Suppression, Violation,
                                      apply_suppressions, inline_disables,
                                      load_baseline)
@@ -83,6 +95,8 @@ RULES = {
     layout.RULE: layout,
     workload.RULE: workload,
     spanrule.RULE: spanrule,
+    determinism.RULE: determinism,
+    epochfence.RULE: epochfence,
 }
 
 # violation-code prefix -> rule family, the CLI's short spelling
@@ -102,6 +116,8 @@ CODE_PREFIXES = {
     "PXL": layout.RULE,
     "PXW": workload.RULE,
     "PXO": spanrule.RULE,
+    "PXD": determinism.RULE,
+    "PXE": epochfence.RULE,
 }
 
 # pair-driven rules (registry-derived sim/host pairs instead of globs)
@@ -137,11 +153,14 @@ def repo_root() -> Path:
 
 
 def _target_files(root: Path, rule_mod,
-                  paths: Sequence[Path]) -> List[Path]:
+                  paths: Sequence[Path],
+                  strict: bool = False) -> List[Path]:
     """A rule's default file set restricted to ``paths`` (files or
     directories), plus any explicitly named file outside the rule's
     globs — that is how fixture tests drive a rule over seeded
-    modules."""
+    modules.  ``strict=True`` drops that out-of-glob escape so a
+    scoped run (``lint --changed``) reports exactly what a full run
+    would for the same files."""
     dirs = [p.resolve() for p in paths if p.is_dir()]
     files = {p.resolve() for p in paths if p.is_file()}
     defaults = list(astutil.iter_py(root, getattr(rule_mod, "TARGETS", ())))
@@ -149,18 +168,22 @@ def _target_files(root: Path, rule_mod,
               if p.resolve() in files
               or any(str(p.resolve()).startswith(str(d) + "/")
                      for d in dirs)]
-    default_set = {p.resolve() for p in defaults}
-    wanted += [Path(f) for f in sorted(files - default_set)]
+    if not strict:
+        default_set = {p.resolve() for p in defaults}
+        wanted += [Path(f) for f in sorted(files - default_set)]
     return sorted(set(wanted))
 
 
 def run_lint(root: Optional[Path] = None,
              rules: Optional[Sequence[str]] = None,
              baseline_path: Optional[Path] = DEFAULT_BASELINE,
-             paths: Optional[Sequence[Path]] = None) -> LintReport:
+             paths: Optional[Sequence[Path]] = None,
+             strict_targets: bool = False) -> LintReport:
     """Run the selected rule families and apply both suppression
     layers.  ``baseline_path=None`` disables the baseline (the
-    "show me everything" mode)."""
+    "show me everything" mode).  ``strict_targets=True`` keeps every
+    rule on its own globs even for explicitly named files — the
+    ``lint --changed`` contract that scoped and full runs agree."""
     root = (root or repo_root()).resolve()
     selected = resolve_rules(rules) if rules else list(RULES)
     if paths is not None:
@@ -170,20 +193,25 @@ def run_lint(root: Optional[Path] = None,
 
     raw: List[Violation] = []
     checked: set = set()
+    timings: Dict[str, float] = {}
     for name in selected:
         mod = RULES[name]
+        t0 = time.perf_counter()
         if name in _PAIR_RULES:
             # pair-based, registry-driven: restriction matches the sim
             # or host module, directories match their subtrees
             for protocol, sp, hp in mod.analyzed_pairs(root, paths):
                 raw.extend(mod.check_pair(protocol, sp, hp, root))
                 checked.update((sp, hp))
+            timings[name] = time.perf_counter() - t0
             continue
         files = (None if paths is None
-                 else _target_files(root, mod, paths))
+                 else _target_files(root, mod, paths,
+                                    strict=strict_targets))
         raw.extend(mod.check(root, files=files))
         checked.update(files if files is not None
                        else astutil.iter_py(root, mod.TARGETS))
+        timings[name] = time.perf_counter() - t0
 
     baseline = (load_baseline(baseline_path)
                 if baseline_path is not None else [])
@@ -199,4 +227,5 @@ def run_lint(root: Optional[Path] = None,
     complete = paths is None and set(selected) == set(RULES)
     unused = [s for s in baseline if not s.used] if complete else []
     return LintReport(violations=kept, suppressed=dropped,
-                      unused_baseline=unused, checked_files=len(checked))
+                      unused_baseline=unused, checked_files=len(checked),
+                      timings=timings)

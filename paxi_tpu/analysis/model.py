@@ -72,6 +72,8 @@ class LintReport:
     suppressed: List[Tuple[Violation, str]]   # (violation, why)
     unused_baseline: List[Suppression]
     checked_files: int = 0
+    # per-family wall time in seconds, insertion-ordered by run order
+    timings: Dict[str, float] = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -102,6 +104,55 @@ class LintReport:
                 {"code": s.code, "path": s.path, "match": s.match}
                 for s in self.unused_baseline],
             "checked_files": self.checked_files,
+            "timings": {k: round(t, 4)
+                        for k, t in self.timings.items()},
+        }, indent=2)
+
+    def to_sarif(self) -> str:
+        """SARIF 2.1.0 export, one run: kept findings as ``error``
+        results, suppressed ones as ``note`` results carrying a
+        ``suppressions`` record — the shape CI annotators ingest."""
+        def _result(v: Violation, level: str,
+                    why: Optional[str] = None) -> dict:
+            out = {
+                "ruleId": v.code,
+                "level": level,
+                "message": {"text": f"[{v.rule}] {v.message}"},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": v.path},
+                        "region": {"startLine": v.line,
+                                   "startColumn": v.col + 1},
+                    },
+                }],
+            }
+            if why is not None:
+                out["suppressions"] = [{"kind": "inSource"
+                                        if why == "inline"
+                                        else "external",
+                                        "justification": why}]
+            return out
+
+        everything = ([(v, "error", None) for v in self.violations]
+                      + [(v, "note", why)
+                         for v, why in self.suppressed])
+        rules = sorted({(v.code, v.rule) for v, _, _ in everything})
+        return json.dumps({
+            "$schema": ("https://json.schemastore.org/"
+                        "sarif-2.1.0.json"),
+            "version": "2.1.0",
+            "runs": [{
+                "tool": {"driver": {
+                    "name": "paxi-lint",
+                    "informationUri":
+                        "https://example.invalid/paxi_tpu/analysis",
+                    "rules": [{"id": code,
+                               "shortDescription": {"text": family}}
+                              for code, family in rules],
+                }},
+                "results": [_result(v, level, why)
+                            for v, level, why in everything],
+            }],
         }, indent=2)
 
 

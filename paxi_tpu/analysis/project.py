@@ -355,6 +355,51 @@ class ProjectIndex:
         return "\n".join(lines)
 
 
+# ---------------------------------------------------------------------------
+# wire-frame sink model (stage 4, PXD14x)
+# ---------------------------------------------------------------------------
+
+# every top-level dataclass of core/command.py crosses the wire (inside
+# WireRequest frames or the HTTP surface), so its constructor keywords
+# are frame-emission sinks alongside the @register_message classes
+_COMMAND_MODULE = "paxi_tpu/core/command.py"
+
+_MESSAGE_FIELDS: Dict[int, Dict[str, List[str]]] = {}
+
+
+def message_fields(index: "ProjectIndex") -> Dict[str, List[str]]:
+    """The wire-frame sink model: class name -> declared field names,
+    for every class decorated ``@register_message`` anywhere in the
+    indexed universe, plus the client wire types of
+    ``core/command.py``.  A constructor call (or field store) on one of
+    these is where host state meets the wire — the PXD14x frame-
+    emission sink set.  Purely static (decorator spotting, AnnAssign
+    fields) and cached per index, like the call graph."""
+    cached = _MESSAGE_FIELDS.get(id(index))
+    if cached is not None:
+        return cached
+    out: Dict[str, List[str]] = {}
+
+    def fields_of(cls: ast.ClassDef) -> List[str]:
+        return [item.target.id for item in cls.body
+                if isinstance(item, ast.AnnAssign)
+                and isinstance(item.target, ast.Name)]
+
+    for rel in index._universe():
+        info = index.module(rel)
+        if info is None:
+            continue
+        for node in info.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if rel == _COMMAND_MODULE or any(
+                    d.split(".")[-1] == "register_message"
+                    for d in astutil.decorator_names(node)):
+                out.setdefault(node.name, fields_of(node))
+    _MESSAGE_FIELDS[id(index)] = out
+    return out
+
+
 def _iter_defs(info: ModInfo) -> List[Tuple[str, ast.AST]]:
     """(qualname, def node) for every top-level function and method —
     the units the call graph attributes edges to.  Nested defs belong

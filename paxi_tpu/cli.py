@@ -937,6 +937,33 @@ def cmd_spans(args) -> int:
     return 0
 
 
+def _git_changed_py(root) -> list:
+    """Files for ``lint --changed``: tracked modifications vs HEAD plus
+    untracked files, filtered to ``paxi_tpu/*.py`` (the analyzer's
+    universe).  Deleted files vanish from the diff listing only once
+    unlinked, so drop anything that no longer exists."""
+    import subprocess
+    from pathlib import Path
+
+    names: list = []
+    for cmd in (["git", "diff", "--name-only", "HEAD", "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            out = subprocess.run(cmd, cwd=root, capture_output=True,
+                                 text=True, check=True).stdout
+        except (OSError, subprocess.CalledProcessError) as e:
+            raise ValueError(f"--changed needs a git checkout: {e}")
+        names.extend(out.splitlines())
+    seen = set()
+    changed = []
+    for n in names:
+        if (n.endswith(".py") and n.startswith("paxi_tpu/")
+                and n not in seen and (root / n).is_file()):
+            seen.add(n)
+            changed.append(Path(root / n))
+    return changed
+
+
 def cmd_lint(args) -> int:
     """paxi-lint: the protocol-aware static analyzer (paxi_tpu/analysis).
 
@@ -954,16 +981,39 @@ def cmd_lint(args) -> int:
         print(shared_index(analysis.repo_root()).to_dot())
         return 0
 
+    paths = [Path(p) for p in args.paths]
+    strict_targets = False
+    if args.changed:
+        if paths:
+            print("lint: --changed and explicit paths are exclusive",
+                  file=sys.stderr)
+            return 2
+        changed = _git_changed_py(analysis.repo_root())
+        if not changed:
+            print("lint: no changed paxi_tpu/*.py files — nothing to do")
+            return 0
+        paths = changed
+        # a changed file outside a family's TARGETS globs must stay
+        # outside it (same verdicts as a full run, just scoped), so
+        # disable the explicit-file escape hatch
+        strict_targets = True
     baseline = None if args.no_baseline else (
         Path(args.baseline) if args.baseline else analysis.DEFAULT_BASELINE)
     try:
         report = analysis.run_lint(
             rules=args.rule or None,
             baseline_path=baseline,
-            paths=[Path(p) for p in args.paths] or None)
+            paths=paths or None,
+            strict_targets=strict_targets)
     except (KeyError, ValueError) as e:
         print(f"lint: {e}", file=sys.stderr)
         return 2
+    if args.sarif:
+        text = report.to_sarif()
+        if args.sarif == "-":
+            print(text)
+        else:
+            Path(args.sarif).write_text(text + "\n")
     if args.json:
         print(report.to_json())
     else:
@@ -1302,6 +1352,15 @@ def main(argv=None) -> int:
                     help="exit 1 on stale (unused) baseline entries — "
                          "the verify.sh --lint gate's baseline-shrink "
                          "policy")
+    li.add_argument("-sarif", "--sarif", default="",
+                    help="also write the report as SARIF 2.1.0 to this "
+                         "path (`-` for stdout) — CI code-scanning "
+                         "upload format")
+    li.add_argument("-changed", "--changed", action="store_true",
+                    help="lint only paxi_tpu/*.py files changed vs git "
+                         "HEAD (plus untracked); families keep their "
+                         "TARGETS scoping so verdicts agree with a "
+                         "full run")
     li.add_argument("-graph", "--graph", action="store_true",
                     help="dump the ProjectIndex cross-module call "
                          "graph as GraphViz DOT (nodes colored by "

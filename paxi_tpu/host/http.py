@@ -352,7 +352,7 @@ class HTTPServer:
         self._timeouts.append((loop.time() + self.REQUEST_TIMEOUT, slot))
         self.node.handle_client_request(Request(
             command=Command(key, value, client_id, command_id),
-            properties=props or {}, timestamp=time.time(),
+            properties=props or {}, timestamp=self.node.spans.now(),
             node_id=self._node_id, reply_to=reply_cb))
         return slot
 
@@ -414,7 +414,7 @@ class HTTPServer:
         self.node.handle_client_request(Request(
             command=Command(cmds[0].key, pack_transaction(cmds),
                             client_id, command_id),
-            properties=props or {}, timestamp=time.time(),
+            properties=props or {}, timestamp=self.node.spans.now(),
             node_id=self._node_id, reply_to=reply_cb))
         return slot
 
@@ -494,7 +494,7 @@ class HTTPServer:
                  if k.startswith("property-")}
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self.node.handle_client_request(Request(
-            command=cmd, properties=props, timestamp=time.time(),
+            command=cmd, properties=props, timestamp=self.node.spans.now(),
             node_id=str(self.node.id), reply_to=fut))
         try:
             rep = await asyncio.wait_for(fut, timeout=10.0)
@@ -533,7 +533,7 @@ class HTTPServer:
                       command_id=int(headers.get("command-id", "0")))
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self.node.handle_client_request(Request(
-            command=cmd, timestamp=time.time(),
+            command=cmd, timestamp=self.node.spans.now(),
             node_id=str(self.node.id), reply_to=fut))
         try:
             rep = await asyncio.wait_for(fut, timeout=10.0)
@@ -583,7 +583,7 @@ class HTTPServer:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self.node.handle_client_request(Request(
             command=Command(key, value), properties=props,
-            timestamp=time.time(),
+            timestamp=self.node.spans.now(),
             node_id=self._node_id, reply_to=fut))
         try:
             rep = await asyncio.wait_for(fut, timeout=10.0)

@@ -214,7 +214,7 @@ class Node:
         wr = WireRequest(
             key=c.key, value=c.value, client_id=c.client_id,
             command_id=c.command_id, properties=dict(req.properties),
-            timestamp=req.timestamp or time.time(),
+            timestamp=req.timestamp or self.spans.now(),
             node_id=str(self.id), seq=seq)
         buf = self._fwd_buf.get(to)
         if buf is None:

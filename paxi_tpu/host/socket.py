@@ -80,7 +80,10 @@ class Socket:
         self._slow: Dict[ID, tuple] = {}   # id -> (delay_s, until)
         self._flaky: Dict[ID, tuple] = {}  # id -> (p, until)
         self._matchers: List[MsgMatcher] = []  # trace-driven faults
-        self._rng = random.Random(hash(self.id) & 0xFFFF)
+        # seeded from the id STRING: hash(str) is PYTHONHASHSEED-
+        # randomized per process, which reseeded the flaky-fault RNG
+        # differently on every run
+        self._rng = random.Random(str(self.id))
 
     # ---- lifecycle -----------------------------------------------------
     async def start(self) -> None:
@@ -91,10 +94,15 @@ class Socket:
             self.cfg.addrs[self.id], self._deliver, self.codec)
 
     def _deliver(self, msg: Any) -> None:
-        if time.monotonic() < self._crashed_until:
-            self.metrics.counter("paxi_msgs_recv_dropped_total",
-                                 reason="crashed").inc()
-            return  # crashed: receives suppressed too
+        if self.fabric is None:
+            # live serving only: under a fabric the trace's fault
+            # schedule owns crash windows — consulting the wall clock
+            # here made fabric replays diverge when a crash window was
+            # armed mid-replay
+            if time.monotonic() < self._crashed_until:
+                self.metrics.counter("paxi_msgs_recv_dropped_total",
+                                     reason="crashed").inc()
+                return  # crashed: receives suppressed too
         self.inbox.put_nowait(msg)
 
     async def recv(self) -> Any:

@@ -490,6 +490,7 @@ PYEOF
     # readable diagnostics
     mkdir -p artifacts
     if ! timeout -k 10 180 python -m paxi_tpu lint --strict-unused \
+        --sarif artifacts/LINT_REPORT.sarif \
         --json > artifacts/LINT_REPORT.json; then
       timeout -k 10 180 python -m paxi_tpu lint --strict-unused
       exit 1
@@ -508,13 +509,33 @@ for v in r["violations"] + r["suppressed"]:
     for k in ("rule", "code", "path", "line", "col", "message"):
         assert k in v, (k, v)
 known = ("PXK", "PXH", "PXT", "PXC", "PXQ", "PXB", "PXS", "PXF", "PXA",
-         "PXM", "PXL", "PXW", "PXO")
+         "PXM", "PXL", "PXW", "PXO", "PXD", "PXE")
 for s in r["suppressed"]:
     assert s["code"].startswith(known), s["code"]
     assert s.get("suppressed_by"), s
 print(f"LINT_REPORT.json OK: {r['checked_files']} files, "
       f"{len(r['violations'])} violations, "
       f"{len(r['suppressed'])} suppressed")
+# per-family wall time: the whole gate must stay commit-cheap, so
+# make any single family's creep visible here
+for fam, secs in sorted(r.get("timings", {}).items(),
+                        key=lambda kv: -kv[1]):
+    print(f"  {fam:<22s} {secs:7.3f}s")
+# SARIF artifact: same run, CI code-scanning format; gate its shape
+with open("artifacts/LINT_REPORT.sarif") as f:
+    s = json.load(f)
+assert s["version"] == "2.1.0", s.get("version")
+assert s["$schema"].endswith("sarif-2.1.0.json"), s["$schema"]
+run = s["runs"][0]
+assert run["tool"]["driver"]["name"] == "paxi-lint"
+assert len(run["results"]) == len(r["violations"]) + len(r["suppressed"])
+for res in run["results"]:
+    assert res["level"] in ("error", "note"), res
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"], res
+    assert loc["region"]["startLine"] >= 1, res
+print(f"LINT_REPORT.sarif OK: {len(run['results'])} results, "
+      f"{len(run['tool']['driver']['rules'])} rules")
 PYEOF
     echo "== compileall (syntax tier) =="
     timeout -k 10 120 python -m compileall -q paxi_tpu tests scripts \

@@ -1,0 +1,88 @@
+"""Seeded replay-determinism violations (PXD14x).
+
+Parsed by tests/test_lint.py, never imported.  Mutants first;
+everything from ``class CleanHost`` down is the sanctioned
+fabric-resolution discipline and must stay green.
+"""
+
+import os
+import random
+import time
+import uuid
+
+from paxi_tpu.core.command import Request
+
+
+class BadHost:
+    def emit_wall_clock_frame(self):
+        # PXD141: raw wall clock into a wire-frame field
+        self.socket.send(self.leader, Request(
+            command=None, timestamp=time.time()))
+
+    def fault_window_branch(self):
+        # PXD141: wall clock steers a fault-window comparison
+        if time.monotonic() < self._crashed_until:
+            return None
+        return self.inbox
+
+    def arm_window(self, t):
+        # PXD141: wall clock stored into instance state
+        self._crashed_until = time.monotonic() + t
+
+    def emit_hash_order(self, peers):
+        # PXD142: hash-ordered iteration into frame emission
+        for p in set(peers):
+            self.socket.send(p, Request(command=None, node_id=p))
+
+    def pick_by_hash_order(self, peers):
+        # PXD142: hash-ordered head steers a protocol decision
+        first = list(set(peers))[0]
+        if first == self.id:
+            self.lead()
+
+    def ambient_reads(self):
+        # PXD143 x3: env read, unseeded RNG, uuid4
+        limit = os.getenv("PAXI_LIMIT")
+        rng = random.Random()
+        tag = uuid.uuid4().hex
+        return limit, rng, tag
+
+
+def stamp_helper():
+    # returns a raw clock on a replay-reachable path, so the clock
+    # pre-pass marks it and its call sites become PXD141 roots (the
+    # interprocedural step)
+    return time.time()
+
+
+class HelperHost:
+    def emit_helper_stamp(self, frame):
+        # PXD141: helper-laundered wall clock into a stamp field
+        frame.timestamp = stamp_helper()
+
+
+class CleanHost:
+    def clean_now(self):
+        # the documented resolution: raw clock only on the live path
+        if self.fabric is not None:
+            return self.fabric.clock()
+        return time.perf_counter()
+
+    def clean_gated_window(self, t):
+        # live-only dominated: replay never reaches the store
+        if self.fabric is None:
+            self._crashed_until = time.monotonic() + t
+
+    def clean_seeded_rng(self):
+        # seeded Random is the sanctioned form
+        self._rng = random.Random(str(self.id))
+
+    def clean_sorted_iteration(self, peers):
+        # sorted(...) launders hash order
+        for p in sorted(set(peers)):
+            self.socket.send(p, Request(command=None, node_id=p))
+
+    def clean_resolved_stamp(self):
+        # stamping from the resolved clock is the fix shape
+        self.socket.send(self.leader, Request(
+            command=None, timestamp=self.spans.now()))

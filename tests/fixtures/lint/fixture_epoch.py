@@ -1,0 +1,59 @@
+"""Seeded shard-epoch fence violations (PXE15x).
+
+Parsed by tests/test_lint.py, never imported.  Mutants first;
+everything from ``class CleanRouter`` down is the documented swap
+discipline (lock-fenced snapshots, monotone installs, param-fenced
+consumers) and must stay green.
+"""
+
+
+class BadRouter:
+    def read_unfenced(self, key):
+        # PXE151: ShardMap read outside the lock
+        return self._map.group_of(key)
+
+    def consume_unfenced(self, key, ops):
+        # PXE151 x2: consumers fed a non-fence-dominated snapshot
+        g = self.cached_map.group_of(key)
+        parts = partition_ops(self.cached_map, ops)
+        return g, parts
+
+    def swap_unlocked(self, new_map):
+        # PXE152: map install outside the lock
+        self._map = new_map
+
+    def swap_unguarded(self, new_map):
+        # PXE152: in-lock install with no strict version-advance proof
+        with self._lock:
+            self._map = new_map
+
+
+class CleanRouter:
+    def __init__(self, initial):
+        self._map = initial          # construction install is sanctioned
+        self._lock = None
+
+    def clean_snapshot_read(self, key):
+        # the flush idiom: in-lock bind, use outside the lock
+        with self._lock:
+            m = self._map
+        return m.group_of(key)
+
+    def clean_install(self, new_map):
+        # the install_map idiom: early-exit spelling of new > current
+        with self._lock:
+            if new_map.version <= self._map.version:
+                raise ValueError("stale map")
+            self._map = new_map
+
+    def clean_param_consumers(self, m, ops):
+        # parameters are fenced (the caller owed us a snapshot), and
+        # move_range derives a fenced map from a fenced map
+        parts = partition_ops(m, ops)
+        m2 = m.move_range(0, 8, "2.1")
+        return parts, m2.group_of(3)
+
+    def clean_fenced_attr(self, router, key):
+        # the shard_map property takes the lock itself
+        m = router.shard_map
+        return m.group_of(key)

@@ -1,0 +1,178 @@
+"""Fabric-replayed regression tests for the PXD141 replay-divergence
+fixes (analysis/determinism.py found them; this file pins the fixes).
+
+Three wall-clock leaks made fabric replays diverge from the logical
+timeline:
+
+- ``host/socket.py`` ``_deliver`` consulted the wall-clock crash
+  window even when a fabric owned delivery, so a ``crash(t)`` armed
+  mid-replay suppressed deliveries for *wall* seconds — whether a
+  message survived depended on how fast the host machine ran the
+  replay;
+- ``host/http.py`` stamped every synthesized ``Request`` with
+  ``time.time()``, putting an epoch wall-clock into a replay-visible
+  wire field;
+- ``host/node.py`` ``forward`` backfilled missing timestamps with
+  ``time.time()`` on the forwarded ``WireRequest``.
+
+The fixes route all three through the resolved fabric clock (the
+``spans.now()`` discipline) or gate them on ``fabric is None``; the
+tests below replay each path under a ``VirtualClockFabric`` and assert
+logical-step stamps and byte-identical double replays — plus negative
+controls that the LIVE fault surface still works without a fabric.
+"""
+
+import asyncio
+
+import pytest
+
+from paxi_tpu.core.command import Command, Request
+from paxi_tpu.core.ident import ID
+from paxi_tpu.host.fabric import VirtualClockFabric
+from paxi_tpu.host.simulation import Cluster, chan_config
+from paxi_tpu.host.socket import Socket
+
+pytestmark = pytest.mark.host
+
+
+def test_crash_armed_replay_commits_and_is_byte_identical():
+    """The socket.py fix: a wall-clock crash window armed DURING a
+    fabric replay must not suppress fabric deliveries (the fabric owns
+    the fault model).  Before the fix, arming ``crash(1000)`` on every
+    socket mid-replay dropped every subsequent delivery for 1000 wall
+    seconds and the command could never commit."""
+    def once():
+        async def main():
+            fab = VirtualClockFabric()
+            c = Cluster("paxos", n=3, http=False, fabric=fab)
+            await c.start()
+            replies = []
+
+            def driver(t: int) -> None:
+                if t == 0:
+                    c["1.1"].handle_client_request(Request(
+                        command=Command(0, b"seed", "c", 1),
+                        reply_to=lambda rep: None))
+                elif t == 2:
+                    # arm the LIVE fault surface on every replica while
+                    # the replay is in flight
+                    for i in c.ids:
+                        c[i].socket.crash(1000.0)
+                elif t == 3:
+                    c["1.1"].handle_client_request(Request(
+                        command=Command(1, b"x", "c", 2),
+                        reply_to=replies.append))
+
+            fab.on_step(driver)
+            await fab.run(8, drain=True)
+            log = list(fab.delivery_log)
+            stats = dict(fab.stats)
+            db = {str(i): c[i].db.get(1) for i in c.ids}
+            await c.stop()
+            return log, stats, db, [r.err for r in replies]
+        return asyncio.run(main())
+
+    a = once()
+    b = once()
+    assert a == b            # two replays, one byte-identical timeline
+    log, stats, db, errs = a
+    assert errs == [None]
+    assert db == {"1.1": b"x", "1.2": b"x", "1.3": b"x"}
+    assert stats["delivered"] > 0
+
+
+def test_crash_window_still_arms_live_sockets():
+    """Negative control: without a fabric the crash window keeps its
+    socket.go semantics — receives are suppressed for the window."""
+    async def main():
+        crashed = Socket(ID("1.1"), chan_config(1, tag="live-crash"))
+        assert crashed.fabric is None
+        crashed.crash(1000.0)
+        crashed._deliver("m")
+        assert crashed.inbox.qsize() == 0   # suppressed
+
+        fresh = Socket(ID("1.1"), chan_config(1, tag="live-fresh"))
+        fresh._deliver("m")
+        assert fresh.inbox.qsize() == 1     # no window: delivered
+    asyncio.run(main())
+
+
+def test_forward_stamp_rides_fabric_clock():
+    """The node.py fix: a forwarded request with no client timestamp
+    is stamped from the resolved fabric clock — the logical step the
+    forward happened at, not an epoch wall-clock."""
+    async def main():
+        fab = VirtualClockFabric()
+        c = Cluster("paxos", n=3, http=False, fabric=fab)
+        await c.start()
+        r2 = c["1.2"]
+        sent = []
+        r2.socket.send = lambda to, msg: sent.append(msg)
+
+        def driver(t: int) -> None:
+            if t == 4:
+                r2.forward(ID("1.1"), Request(
+                    command=Command(5, b"v", "cli", 1)))
+
+        fab.on_step(driver)
+        await fab.run(6, drain=True)
+        await c.stop()
+        return sent
+    sent = asyncio.run(main())
+    assert len(sent) == 1
+    wr = sent[0]
+    assert type(wr).__name__ == "WireRequest"
+    assert wr.timestamp == 4.0   # the logical step, not time.time()
+
+
+def test_http_entry_stamp_rides_fabric_clock():
+    """The http.py fix: the server's synthesized Request carries the
+    fabric-resolved clock in its wire-visible timestamp field."""
+    from paxi_tpu.host.http import HTTPServer
+
+    async def main():
+        fab = VirtualClockFabric()
+        c = Cluster("paxos", n=3, http=False, fabric=fab)
+        await c.start()
+        r0 = c["1.1"]
+        srv = HTTPServer(r0)
+        srv._loop = asyncio.get_running_loop()
+        seen = []
+        r0.handle_client_request = seen.append
+
+        def driver(t: int) -> None:
+            if t == 3:
+                srv._enqueue_kv(7, b"v", "cli", 1)
+
+        fab.on_step(driver)
+        await fab.run(5, drain=True)
+        await c.stop()
+        return seen
+    seen = asyncio.run(main())
+    assert len(seen) == 1
+    assert seen[0].timestamp == 3.0   # logical step, not an epoch stamp
+
+
+def test_live_entry_stamp_is_monotonic_clock():
+    """Without a fabric the stamp falls back to the live serving clock
+    (perf_counter domain) — present and positive, but never the
+    fabric's integral step values by accident."""
+    async def main():
+        c = Cluster("paxos", n=3, http=False)
+        await c.start()
+        try:
+            r2 = c["1.2"]
+            sent = []
+            r2.socket.send = lambda to, msg: sent.append(msg)
+            r2.forward(ID("1.1"), Request(
+                command=Command(5, b"v", "cli", 1)))
+            for _ in range(10):
+                await asyncio.sleep(0)
+                if sent:
+                    break
+            return sent
+        finally:
+            await c.stop()
+    sent = asyncio.run(main())
+    assert len(sent) == 1
+    assert sent[0].timestamp > 0.0
