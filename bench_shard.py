@@ -4,9 +4,15 @@ behind the router, same-day same-box — aggregate cmds/s vs shards
 the identical fleet, surface, workers and offered ramp, serving as ONE
 consensus group.
 
+Every G >= 2 run also performs a live mid-ramp ``move_range`` of a
+non-empty hot range (migrate=True): the migrated-keys readback oracle
+must be clean and the in-window completion p99 ("migration blip") must
+stay within 3x the steady-state p99.
+
 Writes BENCH_SHARD.json; exits nonzero if any run reports
-linearizability anomalies, a 2PC atomicity violation, or the G=4
-aggregate fails to clear the same-day G=1 control.
+linearizability anomalies, a 2PC atomicity violation, a migration
+oracle failure, a blip beyond the 3x gate, or the G=4 aggregate fails
+to clear the same-day G=1 control.
 """
 
 from __future__ import annotations
@@ -20,6 +26,23 @@ import time
 from paxi_tpu.shard.bench import shard_ramp
 
 GS = (1, 2, 4)
+BLIP_GATE = 3.0  # migration blip p99 must stay within 3x steady p99
+
+
+def _migration_gate(r: dict) -> tuple[dict | None, bool]:
+    """(migration block, gate ok) for one shard_ramp result."""
+    mig = next((p for p in r["phases"] if p["phase"] == "migrate"),
+               None)
+    if mig is None:
+        return None, True
+    m = mig["migration"]
+    ok = (m["epoch"] == "complete"
+          and (m["installed"] or 0) > 0
+          and m["oracle"]["clean"]
+          and (mig["anomalies"] or 0) == 0)
+    if m["steady_p99_ms"] and m["blip_ratio"] is not None:
+        ok = ok and m["blip_ratio"] <= BLIP_GATE
+    return m, ok
 
 
 def main() -> int:
@@ -33,12 +56,24 @@ def main() -> int:
     for gi, g in enumerate(GS):
         r = asyncio.run(shard_ramp(
             shards=g, fleet=fleet, workers=workers, rates=rates,
-            step_s=step_s, base_port=18300 + 40 * gi))
+            step_s=step_s, base_port=18300 + 40 * gi,
+            migrate=g >= 2))
         print(json.dumps({k: v for k, v in r.items()
                           if k != "phases"}), flush=True)
         curve.append(r)
         if (r["anomalies"] or 0) > 0 or (
                 r["txn"] and r["txn"]["atomicity_violations"] > 0):
+            worst = 1
+        m, ok = _migration_gate(r)
+        if m is not None:
+            print(json.dumps({"shards": g, "migration": {
+                "installed": m["installed"],
+                "migration_blip_p99_ms": m["migration_blip_p99_ms"],
+                "steady_p99_ms": m["steady_p99_ms"],
+                "blip_ratio": m["blip_ratio"],
+                "oracle_clean": m["oracle"]["clean"],
+                "gate_ok": ok}}), flush=True)
+        if not ok:
             worst = 1
     control = next(r for r in curve if r["shards"] == 1)
     top = next(r for r in curve if r["shards"] == GS[-1])
@@ -54,7 +89,10 @@ def main() -> int:
             "for every G; G=1 is the control. Each run: disjoint-then-"
             "crossing worker key ranges, per-worker linearizability "
             "verdicts (anomalies sum), and a cross-shard 2PC burst "
-            "with a linearizable-readback atomicity oracle. The "
+            "with a linearizable-readback atomicity oracle; G >= 2 "
+            "runs add a live mid-ramp move_range of a non-empty hot "
+            "range gated on a clean migrated-keys readback oracle and "
+            f"a blip p99 within {BLIP_GATE}x steady p99. The "
             "leader's O(n-1) replication fan shrinks with G — the "
             "compartmentalization papers' bottleneck-role scaling, "
             "observable end-to-end; this box is single-core, so the "
@@ -67,6 +105,9 @@ def main() -> int:
         "offered_rates_ops_s": rates,
         "curve": curve,
         "g4_above_g1_control": scaled,
+        "migration_blip_gate_x": BLIP_GATE,
+        "migration_gates_ok": all(
+            _migration_gate(r)[1] for r in curve),
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_SHARD.json")
@@ -81,6 +122,11 @@ def main() -> int:
         "atomicity_violations": sum(
             (r["txn"] or {}).get("atomicity_violations", 0)
             for r in curve),
+        "migration_blip_p99_ms": {
+            str(r["shards"]): _migration_gate(r)[0]
+            ["migration_blip_p99_ms"]
+            for r in curve if _migration_gate(r)[0] is not None},
+        "migration_gates_ok": doc["migration_gates_ok"],
     }))
     return worst
 

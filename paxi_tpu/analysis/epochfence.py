@@ -20,17 +20,19 @@ A map value is **fenced** when it is:
   ``<obj>.shard_map``;
 - a function parameter (the caller owed us a fenced value — this is
   how ``txn.partition_ops(shard_map, ops)`` stays in the proof);
-- a name assigned from any fenced value, a ``.move_range(...)``
-  result (pure derivation of a fenced map), or another fenced name —
+- a name assigned from any fenced value, a ``.move_range(...)`` /
+  ``.with_migration(...)`` / ``.complete_migration(...)`` result
+  (pure derivations of a fenced map), or another fenced name —
   closed over the function by a two-pass propagation, so the
   snapshot-then-use-outside-the-lock idiom (``flush``) proves clean.
 
 Checks:
 
 - **PXE151** unfenced map read: a ``._map`` attribute load outside
-  any lock region, or a ``group_of(...)`` / ``partition_ops(...)``
-  whose map operand is not a fenced value — each one is a key that
-  can resolve against a routing table mid-swap;
+  any lock region, or a ``group_of(...)`` / ``migration_of(...)`` /
+  ``ranges_of(...)`` / ``partition_ops(...)`` whose map operand is
+  not a fenced value — each one is a key that can resolve against a
+  routing table mid-swap;
 - **PXE152** non-monotone map write: a store to ``._map`` outside
   ``__init__`` that is not inside a lock region *and* dominated by a
   strict version-advance comparison (``new.version > current.version``
@@ -57,6 +59,7 @@ RULE = "epoch-fence"
 TARGETS = (
     "paxi_tpu/shard/router.py",
     "paxi_tpu/shard/txn.py",
+    "paxi_tpu/shard/migrate.py",
 )
 
 # attribute names that ARE the guarded routing table
@@ -64,10 +67,15 @@ _MAP_ATTRS = ("_map",)
 # attribute reads that are fenced by construction (the property takes
 # the lock; reading it yields an immutable snapshot)
 _FENCED_ATTRS = ("shard_map",)
-# calls that consume a map operand which must be fenced
-_MAP_CONSUMERS = ("group_of", "partition_ops")
+# calls that consume a map operand which must be fenced; the
+# method-style ones (receiver IS the map) vs. the function-style ones
+# (map is the first argument) are told apart in _check_consumer
+_MAP_CONSUMERS = ("group_of", "migration_of", "ranges_of",
+                  "partition_ops")
+_METHOD_CONSUMERS = ("group_of", "migration_of", "ranges_of")
 # calls whose result is a fenced map derivation
-_FENCED_DERIVATIONS = ("move_range",)
+_FENCED_DERIVATIONS = ("move_range", "with_migration",
+                       "complete_migration")
 
 _NEGATE = {ast.Lt: ast.GtE, ast.LtE: ast.Gt, ast.Gt: ast.LtE,
            ast.GtE: ast.Lt}
@@ -253,7 +261,7 @@ class _FnCheck:
         name = (astutil.dotted_name(call.func) or "").split(".")[-1]
         if name not in _MAP_CONSUMERS:
             return
-        if name == "group_of":
+        if name in _METHOD_CONSUMERS:
             assert isinstance(call.func, ast.Attribute)
             operand: Optional[ast.expr] = call.func.value
         else:

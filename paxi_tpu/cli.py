@@ -108,7 +108,9 @@ def cmd_bench_host(args) -> int:
             seed=args.seed, base_port=args.base_port,
             txns=args.txns, lin=not args.no_lin, conns=args.conns,
             proc=args.cluster_proc,
-            workload=getattr(args, "workload", "")))
+            workload=getattr(args, "workload", ""),
+            migrate=getattr(args, "migrate", False),
+            routers=getattr(args, "routers", 1)))
         print(json.dumps({k: v for k, v in out.items()
                           if k != "phases"}))
         if args.out:
@@ -1158,6 +1160,16 @@ def main(argv=None) -> int:
     bh.add_argument("-txns", "--txns", type=int, default=8,
                     help="cross-shard 2PC transactions fired after "
                          "the ramp (atomicity oracle)")
+    bh.add_argument("-migrate", "--migrate", action="store_true",
+                    help="sharded mode: add a live-migration phase — "
+                         "hot-range traffic, a mid-phase Rebalancer "
+                         "split + streamed NON-EMPTY range move, and "
+                         "the migration_blip_p99_ms / readback-oracle "
+                         "evidence (shard/migrate.py)")
+    bh.add_argument("-routers", "--routers", type=int, default=1,
+                    help="sharded mode: router endpoints over the same "
+                         "groups (1 primary + N-1 stateless "
+                         "secondaries sharing the versioned map)")
     bh.add_argument("-trace_sample", "--trace-sample",
                     dest="trace_sample", type=float, default=0.0,
                     help="span sampling rate 0..1 (0 = tracing off); "

@@ -98,10 +98,65 @@ TXN_MAGIC = b"\x00txn:"
 # whatever consensus protocol the group runs — one ordered command per
 # 2PC state transition, interpreted by Database._execute_tpc.
 TPC_MAGIC = b"\x002pc:"
+# live data migration records (paxi_tpu/shard/migrate.py): begin /
+# read / install / start / cutover / done / drop ride each group's
+# ordered log exactly like 2PC records, so every epoch transition of a
+# range handoff is one totally-ordered log entry interpreted by
+# Database._execute_mig — crash recovery is replaying the log.
+MIG_MAGIC = b"\x00mig:"
+# the reply marker a replica returns for a key it has RELEASED to a
+# new owner group (post-cutover): never stored, only returned, so a
+# stale router learns the range moved and reroutes instead of serving
+# stale state or losing a write
+MOVED_MAGIC = b"\x00moved:"
 # every value prefix the KV surface must refuse from external clients
-# (a client value carrying either magic would be reinterpreted by the
+# (a client value carrying any magic would be reinterpreted by the
 # state machine at execute time on every replica)
-RESERVED_PREFIXES = (TXN_MAGIC, TPC_MAGIC)
+RESERVED_PREFIXES = (TXN_MAGIC, TPC_MAGIC, MIG_MAGIC)
+
+MIG_KINDS = ("begin", "read", "install", "start", "cutover", "done",
+             "drop")
+
+
+def pack_mig(kind: str, mid: str, lo: int = 0, hi: int = 0,
+             span: int = 0, items=None, cursor: int = -1,
+             limit: int = 0) -> Value:
+    """Encode one migration record as an opaque command value
+    (shard/migrate.py epoch taxonomy; interpreted by
+    ``Database._execute_mig``).  ``items`` is the install chunk:
+    [(key, value), ...]."""
+    import json
+    doc: dict = {"kind": kind, "mid": mid}
+    if hi:
+        doc.update(lo=int(lo), hi=int(hi), span=int(span))
+    if items is not None:
+        doc["items"] = [[int(k), v.decode("latin1")] for k, v in items]
+    if cursor >= 0:
+        doc["cursor"] = int(cursor)
+    if limit:
+        doc["limit"] = int(limit)
+    return MIG_MAGIC + json.dumps(doc).encode()
+
+
+def unpack_mig(value: Value):
+    """The migration record back out of a packed value, or None for
+    plain/malformed values (poison-command safety, same contract as
+    unpack_tpc)."""
+    import json
+    if not value.startswith(MIG_MAGIC):
+        return None
+    try:
+        doc = json.loads(value[len(MIG_MAGIC):].decode())
+        if doc["kind"] not in MIG_KINDS \
+                or not isinstance(doc["mid"], str):
+            return None
+        if "items" in doc:
+            doc["items"] = [(int(k), v.encode("latin1"))
+                            for k, v in doc["items"]]
+        return doc
+    except (ValueError, TypeError, KeyError, AttributeError,
+            UnicodeDecodeError):
+        return None
 
 
 def pack_tpc(kind: str, txid: str, ops=None, outcome: str = "") -> Value:

@@ -12,8 +12,9 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
-from paxi_tpu.core.command import (TPC_MAGIC, TXN_MAGIC, Command, Key,
-                                   Value, pack_values, unpack_tpc,
+from paxi_tpu.core.command import (MIG_MAGIC, MOVED_MAGIC, TPC_MAGIC,
+                                   TXN_MAGIC, Command, Key, Value,
+                                   pack_values, unpack_mig, unpack_tpc,
                                    unpack_transaction)
 
 
@@ -35,6 +36,30 @@ class Database:
         # coordinator-recovery tiebreak rides on log order).
         self._staged: Dict[str, list] = {}
         self._decided: Dict[str, str] = {}
+        # live-migration state (paxi_tpu/shard/migrate.py): evolves
+        # only through ordered ``mig`` records, so — like the 2PC
+        # dicts — it is identical at every replica of a group and
+        # crash recovery is log replay.
+        #   _mig_open: destination-side install windows, mid ->
+        #     {lo, hi, span, src, dirty}.  ``dirty`` holds keys this
+        #     replica wrote AFTER the window opened (double-write
+        #     duplicates / post-cutover traffic); ``install`` chunks
+        #     skip them so a late snapshot item can never clobber a
+        #     newer duplicated write.
+        #   _mig_done: completed migration ids — a replayed ``begin``
+        #     must not re-open a finished window.
+        #   _frozen: source-side fence (the ``start`` record): 2PC
+        #     prepares on the range vote NO from the fence until
+        #     cutover, so no transaction can stage into the range
+        #     after the catch-up stream's log position.
+        #   _released: source-side post-cutover ranges: plain reads
+        #     and writes of a released key return MOVED_MAGIC instead
+        #     of executing — the bounce a stale router turns into a
+        #     reroute.
+        self._mig_open: Dict[str, dict] = {}
+        self._mig_done: set = set()
+        self._frozen: Dict[str, tuple] = {}
+        self._released: Dict[str, tuple] = {}
 
     def execute(self, cmd: Command) -> Value:
         """Apply a command; returns the PREVIOUS value (read for gets,
@@ -49,13 +74,21 @@ class Database:
                 rec = unpack_tpc(cmd.value)
                 if rec is not None:
                     return self._execute_tpc(rec)
+            if cmd.value.startswith(MIG_MAGIC):
+                mrec = unpack_mig(cmd.value)
+                if mrec is not None:
+                    return self._execute_mig(mrec)
             batch = unpack_transaction(cmd.value) if cmd.value else None
             if batch is not None:
                 return pack_values(self.execute_transaction(batch))
+            if self._released and self._moved_key(cmd.key):
+                return MOVED_MAGIC
             prev = self._data.get(cmd.key, b"")
             if cmd.is_write():
                 self._data[cmd.key] = cmd.value
                 self._version += 1
+                if self._mig_open:
+                    self._note_write(cmd.key)
                 if self._multi_version:
                     self._history.setdefault(cmd.key, []).append(cmd.value)
             return prev
@@ -81,7 +114,8 @@ class Database:
                     if last is not None and cmd.command_id <= last[0]:
                         continue   # duplicate: already executed
                 v = cmd.value
-                if self._multi_version or v.startswith(TPC_MAGIC):
+                if self._multi_version or v.startswith(TPC_MAGIC) \
+                        or v.startswith(MIG_MAGIC):
                     out = self.execute(cmd)
                 elif v.startswith(TXN_MAGIC):
                     batch = unpack_transaction(v)
@@ -92,11 +126,15 @@ class Database:
                     out = (pack_values(self.execute_transaction(batch))
                            if batch is not None
                            else self.execute(cmd))
+                elif self._released and self._moved_key(cmd.key):
+                    out = MOVED_MAGIC
                 else:
                     out = data.get(cmd.key, b"")
                     if v:
                         data[cmd.key] = v
                         self._version += 1
+                        if self._mig_open:
+                            self._note_write(cmd.key)
                 if cid:
                     ctab[cid] = (cmd.command_id, out)
 
@@ -114,13 +152,19 @@ class Database:
             for c in commands:
                 v = c.value
                 if self._multi_version or v.startswith(TXN_MAGIC) \
-                        or v.startswith(TPC_MAGIC):
+                        or v.startswith(TPC_MAGIC) \
+                        or v.startswith(MIG_MAGIC):
                     out.append(self.execute(c))
+                    continue
+                if self._released and self._moved_key(c.key):
+                    out.append(MOVED_MAGIC)
                     continue
                 prev = data.get(c.key, b"")
                 if v:
                     data[c.key] = v
                     self._version += 1
+                    if self._mig_open:
+                        self._note_write(c.key)
                 out.append(prev)
             return out
 
@@ -147,6 +191,14 @@ class Database:
             kind, txid = rec["kind"], rec["txid"]
             if kind == "prepare":
                 ops = rec.get("ops") or []
+                if (self._frozen or self._released) and any(
+                        self._fenced_key(k) for k, _ in ops):
+                    # the range is mid-handoff (post-fence) or already
+                    # released: staging here could strand a committed
+                    # write at the old owner — vote NO, the
+                    # presumed-abort path retries under a fresh map
+                    if txid not in self._staged:
+                        return b"no"
                 if txid not in self._staged:
                     for other, oops in self._staged.items():
                         if other == txid:
@@ -175,9 +227,147 @@ class Database:
                     if v:
                         self._data[k] = v
                         self._version += 1
+                        if self._mig_open:
+                            self._note_write(k)
                         if self._multi_version:
                             self._history.setdefault(k, []).append(v)
             return b"done"
+
+    # ---- live-migration records (shard/migrate.py) ---------------------
+    @staticmethod
+    def _folds(key: Key, lo: int, hi: int, span: int) -> bool:
+        return lo <= int(key) % span < hi
+
+    def _moved_key(self, key: Key) -> bool:
+        return any(self._folds(key, lo, hi, span)
+                   for lo, hi, span in self._released.values())
+
+    def _fenced_key(self, key: Key) -> bool:
+        """Is ``key`` inside a post-fence (frozen) or released range?"""
+        return any(self._folds(key, lo, hi, span)
+                   for lo, hi, span in self._frozen.values()) \
+            or self._moved_key(key)
+
+    def _note_write(self, key: Key) -> None:
+        """Mark ``key`` dirty in every open install window it folds
+        into — callers gate on ``self._mig_open`` so the steady-state
+        write path never pays for this."""
+        for w in self._mig_open.values():
+            if self._folds(key, w["lo"], w["hi"], w["span"]):
+                w["dirty"].add(int(key))
+
+    def _execute_mig(self, rec: dict) -> Value:
+        """Apply one migration record (shard/migrate.py epochs);
+        caller holds the lock.  Every kind is deterministic and
+        idempotent, so duplicate records (retries, leader-change
+        re-proposals) converge at every replica:
+
+        - ``begin`` (dst): open the install window + dirty tracking,
+          and clear released markers the window intersects (a range
+          migrating back home must stop answering MOVED here).  A
+          replay keeps the existing window's dirty set; a ``begin``
+          for a finished migration replies ``done`` (recovery's
+          already-complete signal) and never re-opens.
+        - ``read`` (src): stream one chunk of committed range state,
+          ordered by key from ``cursor`` — the reply is
+          ``items:{"items": [...], "next": cursor|-1}``.  Read-only,
+          so follower execution is a no-op with the same outcome.
+        - ``install`` (dst): upsert a chunk, SKIPPING dirty keys (a
+          duplicated write ordered after ``begin`` always wins over a
+          snapshot item).  Ignored once the window is closed.
+        - ``start`` (src): the fence — freeze 2PC prepares on the
+          range (see ``_execute_tpc``); every pre-fence write is
+          log-ordered before this record, which is what makes the
+          post-fence catch-up stream complete.
+        - ``cutover`` (src): release the range — but only once no
+          in-doubt 2PC stage intersects it (reply ``busy`` until the
+          coordinator's retries find it clean); from here plain
+          reads/writes of the range return MOVED_MAGIC.
+        - ``done`` (dst): close the window, remember the mid.
+        - ``drop`` (src): delete the moved keys (the drain); the
+          released marker stays so stale routers keep bouncing.
+        """
+        with self._lock:
+            kind, mid = rec["kind"], rec["mid"]
+            if kind == "begin":
+                if mid in self._mig_done:
+                    return b"done"
+                if mid not in self._mig_open:
+                    self._mig_open[mid] = {
+                        "lo": rec["lo"], "hi": rec["hi"],
+                        "span": rec["span"], "dirty": set()}
+                    # becoming the owner again (a split migrating back
+                    # home): drop released markers that intersect the
+                    # incoming window, else the re-owned range would
+                    # answer MOVED forever — routers that missed BOTH
+                    # handoffs still reroute via map-version staleness
+                    for m_ in [m_ for m_, (rlo, rhi, rspan)
+                               in self._released.items()
+                               if rspan == rec["span"]
+                               and rlo < rec["hi"] and rec["lo"] < rhi]:
+                        del self._released[m_]
+                return b"open"
+            if kind == "read":
+                lo, hi, span = rec["lo"], rec["hi"], rec["span"]
+                cursor, limit = rec.get("cursor", -1), \
+                    rec.get("limit", 256) or 256
+                keys = sorted(k for k in self._data
+                              if k > cursor
+                              and self._folds(k, lo, hi, span))
+                chunk = keys[:limit]
+                nxt = chunk[-1] if len(keys) > limit else -1
+                doc = {"items": [[k, self._data[k].decode("latin1")]
+                                 for k in chunk],
+                       "next": nxt}
+                import json
+                return b"items:" + json.dumps(doc).encode()
+            if kind == "install":
+                w = self._mig_open.get(mid)
+                if w is None:
+                    return b"stale"   # window closed (or never opened)
+                for k, v in rec.get("items", []):
+                    if k not in w["dirty"]:
+                        self._data[k] = v
+                        self._version += 1
+                        if self._multi_version:
+                            self._history.setdefault(k, []).append(v)
+                return b"ok"
+            if kind == "start":
+                if mid not in self._released:
+                    self._frozen[mid] = (rec["lo"], rec["hi"],
+                                         rec["span"])
+                return b"fenced"
+            if kind == "cutover":
+                lo, hi, span = rec["lo"], rec["hi"], rec["span"]
+                if mid not in self._released:
+                    for ops in self._staged.values():
+                        if any(self._folds(k, lo, hi, span)
+                               for k, _ in ops):
+                            # an in-doubt 2PC stage intersects the
+                            # range: releasing now could strand its
+                            # commit — the coordinator retries
+                            return b"busy"
+                    self._released[mid] = (lo, hi, span)
+                    self._frozen.pop(mid, None)
+                return b"ok"
+            if kind == "done":
+                self._mig_open.pop(mid, None)
+                self._mig_done.add(mid)
+                return b"ok"
+            # drop: drain the moved keys from the old owner
+            lo, hi, span = rec["lo"], rec["hi"], rec["span"]
+            for k in [k for k in self._data
+                      if self._folds(k, lo, hi, span)]:
+                del self._data[k]
+            return b"ok"
+
+    def migration_state(self) -> dict:
+        """Diagnostic view of the migration planes (tests/status)."""
+        with self._lock:
+            return {"open": sorted(self._mig_open),
+                    "done": sorted(self._mig_done),
+                    "frozen": dict(self._frozen),
+                    "released": dict(self._released)}
 
     def staged_txns(self) -> List[str]:
         """In-doubt txids (prepared, no commit/abort executed yet) —
@@ -204,6 +394,64 @@ class Database:
         leader-change log compaction (P1b snap)."""
         with self._lock:
             return dict(self._data)
+
+    def aux_snapshot(self) -> dict:
+        """The non-KV replicated state riding the P1b snapshot
+        (protocols/paxos/host.py): staged/decided 2PC planes and the
+        migration planes.  Without this, a leader change whose
+        frontier jump compacts past an in-doubt txn's prepare (or a
+        migration window's begin) would drop staged ops the decide
+        record still commits — the documented 2PC gap, now closed.
+        Wire-friendly: sets become sorted lists, values stay bytes
+        (the codec round-trips bytes like the KV snap)."""
+        with self._lock:
+            return {
+                "staged": {t: [[int(k), v] for k, v in ops]
+                           for t, ops in self._staged.items()},
+                "decided": dict(self._decided),
+                "mig_open": {m: {"lo": w["lo"], "hi": w["hi"],
+                                 "span": w["span"],
+                                 "dirty": sorted(w["dirty"])}
+                             for m, w in self._mig_open.items()},
+                "mig_done": sorted(self._mig_done),
+                "frozen": {m: list(r)
+                           for m, r in self._frozen.items()},
+                "released": {m: list(r)
+                             for m, r in self._released.items()},
+            }
+
+    def restore_aux(self, aux: dict) -> None:
+        """Adopt an aux snapshot at a P1b frontier jump.  Upsert
+        semantics like :meth:`restore`: decided outcomes merge
+        first-wins-preserving (``setdefault``), stages only land for
+        txns not already decided locally, windows/fences/releases
+        union — so a replica that is AHEAD on any plane keeps its own
+        state."""
+        if not aux:
+            return
+        with self._lock:
+            for t, o in (aux.get("decided") or {}).items():
+                self._decided.setdefault(t, o)
+            for t, ops in (aux.get("staged") or {}).items():
+                if t not in self._decided and t not in self._staged:
+                    self._staged[t] = [(int(k), v) for k, v in ops]
+            for m in aux.get("mig_done") or []:
+                self._mig_done.add(m)
+                self._mig_open.pop(m, None)
+            for m, w in (aux.get("mig_open") or {}).items():
+                if m in self._mig_done:
+                    continue
+                mine = self._mig_open.setdefault(
+                    m, {"lo": int(w["lo"]), "hi": int(w["hi"]),
+                        "span": int(w["span"]), "dirty": set()})
+                mine["dirty"].update(int(k) for k in w["dirty"])
+            for m, r in (aux.get("released") or {}).items():
+                self._released.setdefault(m, tuple(int(x) for x in r))
+                self._frozen.pop(m, None)
+            for m, r in (aux.get("frozen") or {}).items():
+                if m not in self._released:
+                    self._frozen.setdefault(
+                        m, tuple(int(x) for x in r))
 
     def restore(self, snap: Dict[Key, Value]) -> None:
         """Adopt a snapshot (state transfer at leader change).  Upsert

@@ -43,7 +43,8 @@ import time
 from typing import TYPE_CHECKING, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
-from paxi_tpu.core.command import (RESERVED_PREFIXES, Command, Request,
+from paxi_tpu.core.command import (MIG_KINDS, RESERVED_PREFIXES,
+                                   Command, Request, pack_mig,
                                    pack_tpc)
 
 if TYPE_CHECKING:
@@ -473,6 +474,13 @@ class HTTPServer:
             if method != "POST":
                 return _response(405, b"", {"Err": "POST only"})
             return await self._tpc(body)
+        if parts and parts[0] == "mig":
+            # live-migration record injection (shard/migrate.py
+            # coordinator only); packed server-side like /tpc so the
+            # MIG_MAGIC encoding never crosses the client surface
+            if method != "POST":
+                return _response(405, b"", {"Err": "POST only"})
+            return await self._mig(body)
         if len(parts) != 1:
             return _response(404)
         try:
@@ -591,6 +599,48 @@ class HTTPServer:
             return _response(500, b"", {"Err": "2pc record timed out"})
         finally:
             self.node.spans.finish(sp)
+        if rep.err:
+            return _response(500, b"", {"Err": str(rep.err)})
+        return _response(200, rep.value or b"")
+
+    async def _mig(self, body: bytes) -> bytes:
+        """One migration record through the group's ordinary Request
+        path: ``{"kind", "mid", "key", "lo"?, "hi"?, "span"?,
+        "items"?, "cursor"?, "limit"?}`` packs into a MIG-record
+        command on ``key`` (the group-local ordering anchor),
+        replicates like any write, and the epoch state machine's
+        reply (open/done, an items chunk, fenced, ok/busy) returns as
+        the body — so every epoch transition of a range handoff is
+        one totally-ordered log entry (shard/migrate.py)."""
+        try:
+            doc = json.loads(body.decode() or "{}")
+            if doc.get("kind") not in MIG_KINDS \
+                    or not isinstance(doc.get("mid"), str):
+                raise ValueError(
+                    f"bad migration record: kind={doc.get('kind')!r} "
+                    f"mid={doc.get('mid')!r}")
+            value = pack_mig(
+                doc["kind"], doc["mid"],
+                lo=int(doc.get("lo", 0)), hi=int(doc.get("hi", 0)),
+                span=int(doc.get("span", 0)),
+                items=[(int(k), v.encode("latin1"))
+                       for k, v in doc["items"]]
+                if "items" in doc else None,
+                cursor=int(doc.get("cursor", -1)),
+                limit=int(doc.get("limit", 0)))
+            key = int(doc.get("key", 0))
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
+            return _response(400, b"", {"Err": repr(e)})
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.node.handle_client_request(Request(
+            command=Command(key, value),
+            timestamp=self.node.spans.now(),
+            node_id=self._node_id, reply_to=fut))
+        try:
+            rep = await asyncio.wait_for(fut, timeout=10.0)
+        except asyncio.TimeoutError:
+            return _response(500, b"", {"Err": "migration record "
+                                               "timed out"})
         if rep.err:
             return _response(500, b"", {"Err": str(rep.err)})
         return _response(200, rep.value or b"")

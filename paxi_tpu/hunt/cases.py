@@ -174,6 +174,35 @@ SHARD_ROUTER_CASES: List[ShardCase] = [
     ("mid_commit", 2, 3, (0,)),
 ]
 
+# ---- 2PC-recovery-during-live-migration matrix (host-only) --------------
+# The nastier grid: a range handoff (shard/migrate.py) is killed at a
+# scripted epoch WHILE a cross-shard transaction's coordinator also
+# dies mid-flight on the same shared fabric.  The migration is then
+# resumed by re-run AND 2PC recovery runs, in that order, and the
+# every-replica atomicity oracle must still hold — the fence/freeze
+# interplay (prepares vote no on a frozen range, cutover busy-waits on
+# staged txns, the post-cutover catch-up stream carries freeze-window
+# commits) is exactly the machinery these kills aim at.  Consumed by
+# tests/test_shard_migrate.py.
+# (mig_kill_point, tpc_kill_point, n_groups, replicas_per_group, seeds)
+ShardMigrationCase = Tuple[str, str, int, int, Tuple[int, ...]]
+SHARD_MIGRATION_CASES: List[ShardMigrationCase] = [
+    # coordinator dies streaming the bulk snapshot; the txn dies fully
+    # staged: recovery aborts it, the resumed stream must not resurrect
+    # the aborted writes at dst
+    ("snapshot", "after_prepare", 2, 3, (0, 1)),
+    # fence committed (prepares on the range freeze), txn staged only
+    # at home: recovery's abort + resumed catch-up must converge
+    ("double_write", "mid_prepare", 2, 3, (0, 1)),
+    # decision durable, fan-out dead, range released: recovery must
+    # complete the commit THROUGH the moved range's new owner, and the
+    # resumed drain must carry the freeze-window commit to dst
+    ("double_write", "after_decide", 2, 3, (0, 1)),
+    # cutover committed, drain dead, partial commit fan-out: the
+    # resumed migration's final stream is what reconciles dst
+    ("cutover", "mid_commit", 2, 3, (0,)),
+]
+
 
 def sched_name(fuzz: FuzzConfig) -> str:
     """STRUCTURAL schedule name — a pure function of the config's

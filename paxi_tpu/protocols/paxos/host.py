@@ -84,6 +84,11 @@ class P1b:
     # [command_id, value] of its highest executed command, so a frontier
     # jump can never re-execute a command whose slot was compacted away
     ctab: Dict[str, list] = field(default_factory=dict)
+    # non-KV replicated planes riding the same transfer
+    # (db.aux_snapshot): staged/decided 2PC state and migration
+    # windows — a frontier jump past an in-doubt txn's prepare (or a
+    # migration begin) must carry the stage, not drop it
+    aux: Dict = field(default_factory=dict)
 
 
 @register_message
@@ -154,7 +159,8 @@ class PaxosReplica(Node):
         self.execute = 0        # next slot to execute
         self.p1_quorum = Quorum(cfg.ids)
         self.p1b_logs: Dict[ID, Dict[int, list]] = {}
-        self.p1b_meta: Dict[ID, tuple] = {}   # id -> (execute, snap, ctab)
+        # id -> (execute, snap, ctab, aux)
+        self.p1b_meta: Dict[ID, tuple] = {}
         self.pending: list = []  # requests queued while electing
         # leader-reads barrier: proposal-frontier slot -> reads waiting
         # for every slot <= it to execute (cfg.leader_reads only)
@@ -241,7 +247,8 @@ class PaxosReplica(Node):
         self.p1_quorum = Quorum(self.cfg.ids)
         self.p1_quorum.ack(self.id)
         self.p1b_logs = {self.id: self._log_payload()}
-        self.p1b_meta = {self.id: (self.execute, {}, {})}  # own db is local
+        # own db is local: no transfer needed
+        self.p1b_meta = {self.id: (self.execute, {}, {}, {})}
         self.socket.broadcast(self.P1A_CLS(self.ballot, self.execute))
 
     def _log_payload(self) -> Dict[int, list]:
@@ -367,9 +374,10 @@ class PaxosReplica(Node):
         snap = self.db.snapshot() if ahead else {}
         ctab = ({c: [i, v] for c, (i, v) in self.ctab.items()}
                 if ahead else {})  # stale candidates discard the P1b anyway
+        aux = self.db.aux_snapshot() if ahead else {}
         self.socket.send(ballot_id(m.ballot),
                          self.P1B_CLS(self.ballot, str(self.id), self._log_payload(),
-                             self.execute, snap, ctab))
+                             self.execute, snap, ctab, aux))
 
     def _repend_inflight(self) -> None:
         """Losing leadership: unflushed batch, barrier reads and
@@ -402,7 +410,7 @@ class PaxosReplica(Node):
             return
         self.p1_quorum.ack(ID(m.id))
         self.p1b_logs[ID(m.id)] = m.log
-        self.p1b_meta[ID(m.id)] = (m.execute, m.snap, m.ctab)
+        self.p1b_meta[ID(m.id)] = (m.execute, m.snap, m.ctab, m.aux)
         if self._p1_complete():
             self._become_leader()
 
@@ -426,8 +434,9 @@ class PaxosReplica(Node):
         # state transfer first: an acker ahead of our execute frontier
         # has executed (hence committed) everything below it; adopt its
         # snapshot + frontier so the merge never NOOPs an executed slot
-        front, snap, ctab = max(self.p1b_meta.values(),
-                                key=lambda fs: fs[0], default=(0, {}, {}))
+        front, snap, ctab, aux = max(self.p1b_meta.values(),
+                                     key=lambda fs: fs[0],
+                                     default=(0, {}, {}, {}))
         if front > self.execute:
             # adopt the acker's session table first: re-pended requests
             # whose command already executed in a compacted slot must be
@@ -455,6 +464,10 @@ class PaxosReplica(Node):
                     self.pending.extend(e.live_requests())
                 e.requests = []
             self.db.restore(snap)
+            # the aux planes travel WITH the frontier jump: staged 2PC
+            # ops whose prepare slot was compacted away, and open
+            # migration windows with their dirty sets
+            self.db.restore_aux(aux)
             self.execute = front
             self.slot = max(self.slot, front - 1)
         merged: Dict[int, Tuple[int, list, bool]] = {}

@@ -1,7 +1,10 @@
 """Sharded multi-group serving: key-range router, cluster-of-clusters,
-cross-shard 2PC (see README "Sharded serving")."""
+cross-shard 2PC, live range migration (see README "Sharded serving")."""
 
 from paxi_tpu.shard.cluster import ShardedCluster, group_config
+from paxi_tpu.shard.migrate import (MapHolder, MigrationCoordinator,
+                                    MigrationError, MigrationKilled,
+                                    Rebalancer)
 from paxi_tpu.shard.router import RouterServer, ShardRouter, label_group
 from paxi_tpu.shard.shardmap import ShardMap
 from paxi_tpu.shard.txn import (CoordinatorKilled, ShardCoordinator,
@@ -11,4 +14,6 @@ __all__ = [
     "ShardMap", "ShardRouter", "RouterServer", "label_group",
     "ShardedCluster", "group_config", "ShardCoordinator",
     "CoordinatorKilled", "TxnOutcome", "partition_ops", "atomic_check",
+    "MigrationCoordinator", "MigrationError", "MigrationKilled",
+    "MapHolder", "Rebalancer",
 ]

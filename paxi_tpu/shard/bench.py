@@ -23,12 +23,26 @@ Every run ends with a burst of cross-shard transactions through the
 router's 2PC path and an **atomicity oracle** sweep: for each txn,
 linearizable readback of every op key must show the txn's writes
 everywhere or nowhere (shard/txn.atomic_check).
+
+``migrate=True`` inserts a **migrate** phase after the ramp: paced
+per-key-sequential traffic concentrates on group 0's range, a
+Rebalancer reads the router's own load evidence to pick the split
+point (deterministic midpoint fallback), and the coordinator streams
+the NON-EMPTY range to the least-loaded group LIVE — under the
+double-write fence, with per-key strict read-your-writes checking
+through the whole window.  The phase row reports
+``migration_blip_p99_ms`` (completion p99 inside the move window) vs
+the steady-state p99, plus a seeded-keys readback oracle proving the
+moved range arrived intact.  ``routers=N`` spreads the phase's
+workers over N router endpoints (keys stay per-worker-disjoint, so
+one key always flows through one router and the verdicts compose).
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import random
 import time
 from typing import Dict, List, Optional
 
@@ -137,7 +151,8 @@ async def shard_ramp(algorithm: str = "paxos", shards: int = 2,
                      txns: int = 8, lin: bool = True,
                      proc: bool = False, conns: int = 2,
                      drain_s: float = 4.0,
-                     workload: str = "") -> Dict:
+                     workload: str = "", migrate: bool = False,
+                     routers: int = 1) -> Dict:
     """One G-point of the curve: ramp both phases, fire the 2PC burst,
     return the artifact row.
 
@@ -152,10 +167,13 @@ async def shard_ramp(algorithm: str = "paxos", shards: int = 2,
     G = shards
     if fleet % G:
         raise ValueError(f"fleet {fleet} not divisible into {G} groups")
+    if migrate and G < 2:
+        raise ValueError("migrate phase needs at least 2 groups")
     n = fleet // G
     rates = rates or [2000.0, 5000.0, 10000.0]
     sc = ShardedCluster(algorithm, groups=G, n=n, base_port=base_port,
-                        router_port=base_port + 98, proc=proc)
+                        router_port=base_port + 98, proc=proc,
+                        routers=routers)
     await sc.start()
     try:
         rcfg = _router_cfg(sc.router_url)
@@ -207,6 +225,10 @@ async def shard_ramp(algorithm: str = "paxos", shards: int = 2,
                      "router_gauges": _traj_report(traj)}]
 
         phases = await phase("disjoint") + await phase("crossing")
+        if migrate:
+            phases += await _migrate_phase(
+                sc, rate=rates[0], run_s=max(3 * step_s, 4.0),
+                workers=workers, seed=seed)
         group_fwd_base: Dict[str, int] = {}
         if workload:
             # snapshot per-group counters BEFORE the hot phase so its
@@ -217,8 +239,11 @@ async def shard_ramp(algorithm: str = "paxos", shards: int = 2,
                 workload, rcfg, sc.map, rates, workers, step_s, seed,
                 conns, W, K, drain_s)
         # G == 1 exercises the single-group packed-transaction path
-        # (same surface, single-log atomicity); G > 1 runs real 2PC
-        txn_report = await _txn_shots(sc.router_url, sc.map, G, txns) \
+        # (same surface, single-log atomicity); G > 1 runs real 2PC.
+        # The oracle reads the ROUTER's live map: after a migrate
+        # phase the boot map no longer describes ownership.
+        txn_report = await _txn_shots(sc.router_url,
+                                      sc.router.shard_map, G, txns) \
             if txns > 0 else None
         router_metrics = await sc.router.metrics_snapshot()
         peak = max(p["peak_ops_s"] for p in phases)
@@ -253,6 +278,7 @@ async def shard_ramp(algorithm: str = "paxos", shards: int = 2,
             "workers": workers,
             "W": W, "K": K,
             "cluster_proc": proc,
+            **({"routers": routers} if routers > 1 else {}),
             **({"workload": workload} if workload else {}),
             "phases": phases,
             "aggregate_peak_ops_s": peak,
@@ -263,6 +289,247 @@ async def shard_ramp(algorithm: str = "paxos", shards: int = 2,
         }
     finally:
         await sc.stop()
+
+
+def _p(lat: List[float], q: float) -> float:
+    if not lat:
+        return 0.0
+    s = sorted(lat)
+    return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))]
+
+
+async def _migrate_phase(sc: ShardedCluster, rate: float,
+                         run_s: float, workers: int,
+                         seed: int) -> List[Dict]:
+    """The live-migration phase: hot-range traffic, a mid-phase
+    Rebalancer-chosen split + streamed move of a NON-EMPTY range, and
+    the blip/oracle evidence for the artifact.
+
+    Every worker owns a disjoint key set in the upper quarter of
+    group 0's range and runs ONE op at a time (write then read-your-
+    write), so each key has a single sequential client and a read
+    returning anything but the last acked write is a hard anomaly —
+    the strictest per-key check there is, held THROUGH the move
+    window.  Oracle keys seeded above all traffic keys guarantee the
+    moved slice is non-empty and its bytes survive the stream."""
+    from paxi_tpu.shard.migrate import Rebalancer
+    G, span = sc.G, sc.map.span
+    gsize = span // G
+    hot_hi = gsize                      # group 0's range is [0, gsize)
+    urls = sc.router_urls
+    # traffic keys: upper quarter of the hot range, per-worker blocks,
+    # capped below the oracle strip
+    base = (hot_hi * 3) // 4
+    keys_of = [[base + w * 1024 + j * 8 for j in range(8)]
+               for w in range(workers)]
+    assert max(max(ks) for ks in keys_of) < hot_hi - 512
+    # oracle keys: the very top of the range, above every traffic key,
+    # so ANY load-median cut moves them — written once before the
+    # move, untouched during it, read back after
+    oracle = {hot_hi - 256 + i: f"mig-oracle-{i}".encode()
+              for i in range(16)}
+    conn = _Conn(sc.router_url)
+    try:
+        for i, (k, v) in enumerate(sorted(oracle.items())):
+            st, _, _ = await conn.request(
+                "PUT", f"/{k}", {"Client-Id": "migseed",
+                                 "Command-Id": str(i + 1)}, v)
+            if st != 200:
+                raise RuntimeError(f"oracle seed write failed on {k}")
+    finally:
+        conn.close()
+
+    t0 = time.monotonic()
+    window = {"t_start": None, "t_end": None, "plan": None,
+              "status": None, "fallback": False}
+    stop = asyncio.Event()
+
+    async def worker(w: int) -> Dict:
+        wconn = _Conn(urls[w % len(urls)])
+        rnd = random.Random(seed + 31 * w)
+        vals: Dict[int, Optional[bytes]] = {}
+        samples: List = []
+        anomalies = errors = completed = 0
+        cmd = 0
+        per_op = workers / max(rate, 1.0)
+        try:
+            while not stop.is_set():
+                k = rnd.choice(keys_of[w])
+                cmd += 1
+                t1 = time.monotonic()
+                try:
+                    if k not in vals or rnd.random() < 0.5:
+                        v = f"w{w}c{cmd}".encode()
+                        st, _, _ = await wconn.request(
+                            "PUT", f"/{k}",
+                            {"Client-Id": f"mg{w}",
+                             "Command-Id": str(cmd)}, v)
+                        if st == 200:
+                            vals[k] = v
+                        else:
+                            # the write MAY have landed on one side:
+                            # suspend this key's check until the next
+                            # acked write re-anchors it
+                            vals[k] = None
+                            errors += 1
+                    else:
+                        st, _, obs = await wconn.request(
+                            "GET", f"/{k}",
+                            {"Client-Id": f"mg{w}",
+                             "Command-Id": str(cmd)}, b"")
+                        if st != 200:
+                            errors += 1
+                        elif vals.get(k) is not None \
+                                and obs != vals[k]:
+                            anomalies += 1
+                except (IOError, OSError):
+                    errors += 1
+                    vals[k] = None
+                t2 = time.monotonic()
+                completed += 1
+                samples.append((t2, (t2 - t1) * 1000.0))
+                # fixed-interval pacing (closed loop + rate-derived
+                # sleep): offered rate is approximate, which is fine
+                # for a blip window — and no clock value ever steers
+                # control flow (PXD141)
+                await asyncio.sleep(per_op)
+        finally:
+            wconn.close()
+        return {"samples": samples, "anomalies": anomalies,
+                "errors": errors, "completed": completed,
+                "vals": vals}
+
+    async def mover() -> None:
+        await asyncio.sleep(run_s * 0.3)
+        # the split decision off the router's OWN evidence: command
+        # deltas + the 64-bucket key histogram, with short hysteresis
+        reb = Rebalancer(hot_share=0.5, min_ticks=2, min_cmds=10,
+                         cooldown=0)
+        sc.router.bucket_hits(reset=True)
+        last = [c.value for c in sc.router._group_fwd]
+        plan = None
+        for _ in range(10):
+            await asyncio.sleep(max(0.15, run_s * 0.02))
+            cur = [c.value for c in sc.router._group_fwd]
+            deltas = [a - b for a, b in zip(cur, last)]
+            last = cur
+            plan = reb.tick(sc.router.shard_map, deltas,
+                            sc.router.bucket_hits(reset=True))
+            if plan is not None:
+                break
+        if plan is None or plan.get("action") != "split" \
+                or plan.get("src") != 0:
+            # deterministic fallback: cut the hot range at the floor
+            # of the traffic band so every live key moves too
+            plan = {"action": "split", "lo": base - 64, "hi": hot_hi,
+                    "src": 0, "dst": 1}
+            window["fallback"] = True
+        mig = sc.migrator(chunk=48)
+        window["plan"] = plan
+        window["t_start"] = time.monotonic()
+        window["status"] = await mig.move_range(plan["lo"],
+                                                plan["hi"],
+                                                plan["dst"])
+        window["t_end"] = time.monotonic()
+
+    async def run() -> List[Dict]:
+        tasks = [asyncio.ensure_future(worker(w))
+                 for w in range(workers)]
+        mv = asyncio.ensure_future(mover())
+        await asyncio.sleep(run_s)
+        try:
+            await asyncio.wait_for(mv, timeout=60.0)
+        finally:
+            stop.set()
+        return await asyncio.gather(*tasks)
+
+    outs = await run()
+    t_total = time.monotonic() - t0
+    ws, we = window["t_start"], window["t_end"]
+    in_win, steady = [], []
+    for o in outs:
+        for t, lat in o["samples"]:
+            (in_win if ws is not None and ws <= t <= we
+             else steady).append(lat)
+    anomalies = sum(o["anomalies"] for o in outs)
+    completed = sum(o["completed"] for o in outs)
+    errors = sum(o["errors"] for o in outs)
+    steady_p99 = round(_p(steady, 0.99), 3)
+    blip_p99 = round(_p(in_win, 0.99), 3)
+
+    # the migrated-range oracle: seeded keys must now route to dst
+    # and read back byte-identical; live keys' last acked write must
+    # read back too (the post-move readback half of the verdict)
+    m_now = sc.router.shard_map
+    plan = window["plan"]
+    oracle_fail = moved_wrong = live_fail = 0
+    conn = _Conn(sc.router_url)
+    try:
+        chk = 0
+        for k, v in sorted(oracle.items()):
+            chk += 1
+            if m_now.group_of(k) != plan["dst"]:
+                moved_wrong += 1
+            st, _, obs = await conn.request(
+                "GET", f"/{k}", {"Client-Id": "migchk",
+                                 "Command-Id": str(chk)}, b"")
+            if st != 200 or obs != v:
+                oracle_fail += 1
+        for o in outs:
+            for k, v in sorted(o["vals"].items()):
+                if v is None:
+                    continue
+                chk += 1
+                st, _, obs = await conn.request(
+                    "GET", f"/{k}", {"Client-Id": "migchk",
+                                     "Command-Id": str(chk)}, b"")
+                if st != 200 or obs != v:
+                    live_fail += 1
+    finally:
+        conn.close()
+
+    status = window["status"] or {}
+    dualwrites = sum(
+        r._dual_total.value
+        for r in [sc.router] + [r for r, _ in sc.secondaries])
+    return [{
+        "phase": "migrate",
+        "steps": [{
+            "offered_ops_s": rate,
+            "achieved_ops_s": round(completed / t_total, 1),
+            "completed": completed,
+            "errors": errors,
+            "latency_p50_ms": round(_p(steady, 0.5), 3),
+            "latency_p99_ms": steady_p99,
+        }],
+        "anomalies": anomalies,
+        "peak_ops_s": round(completed / t_total, 1),
+        "migration": {
+            "plan": plan,
+            "rebalancer_fallback": window["fallback"],
+            "mid": status.get("mid"),
+            "epoch": status.get("epoch"),
+            "installed": status.get("installed"),
+            "chunks": status.get("chunks"),
+            "window_s": round((we - ws) if ws is not None else 0.0,
+                              3),
+            "window_samples": len(in_win),
+            "steady_p99_ms": steady_p99,
+            "migration_blip_p99_ms": blip_p99,
+            "blip_ratio": round(blip_p99 / steady_p99, 3)
+            if steady_p99 else None,
+            "map_version": m_now.version,
+            "dualwrites": dualwrites,
+            "oracle": {
+                "seeded_keys": len(oracle),
+                "readback_failures": oracle_fail,
+                "misrouted": moved_wrong,
+                "live_readback_failures": live_fail,
+                "clean": oracle_fail == moved_wrong == live_fail
+                == 0,
+            },
+        },
+    }]
 
 
 async def _hot_phase(wl_name: str, rcfg: Config, shard_map,

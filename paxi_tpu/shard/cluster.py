@@ -13,6 +13,15 @@ this).  HTTP ports stack per group off one base port.
 instead (chan peers inside the subprocess, real TCP HTTP towards the
 router) — the honest topology for throughput measurements: the groups
 stop sharing the router/generator interpreter.
+
+``routers=N`` starts N router endpoints over the same groups: one
+PRIMARY (``router_url``) that owns map changes, plus N-1 stateless
+secondaries (``router_urls``) that converge on a new map lazily — via
+the coordinator's ``install_map`` fan-out when in its holder list, or
+via the MOVED-bounce ``_map_refresh`` hook (GET /shardmap off the
+primary) when a backend tells them their map is stale.  That is the
+scale-out story for the router bottleneck BENCH_SHARD.json measures
+past G=2: routers share nothing but the versioned map.
 """
 
 from __future__ import annotations
@@ -60,7 +69,7 @@ class ShardedCluster:
                  base_port: int = 0, router_port: int = 0,
                  http: bool = True, fabric=None, proc: bool = False,
                  tag: str = "shard", batch_size: int = 64,
-                 lease_s: float = 0.2):
+                 lease_s: float = 0.2, routers: int = 1):
         if isinstance(algorithm, str):
             algorithm = [algorithm] * groups
         if len(algorithm) != groups:
@@ -82,11 +91,15 @@ class ShardedCluster:
                                   http=self.http, batch_size=batch_size,
                                   lease_s=lease_s)
                      for g in range(groups)]
+        self.n_routers = max(1, routers)
         self.clusters: List = []        # in-proc mode
         self.procs: List[subprocess.Popen] = []
         self._cfg_paths: List[str] = []
         self.router: Optional[ShardRouter] = None
         self.server: Optional[RouterServer] = None
+        # (router, server) pairs for the stateless secondary tier
+        self.secondaries: List = []
+        self._mig_conns: Dict[int, object] = {}
 
     # ---- lifecycle ------------------------------------------------------
     async def start(self) -> None:
@@ -126,8 +139,38 @@ class ShardedCluster:
             self.server = RouterServer(
                 self.router, f"http://127.0.0.1:{self.router_port}")
             await self.server.start()
+            for k in range(1, self.n_routers):
+                r = ShardRouter(self.map, urls,
+                                lease_s=self.cfgs[0].lease_s)
+                r._map_refresh = self._refresh_for(r)
+                s = RouterServer(
+                    r, f"http://127.0.0.1:{self.router_port + k}")
+                await s.start()
+                self.secondaries.append((r, s))
+
+    def _refresh_for(self, r: ShardRouter):
+        """A secondary router's map-refresh hook: pull the primary's
+        current map and install it (a no-op ValueError when this
+        router already caught up)."""
+        async def refresh() -> None:
+            from paxi_tpu.host.client import _Conn
+            conn = _Conn(self.router_url)
+            try:
+                status, _, payload = await conn.request(
+                    "GET", "/shardmap", {}, b"")
+                if status == 200:
+                    r.install_map(ShardMap.from_json(payload.decode()))
+            finally:
+                conn.close()
+        return refresh
 
     async def stop(self) -> None:
+        for _, s in self.secondaries:
+            await s.stop()
+        self.secondaries = []
+        for conn in self._mig_conns.values():
+            conn.close()
+        self._mig_conns = {}
         if self.server:
             await self.server.stop()
         for c in self.clusters:
@@ -151,6 +194,54 @@ class ShardedCluster:
     @property
     def router_url(self) -> str:
         return f"http://127.0.0.1:{self.router_port}"
+
+    @property
+    def router_urls(self) -> List[str]:
+        """Every router endpoint: the primary first, then the
+        stateless secondaries."""
+        return [f"http://127.0.0.1:{self.router_port + k}"
+                for k in range(self.n_routers)]
+
+    # ---- live migration -------------------------------------------------
+    def migrator(self, chunk: int = 64, crash_at: Optional[str] = None,
+                 busy_wait_s: float = 0.05):
+        """A MigrationCoordinator over this fleet: records travel as
+        POST /mig to each group's entry node, and every router (the
+        primary AND the secondaries) is in the holder list, so map
+        epochs install everywhere before the records that depend on
+        them commit."""
+        from paxi_tpu.shard.migrate import MigrationCoordinator
+        if self.router is None:
+            raise RuntimeError("migrator() needs the HTTP router tier")
+        holders = [self.router] + [r for r, _ in self.secondaries]
+        return MigrationCoordinator(self._mig_submit, holders,
+                                    chunk=chunk, crash_at=crash_at,
+                                    busy_wait_s=busy_wait_s)
+
+    async def _mig_submit(self, group: int, key: int, rec: dict):
+        """Migration-record transport: POST /mig to the group's entry
+        node over a dedicated per-group connection (records must not
+        queue behind a KV burst in the router's shared pipes)."""
+        from paxi_tpu.host.client import _Conn
+        doc: Dict = {"kind": rec["kind"], "mid": rec["mid"],
+                     "key": int(key)}
+        for f in ("lo", "hi", "span", "cursor", "limit"):
+            if f in rec:
+                doc[f] = int(rec[f])
+        if "items" in rec:
+            doc["items"] = [[k, v.decode("latin1")]
+                            for k, v in rec["items"]]
+        conn = self._mig_conns.get(group)
+        if conn is None:
+            cfg = self.cfgs[group]
+            conn = _Conn(cfg.http_addrs[cfg.ids[0]])
+            self._mig_conns[group] = conn
+        try:
+            status, _, payload = await conn.request(
+                "POST", "/mig", {}, json.dumps(doc).encode())
+            return status == 200, payload
+        except (IOError, OSError) as e:
+            return False, repr(e).encode()
 
     def group(self, g: int):
         """The in-proc Cluster of group g (in-proc mode only)."""

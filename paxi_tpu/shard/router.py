@@ -22,6 +22,16 @@ is rerouted to its new owner (counted as
 group that no longer owns the key: the stale-epoch reject + retry
 path, internal to the router so clients never see a misrouted reply.
 
+Live migration (shard/migrate.py) adds two flush-time behaviors: a
+write whose key sits in a migration window of the current map ships
+to BOTH owner groups and acks only when both legs ack (the
+double-write fence, ``paxi_router_dualwrites_total``); a backend
+reply carrying the MOVED marker (the key's range was released at
+cutover) re-enqueues the op under the freshest map — refreshed via
+the injectable ``_map_refresh`` hook on secondary routers — instead
+of surfacing stale state, so N stateless routers can share one
+versioned map with only the primary seeing ``install_map`` directly.
+
 Surfaces:
 - ``GET|PUT|POST /{key}``          routed KV (Client-Id/Command-Id pass
                                    through, so at-most-once filtering
@@ -47,7 +57,7 @@ import threading
 from typing import Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
-from paxi_tpu.core.command import RESERVED_PREFIXES
+from paxi_tpu.core.command import MOVED_MAGIC, RESERVED_PREFIXES
 from paxi_tpu.host.client import _Conn
 from paxi_tpu.host.http import _OK_TMPL, _response, read_request
 from paxi_tpu.metrics import Registry, merge_snapshots
@@ -63,17 +73,25 @@ from paxi_tpu.shard.txn import ShardCoordinator, TxnOutcome, partition_ops
 class _RoutedOp:
     """One forwarded KV request: the backend frame, the response slot,
     the map epoch it was routed under, and the pending-queue ``route``
-    span when the request is traced."""
+    span when the request is traced.  ``write`` marks ops that must be
+    duplicated inside a double-write window; ``dual`` marks a leg of
+    such a duplicated write (its slot resolves to a raw
+    ``(status, payload)`` pair joined by ``_dual_join``); ``tries``
+    counts MOVED-marker bounces (shard/migrate.py cutover)."""
 
-    __slots__ = ("key", "frame", "slot", "epoch", "span")
+    __slots__ = ("key", "frame", "slot", "epoch", "span", "write",
+                 "tries", "dual")
 
     def __init__(self, key: int, frame: bytes, slot, epoch: int,
-                 span=None):
+                 span=None, write: bool = False):
         self.key = key
         self.frame = frame
         self.slot = slot
         self.epoch = epoch
         self.span = span
+        self.write = write
+        self.tries = 0
+        self.dual = False
 
 
 class ShardRouter:
@@ -113,6 +131,18 @@ class ShardRouter:
             "paxi_router_stale_reroutes_total")
         self._map_swaps = self.metrics.counter(
             "paxi_router_map_swaps_total")
+        self._dual_total = self.metrics.counter(
+            "paxi_router_dualwrites_total")
+        # optional async hook a multi-router deployment injects
+        # (cluster.py): fetch + install the primary's current map when
+        # a backend bounces a request with the MOVED marker — how a
+        # stale secondary router converges on a cutover it missed
+        self._map_refresh = None
+        # 64-bucket key histogram over the map span: the rebalancer's
+        # split-point evidence (which part of a hot range is hot),
+        # maintained under the routing lock so it reads one map
+        # snapshot per increment
+        self._bucket_hits = [0] * 64
         # per-group routed-command load: the skew evidence for
         # workload-driven runs (a hot key range shows up as one group's
         # counter racing ahead of the rest) — same registry path as
@@ -169,21 +199,24 @@ class ShardRouter:
                                 **labels)
 
     def route_kv(self, key: int, frame: bytes, loop,
-                 span=None) -> asyncio.Future:
+                 span=None, write: bool = False) -> asyncio.Future:
         """Enqueue one KV request for its owning group; the returned
         future resolves to response BYTES for the router's client.
         ``span`` is the traced request's root (sample_entry): its
         pending-queue wait becomes a ``route`` child span and the root
-        finishes when the response slot resolves."""
+        finishes when the response slot resolves.  ``write`` ops are
+        duplicated to the destination group at flush time when their
+        key sits in a double-write window (shard/migrate.py)."""
         slot: asyncio.Future = loop.create_future()
         self._fwd_total.inc()
-        op = _RoutedOp(key, frame, slot, 0)
+        op = _RoutedOp(key, frame, slot, 0, write=write)
         with self._lock:
             m = self._map
             g = m.group_of(key)
             op.epoch = m.version
             self._pending[g].append(op)
             depth = len(self._pending[g])
+            self._bucket_hits[(int(key) % m.span) * 64 // m.span] += 1
         self._g_depth[g].set(depth)
         self._group_fwd[g].inc()
         if span is not None:
@@ -222,6 +255,29 @@ class ShardRouter:
             g_new = m.group_of(op.key)
             self._group_fwd[g_new].inc()   # load lands on the new owner
             batches[g_new].append(op)
+        # double-write fence: a write whose key sits in one of the
+        # CURRENT map's migration windows ships to BOTH groups — the
+        # client slot resolves only once both legs acked (_dual_join),
+        # so an acked write can never exist on just one side of the
+        # handoff
+        for g, ops in enumerate(batches):
+            for op in ops:
+                if not op.write or op.dual:
+                    continue
+                mig = m.migration_of(op.key)
+                if mig is None or mig[2] != g:
+                    continue
+                client = op.slot
+                fa = client.get_loop().create_future()
+                fb = client.get_loop().create_future()
+                op.slot, op.dual = fa, True
+                shadow = _RoutedOp(op.key, op.frame, fb, m.version,
+                                   write=True)
+                shadow.dual = True
+                self._dual_total.inc()
+                self._group_fwd[mig[3]].inc()
+                batches[mig[3]].append(shadow)
+                self._dual_join(client, fa, fb)
         await asyncio.gather(*[
             self._ship(g, ops) for g, ops in enumerate(batches) if ops])
 
@@ -232,12 +288,12 @@ class ShardRouter:
         except OSError as e:
             for op in ops:
                 self.spans.finish(op.span)
-                self._fail_slot(op.slot, e)
+                self._fail_op(op, e)
             return
         self._g_inflight[g].inc(len(ops))
         for op in ops:
             self.spans.finish(op.span)   # queue wait ends at the wire
-            conn.submit_raw(op.frame, self._make_done(op.slot, g))
+            conn.submit_raw(op.frame, self._make_done(op, g))
         try:
             await conn.flush()
         except (ConnectionError, OSError):
@@ -250,21 +306,131 @@ class ShardRouter:
             slot.set_result(_response(
                 500, b"", {"Err": f"group unreachable: {exc!r}"}))
 
-    def _make_done(self, slot: asyncio.Future, g: int):
+    def _fail_op(self, op: _RoutedOp, exc: Exception) -> None:
+        if op.slot.done():
+            return
+        if op.dual:
+            op.slot.set_result((599, repr(exc).encode()))
+        else:
+            self._fail_slot(op.slot, exc)
+
+    @staticmethod
+    def _dual_join(client: asyncio.Future, fa: asyncio.Future,
+                   fb: asyncio.Future) -> None:
+        """Resolve the client slot once BOTH double-write legs are in:
+        either leg failing fails the request (the client must never
+        believe an un-duplicated write acked); the source group's
+        payload (the authoritative previous value) answers, unless the
+        source already released the range (MOVED marker — cutover
+        raced the ship), in which case the destination ack stands."""
+        def done(_f):
+            if not (fa.done() and fb.done()) or client.done():
+                return
+            (sa, pa), (sb, pb) = fa.result(), fb.result()
+            if sa != 200 or sb != 200:
+                err = pa if sa != 200 else pb
+                client.set_result(_response(
+                    500, b"",
+                    {"Err": "double-write leg failed: "
+                     + err.decode("latin1")}))
+                return
+            payload = pb if pa.startswith(MOVED_MAGIC) else pa
+            client.set_result(_OK_TMPL % len(payload) + payload)
+        fa.add_done_callback(done)
+        fb.add_done_callback(done)
+
+    def _make_done(self, op: _RoutedOp, g: int):
         inflight = self._g_inflight[g]
 
-        def done(status, headers, payload, exc, _slot=slot):
+        def done(status, headers, payload, exc, _op=op):
             inflight.dec()
-            if _slot.done():
+            slot = _op.slot
+            if slot.done():
+                return
+            if _op.dual:
+                # one leg of a double-write: hand the raw outcome to
+                # _dual_join, which picks the client reply
+                if exc is not None:
+                    slot.set_result((599, repr(exc).encode()))
+                elif status == 200:
+                    slot.set_result((200, payload))
+                else:
+                    slot.set_result(
+                        (status, headers.get("err", "").encode()))
                 return
             if exc is not None:
-                ShardRouter._fail_slot(_slot, exc)
+                ShardRouter._fail_slot(slot, exc)
+            elif status == 200 and payload.startswith(MOVED_MAGIC):
+                # the group released this key's range to a new owner
+                # (post-cutover): reroute under the current map
+                # instead of surfacing the marker
+                self._bounce(_op)
             elif status == 200:
-                _slot.set_result(_OK_TMPL % len(payload) + payload)
+                slot.set_result(_OK_TMPL % len(payload) + payload)
             else:
-                _slot.set_result(_response(
+                slot.set_result(_response(
                     status, b"", {"Err": headers.get("err", "")}))
         return done
+
+    # ---- MOVED bounce (stale router vs. cutover) ------------------------
+    def _bounce(self, op: _RoutedOp) -> None:
+        op.tries += 1
+        if op.tries > 3:
+            op.slot.set_result(_response(
+                500, b"", {"Err": "range moved; reroute retries "
+                                  "exhausted"}))
+            return
+        self._stale_total.inc()
+        op.slot.get_loop().create_task(self._rebounce(op))
+
+    async def _rebounce(self, op: _RoutedOp) -> None:
+        """Re-enqueue a MOVED-bounced op under the freshest map: pull
+        the primary's map first when the refresh hook is wired (a
+        stale secondary router learning of a cutover it missed), then
+        re-resolve and ship."""
+        if self._map_refresh is not None:
+            try:
+                await self._map_refresh()
+            except (IOError, OSError, ValueError):
+                pass   # refresh failing just burns one retry
+        with self._lock:
+            m = self._map
+            g = m.group_of(op.key)
+            op.epoch = m.version
+            self._pending[g].append(op)
+        self._group_fwd[g].inc()
+        await self.flush()
+
+    async def barrier(self, group: int) -> None:
+        """Write-order fence for ``group``: every KV op this router
+        already accepted for the group is on its wire (and therefore
+        ahead in its log) before this returns — flush the pending
+        queue, then ride a no-op read through the SAME pipelined
+        connection, whose FIFO ordering makes the read's reply prove
+        the earlier writes were submitted.  The migration coordinator
+        calls this before committing a fence record so the fence
+        orders after every pre-fence routed write."""
+        await self.flush()
+        conn = self._conns[group]
+        await conn.ensure()
+        slot = asyncio.get_running_loop().create_future()
+
+        def done(status, headers, payload, exc):
+            if not slot.done():
+                slot.set_result(b"")
+        conn.submit_raw(
+            b"GET /0 HTTP/1.1\r\nContent-Length: 0\r\n"
+            b"Client-Id: \r\nCommand-Id: 0\r\n\r\n", done)
+        await conn.flush()
+        await slot
+
+    def bucket_hits(self, reset: bool = False) -> List[int]:
+        """The 64-bucket key histogram snapshot (rebalancer input)."""
+        with self._lock:
+            out = list(self._bucket_hits)
+            if reset:
+                self._bucket_hits = [0] * 64
+        return out
 
     # ---- 2PC transport --------------------------------------------------
     async def _tpc_submit(self, group: int, key: int, rec: dict):
@@ -497,7 +663,8 @@ class RouterServer:
                 head.append(f"Property-Trace: {sp.child().encode()}")
             frame = ("\r\n".join(head) + "\r\n\r\n").encode() + value
             return self.router.route_kv(key, frame, self._loop,
-                                        span=sp)
+                                        span=sp,
+                                        write=len(value) > 0)
         return await self._route_slow(method, url, parts, headers, body)
 
     async def _route_slow(self, method: str, url, parts,

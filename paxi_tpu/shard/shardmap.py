@@ -20,22 +20,31 @@ from __future__ import annotations
 
 import bisect
 import json
-from dataclasses import dataclass
-from typing import List, Tuple
+from dataclasses import dataclass, replace
+from typing import List, Optional, Tuple
 
 DEFAULT_SPAN = 1 << 20
+
+# one in-flight range handoff: [lo, hi) moving from group ``src`` to
+# group ``dst`` while ``src`` still OWNS the range (double-write
+# window; shard/migrate.py)
+Migration = Tuple[int, int, int, int]      # (lo, hi, src, dst)
 
 
 @dataclass(frozen=True)
 class ShardMap:
     """``starts[i]`` begins the i-th range (``starts[0] == 0``); range
     i covers ``[starts[i], starts[i+1])`` (the last runs to ``span``)
-    and is owned by ``groups[i]``."""
+    and is owned by ``groups[i]``.  ``migrations`` lists the in-flight
+    handoffs: ownership (and reads) stay with ``src``, but routers
+    duplicate writes in the range to ``dst`` — the double-write window
+    between a migration's fence and its cutover."""
 
     version: int
     span: int
     starts: Tuple[int, ...]
     groups: Tuple[int, ...]
+    migrations: Tuple[Migration, ...] = ()
 
     @staticmethod
     def static(n_groups: int, span: int = DEFAULT_SPAN) -> "ShardMap":
@@ -84,12 +93,61 @@ class ShardMap:
             starts.append(p)
             groups.append(g)
         return ShardMap(version=self.version + 1, span=self.span,
-                        starts=tuple(starts), groups=tuple(groups))
+                        starts=tuple(starts), groups=tuple(groups),
+                        migrations=self.migrations)
+
+    # ---- live-migration control plane (shard/migrate.py) ---------------
+    def migration_of(self, key: int) -> Optional[Migration]:
+        """The in-flight handoff covering ``key`` (modulo-folded), or
+        None — the router's double-write test, so it belongs to the
+        fenced-read proof surface like ``group_of``."""
+        k = int(key) % self.span
+        for m in self.migrations:
+            if m[0] <= k < m[1]:
+                return m
+        return None
+
+    def with_migration(self, lo: int, hi: int, dst: int) -> "ShardMap":
+        """A new map (version + 1) opening the double-write window for
+        ``[lo, hi)`` toward ``dst``.  Ownership does NOT change — that
+        is ``complete_migration`` — but routers seeing this map
+        duplicate the range's writes to both groups."""
+        if not (0 <= lo < hi <= self.span):
+            raise ValueError(f"bad range [{lo}, {hi}) over span "
+                             f"{self.span}")
+        src = self.group_of(lo)
+        if any(self.group_of(k) != src
+               for k in self.starts if lo < k < hi):
+            raise ValueError(f"range [{lo}, {hi}) spans several owner "
+                             f"groups")
+        if src == dst:
+            raise ValueError(f"range [{lo}, {hi}) already owned by "
+                             f"group {dst}")
+        if any(m[0] < hi and lo < m[1] for m in self.migrations):
+            raise ValueError(f"range [{lo}, {hi}) overlaps an "
+                             f"in-flight migration")
+        return replace(self, version=self.version + 1,
+                       migrations=self.migrations + ((lo, hi, src,
+                                                      dst),))
+
+    def complete_migration(self, lo: int, hi: int) -> "ShardMap":
+        """Cutover: a new map (version + 1) with ``[lo, hi)`` owned by
+        its migration's ``dst`` and the window closed."""
+        mig = next((m for m in self.migrations
+                    if (m[0], m[1]) == (lo, hi)), None)
+        if mig is None:
+            raise ValueError(f"no in-flight migration for [{lo}, {hi})")
+        rest = tuple(m for m in self.migrations if m is not mig)
+        return replace(self.move_range(lo, hi, mig[3]),
+                       migrations=rest)
 
     # ---- (de)serialization (the /shardmap wire form) -------------------
     def to_json(self) -> dict:
-        return {"version": self.version, "span": self.span,
-                "starts": list(self.starts), "groups": list(self.groups)}
+        d = {"version": self.version, "span": self.span,
+             "starts": list(self.starts), "groups": list(self.groups)}
+        if self.migrations:
+            d["migrations"] = [list(m) for m in self.migrations]
+        return d
 
     @staticmethod
     def from_json(d) -> "ShardMap":
@@ -97,7 +155,10 @@ class ShardMap:
             d = json.loads(d)
         m = ShardMap(version=int(d["version"]), span=int(d["span"]),
                      starts=tuple(int(s) for s in d["starts"]),
-                     groups=tuple(int(g) for g in d["groups"]))
+                     groups=tuple(int(g) for g in d["groups"]),
+                     migrations=tuple(
+                         tuple(int(x) for x in mg)
+                         for mg in d.get("migrations", [])))
         m.validate()
         return m
 
@@ -108,3 +169,11 @@ class ShardMap:
                 or self.starts[-1] >= self.span \
                 or any(g < 0 for g in self.groups):
             raise ValueError(f"inconsistent ShardMap: {self.to_json()}")
+        for lo, hi, src, dst in self.migrations:
+            if not (0 <= lo < hi <= self.span) or src == dst \
+                    or dst < 0 or self.group_of(lo) != src \
+                    or any(self.group_of(k) != src
+                           for k in self.starts if lo < k < hi):
+                raise ValueError(
+                    f"inconsistent migration ({lo}, {hi}, {src}, "
+                    f"{dst}) in ShardMap: {self.to_json()}")

@@ -33,10 +33,15 @@ wins and recovery aborts the stragglers.  Either way every group
 converges on one outcome — the atomicity the fabric-replayed
 coordinator-kill test pins (tests/test_shard_txn.py).
 
-Scope note: staged 2PC state rides each replica's ordered log, not the
-P1b KV snapshot — a leader change that compacts past an in-doubt txn's
-prepare is a follow-up (ROADMAP); elections without frontier jumps
-re-propose the records like any uncommitted slot.
+Scope note: staged 2PC state rides each replica's ordered log AND the
+P1b auxiliary snapshot (``Database.aux_snapshot`` / ``restore_aux``,
+carried in the paxos P1b seam) — a leader elected across a frontier
+jump restores in-doubt stages, decides, and migration windows from
+the ahead acker instead of dropping them, so an election between
+prepare and decide no longer loses staged ops (the fabric-replayed
+election regression in tests/test_shard_txn.py pins this); elections
+without frontier jumps still re-propose the records like any
+uncommitted slot.
 
 The coordinator is transport-agnostic: ``submit(group, key, record)``
 — ``record`` a plain ``{"kind", "txid", "ops"?, "outcome"?}`` dict —

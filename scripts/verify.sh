@@ -36,8 +36,11 @@
 #                                # a tiny G=2 ramp through the shard
 #                                # router (paxi_tpu/shard/) asserting
 #                                # the artifact schema, committed > 0,
-#                                # anomalies == 0 and a clean
-#                                # cross-shard 2PC atomicity verdict
+#                                # anomalies == 0, a clean cross-shard
+#                                # 2PC atomicity verdict, AND a live
+#                                # move_range of a non-empty hot range
+#                                # mid-ramp: migrated-keys readback
+#                                # oracle clean + blip p99 reported
 #   scripts/verify.sh --spans    # prepend the causal-tracing smoke:
 #                                # a tiny 100%-sampled ramp through the
 #                                # batched commit path (span schema gate
@@ -258,15 +261,17 @@ PYEOF
     timeout -k 10 120 python -m paxi_tpu lint --rule PXW || exit $?
   elif [ "$1" = "--shard" ]; then
     shift
-    echo "== shard smoke (G=2 ramp through the router + 2PC) =="
+    echo "== shard smoke (G=2 ramp + live migration + 2PC) =="
     # the sharded serving tier end-to-end at a toy rate: router ->
-    # 2 consensus groups -> per-worker linearizability verdicts, plus
+    # 2 consensus groups -> per-worker linearizability verdicts, a
+    # mid-ramp move_range of a NON-EMPTY hot range (seeded-keys
+    # readback oracle must be clean, blip p99 must be reported), plus
     # the cross-shard 2PC burst whose atomicity oracle must be clean
     SH_OUT=$(mktemp /tmp/paxi_shard.XXXXXX.json)
-    timeout -k 10 240 env JAX_PLATFORMS=cpu python -m paxi_tpu \
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python -m paxi_tpu \
       bench-host --shards 2 -shard_fleet 6 -shard_workers 2 \
-      -rates 300,800 -step_s 1.5 -K 64 -txns 4 -base_port 18200 \
-      -out "$SH_OUT" >/dev/null || exit $?
+      -rates 300,800 -step_s 1.5 -K 64 -txns 4 -migrate \
+      -base_port 18200 -out "$SH_OUT" >/dev/null || exit $?
     SH_OUT="$SH_OUT" python - <<'PYEOF' || exit $?
 import json, os
 with open(os.environ["SH_OUT"]) as f:
@@ -278,7 +283,7 @@ missing = [k for k in required if k not in r]
 assert not missing, f"shard artifact missing keys: {missing}"
 assert r["mode"] == "shard-ramp" and r["shards"] == 2, r
 names = [p["phase"] for p in r["phases"]]
-assert names == ["disjoint", "crossing"], names
+assert names == ["disjoint", "crossing", "migrate"], names
 for p in r["phases"]:
     assert sum(s["completed"] for s in p["steps"]) > 0, p
 assert (r["anomalies"] or 0) == 0, f"linearizability: {r['anomalies']}"
@@ -286,9 +291,21 @@ t = r["txn"]
 assert t["txns"] > 0 and t["committed"] > 0, t
 assert t["atomicity_violations"] == 0, t
 assert r["router"]["forwards"] > 0, r["router"]
+mig = [p for p in r["phases"] if p["phase"] == "migrate"][0]
+m = mig["migration"]
+assert m["epoch"] == "complete", m
+assert (m["installed"] or 0) > 0, m
+assert m["oracle"]["seeded_keys"] > 0, m["oracle"]
+assert m["oracle"]["clean"], m["oracle"]
+assert mig["steps"][0]["completed"] > 0, mig
+assert (mig["anomalies"] or 0) == 0, mig
+assert "migration_blip_p99_ms" in m and "blip_ratio" in m, m
 print(f"shard smoke OK: peak {r['aggregate_peak_ops_s']} cmds/s over "
       f"{r['shards']} groups, {t['committed']}/{t['txns']} 2PC "
-      f"committed, atomicity clean, anomalies={r['anomalies']}")
+      f"committed, atomicity clean, anomalies={r['anomalies']}, "
+      f"migration {m['installed']} keys moved (oracle clean, "
+      f"blip p99 {m['migration_blip_p99_ms']}ms / "
+      f"steady {m['steady_p99_ms']}ms)")
 PYEOF
     rm -f "$SH_OUT"
   elif [ "$1" = "--host-bench" ]; then

@@ -831,6 +831,13 @@ def test_epochfence_coverage_pins():
     t = cov["paxi_tpu/shard/txn.py"]
     assert t["map_reads"] >= 1
     assert t["map_reads"] == t["fenced_reads"]
+    # the migration subsystem joined the proof surface with this PR:
+    # MapHolder's __init__ install + guarded install_map swap, and the
+    # coordinator's map consumption all fenced
+    mg = cov["paxi_tpu/shard/migrate.py"]
+    assert mg["map_reads"] >= 10
+    assert mg["map_reads"] == mg["fenced_reads"]
+    assert mg["swaps"] == 2 and mg["guarded_swaps"] == 2
 
 
 # ---- stage-4 plumbing: SARIF, --changed, timings -------------------------

@@ -1,0 +1,604 @@
+"""Live range migration (paxi_tpu/shard/migrate.py): the epoch state
+machine end to end — streamed handoff of a NON-EMPTY range, per-epoch
+crash/restart convergence by log order, the mid-migration 2PC kill
+matrix (hunt/cases.SHARD_MIGRATION_CASES) on one virtual-clock fabric,
+the router's double-write window + MOVED-bounce reroute over real
+HTTP, and the Rebalancer's hysteresis policy as pure decisions."""
+
+import asyncio
+import itertools
+
+import pytest
+
+from paxi_tpu.core.command import Command, Request, pack_mig, pack_tpc
+from paxi_tpu.host.client import _Conn
+from paxi_tpu.host.fabric import VirtualClockFabric
+from paxi_tpu.hunt.cases import SHARD_MIGRATION_CASES
+from paxi_tpu.shard import (CoordinatorKilled, MapHolder,
+                            MigrationCoordinator, MigrationError,
+                            MigrationKilled, Rebalancer,
+                            ShardCoordinator, ShardMap, ShardRouter,
+                            ShardedCluster)
+
+pytestmark = pytest.mark.host
+
+
+# ---- shardmap: the migration window as a value --------------------------
+def test_with_migration_window_and_cutover():
+    m = ShardMap.static(2)
+    gsize = m.span // 2
+    lo, hi = gsize - 4096, gsize
+    m1 = m.with_migration(lo, hi, 1)
+    assert m1.version == m.version + 1
+    # ownership unchanged inside the window; the entry is visible
+    assert m1.group_of(lo) == 0
+    assert m1.migration_of(lo) == (lo, hi, 0, 1)
+    assert m1.migration_of(hi - 1) == (lo, hi, 0, 1)
+    assert m1.migration_of(lo - 1) is None
+    # modulo folding reaches the window like group_of
+    assert m1.migration_of(lo + m.span) == (lo, hi, 0, 1)
+    m2 = m1.complete_migration(lo, hi)
+    assert m2.version == m.version + 2
+    assert m2.group_of(lo) == 1 and m2.group_of(hi - 1) == 1
+    assert m2.migration_of(lo) is None and m2.migrations == ()
+
+
+def test_migration_json_roundtrip_and_validate():
+    m = ShardMap.static(3)
+    lo, hi = 64, m.span // 3 - 5
+    m1 = m.with_migration(lo, hi, 2)
+    again = ShardMap.from_json(m1.to_json())
+    assert again == m1 and again.migrations == ((lo, hi, 0, 2),)
+    # a window-less map serializes without the key (wire compat)
+    assert "migrations" not in m.to_json()
+    with pytest.raises(ValueError):
+        m.with_migration(hi, lo, 2)            # inverted range
+    with pytest.raises(ValueError):
+        m.with_migration(lo, hi, 0)            # dst == src
+    with pytest.raises(ValueError):
+        m.with_migration(0, m.span, 1)         # spans several owners
+    with pytest.raises(ValueError):
+        m1.with_migration(lo + 1, hi - 1, 1)   # overlaps in-flight
+    with pytest.raises(ValueError):
+        m1.complete_migration(lo + 1, hi)      # no such window
+
+
+# ---- Rebalancer: hysteresis policy, pure in/out -------------------------
+def test_rebalancer_splits_hot_group_after_streak():
+    m = ShardMap.static(2)
+    reb = Rebalancer(hot_share=0.6, min_ticks=2, min_cmds=10,
+                     cooldown=1)
+    # all load on group 0's lower half
+    hits = [0] * 64
+    for b in range(16):
+        hits[b] = 10
+    assert reb.tick(m, [90, 10], hits) is None      # streak 1
+    plan = reb.tick(m, [90, 10], hits)              # streak 2: split
+    assert plan is not None and plan["action"] == "split"
+    assert plan["src"] == 0 and plan["dst"] == 1
+    assert 0 < plan["lo"] < plan["hi"] <= m.span // 2
+    # cooldown swallows the next tick even under the same skew
+    assert reb.tick(m, [90, 10], hits) is None
+
+
+def test_rebalancer_merges_cold_group_and_quiet_resets():
+    m = ShardMap.static(3)
+    reb = Rebalancer(hot_share=0.9, cold_share=0.05, min_ticks=2,
+                     min_cmds=10, cooldown=0)
+    hits = [1] * 64
+    assert reb.tick(m, [50, 48, 2], hits) is None
+    plan = reb.tick(m, [50, 48, 2], hits)
+    assert plan is not None and plan["action"] == "merge"
+    assert plan["src"] == 2
+    # group 2's range folds into its lower neighbor
+    assert plan["dst"] == m.group_of(plan["lo"] - 1)
+    # a quiet tick (< min_cmds) resets the streaks
+    reb2 = Rebalancer(hot_share=0.6, min_ticks=2, min_cmds=10,
+                      cooldown=0)
+    assert reb2.tick(m, [90, 5, 5], hits) is None
+    assert reb2.tick(m, [1, 0, 0], hits) is None    # quiet: reset
+    assert reb2.tick(m, [90, 5, 5], hits) is None   # streak restarts
+
+
+# ---- fabric harness (test_shard_txn.py idiom) ---------------------------
+def _fabric_cluster(groups=2, n=3):
+    fab = VirtualClockFabric()
+    sc = ShardedCluster("paxos", groups=groups, n=n, http=False,
+                        fabric=fab, tag="migfab")
+    return fab, sc
+
+
+async def drive(fab, aw, max_steps=2000, tick_s=0.0):
+    task = asyncio.ensure_future(aw)
+    for _ in range(max_steps):
+        if task.done():
+            break
+        await fab.run(1)
+        if tick_s:
+            await asyncio.sleep(tick_s)
+    assert task.done(), "fabric steps exhausted mid-migration"
+    return task
+
+
+def mig_submit(sc):
+    """MigrationCoordinator transport for fabric tests: records pack
+    to their MIG_MAGIC wire form and inject straight into each group's
+    entry replica (the /mig HTTP hop collapsed away)."""
+    async def submit(group, key, rec):
+        value = pack_mig(rec["kind"], rec["mid"],
+                         lo=rec.get("lo", 0), hi=rec.get("hi", 0),
+                         span=rec.get("span", 0),
+                         items=rec.get("items"),
+                         cursor=rec.get("cursor", -1),
+                         limit=rec.get("limit", 0))
+        fut = asyncio.get_running_loop().create_future()
+
+        def cb(rep, _fut=fut):
+            if not _fut.done():
+                _fut.set_result((not rep.err, rep.value
+                                 or (rep.err or "").encode()))
+        sc.leader_node(group).handle_client_request(Request(
+            command=Command(int(key), value), reply_to=cb))
+        return await fut
+    return submit
+
+
+def tpc_submit(sc):
+    async def submit(group, key, rec):
+        value = pack_tpc(rec["kind"], rec["txid"],
+                         ops=rec.get("ops"),
+                         outcome=rec.get("outcome", ""))
+        fut = asyncio.get_running_loop().create_future()
+
+        def cb(rep, _fut=fut):
+            if not _fut.done():
+                _fut.set_result((not rep.err, rep.value
+                                 or (rep.err or "").encode()))
+        sc.leader_node(group).handle_client_request(Request(
+            command=Command(int(key), value), reply_to=cb))
+        return await fut
+    return submit
+
+
+async def fput(fab, node, key, value, cid="mseed", cmd_id=1):
+    fut = asyncio.get_running_loop().create_future()
+    node.handle_client_request(Request(
+        command=Command(key, value, cid, cmd_id), reply_to=fut))
+    task = await drive(fab, fut)
+    rep = task.result()
+    assert rep.err is None, rep.err
+
+
+def _seed_kvs(span, lo, n_keys=10):
+    return {lo + 3 * i: f"s{lo + 3 * i}".encode() for i in range(n_keys)}
+
+
+async def _seed(fab, sc, kvs, group):
+    for i, (k, v) in enumerate(sorted(kvs.items())):
+        await fput(fab, sc.leader_node(group), k, v, cmd_id=i + 1)
+
+
+def _assert_moved(sc, kvs, mid, src=0, dst=1, overrides=None):
+    """The migrated-range oracle at EVERY replica: each key's value at
+    dst, the keys dropped at src, the released/done markers durable."""
+    want = dict(kvs)
+    want.update(overrides or {})
+    for r in sc.group(dst).replicas.values():
+        for k, v in want.items():
+            assert r.db.get(k) == v, (r.id, k, r.db.get(k), v)
+        assert mid in r.db.migration_state()["done"], r.id
+    for r in sc.group(src).replicas.values():
+        for k in want:
+            assert not r.db.get(k), (r.id, k)
+        assert mid in r.db.migration_state()["released"], r.id
+
+
+# ---- streamed handoff of a non-empty range (fabric) ---------------------
+def test_streamed_move_nonempty_range_converges():
+    async def main():
+        fab, sc = _fabric_cluster()
+        await sc.start()
+        try:
+            gsize = sc.map.span // 2
+            lo, hi = gsize - 128, gsize
+            kvs = _seed_kvs(sc.map.span, lo)
+            await _seed(fab, sc, kvs, 0)
+            holder = MapHolder(sc.map)
+            mig = MigrationCoordinator(mig_submit(sc), [holder],
+                                       chunk=4)
+            task = await drive(fab, mig.move_range(lo, hi, 1))
+            st = task.result()
+            assert st["epoch"] == "complete"
+            assert st["installed"] >= len(kvs), st
+            assert st["chunks"] >= 3, st          # paging actually paged
+            m = holder.shard_map
+            assert m.version == sc.map.version + 2
+            assert m.group_of(lo) == 1 and m.migration_of(lo) is None
+            await fab.run(80)   # trailing P3s: every replica converges
+            _assert_moved(sc, kvs, st["mid"])
+            # a full re-run of the SAME move is idempotent: the map
+            # already routes to dst, so it collapses to a drain
+            again = MigrationCoordinator(mig_submit(sc), [holder],
+                                         chunk=4)
+            task = await drive(fab, again.move_range(lo, hi, 1,
+                                                     src=0))
+            assert task.result()["epoch"] == "complete"
+            assert holder.shard_map.version == sc.map.version + 2
+        finally:
+            await sc.stop()
+    asyncio.run(main())
+
+
+def test_round_trip_move_and_mid_collision():
+    """A range migrates out and BACK (the rebalancer's split-then-
+    merge-home shape): ``begin`` clears the returning owner's released
+    markers so the range serves again; a THIRD move reusing the first
+    move's default mid is the documented collision, and an explicit
+    fresh mid completes it."""
+    async def main():
+        fab, sc = _fabric_cluster()
+        await sc.start()
+        try:
+            gsize = sc.map.span // 2
+            lo, hi = gsize - 128, gsize
+            kvs = _seed_kvs(sc.map.span, lo, n_keys=6)
+            await _seed(fab, sc, kvs, 0)
+            holder = MapHolder(sc.map)
+            sub = mig_submit(sc)
+
+            async def move(dst, src, **kw):
+                mc = MigrationCoordinator(sub, [holder], chunk=4)
+                task = await drive(fab, mc.move_range(lo, hi, dst,
+                                                      src=src, **kw))
+                return task
+            st = (await move(1, 0)).result()
+            assert st["epoch"] == "complete"
+            st = (await move(0, 1)).result()        # back home
+            assert st["epoch"] == "complete"
+            assert holder.shard_map.group_of(lo) == 0
+            await fab.run(80)
+            # group 0 serves the range again: released markers cleared
+            for r in sc.group(0).replicas.values():
+                assert not any(
+                    rlo < hi and lo < rhi for rlo, rhi, _ in
+                    r.db.migration_state()["released"].values()), r.id
+                for k, v in kvs.items():
+                    assert r.db.get(k) == v, (r.id, k)
+            # ... and plain writes apply instead of bouncing MOVED
+            await fput(fab, sc.leader_node(0), lo, b"home",
+                       cid="rt", cmd_id=1)
+            await fab.run(40)
+            assert sc.leader_node(0).db.get(lo) == b"home"
+            # the first move's default mid is spent at group 1
+            task = await move(1, 0)
+            assert isinstance(task.exception(), MigrationError)
+            # an explicit fresh mid migrates the range out again
+            st = (await move(1, 0, mid="rt-2")).result()
+            assert st["epoch"] == "complete"
+            await fab.run(80)
+            _assert_moved(sc, {**kvs, lo: b"home"}, "rt-2")
+        finally:
+            await sc.stop()
+    asyncio.run(main())
+
+
+@pytest.mark.parametrize("point", ["snapshot", "double_write",
+                                   "cutover"])
+def test_crash_at_every_epoch_then_rerun_converges(point):
+    """Kill the coordinator at each epoch boundary; a FRESH coordinator
+    re-running ``move_range`` with the same arguments must resume at
+    the epoch the logs prove and converge to the same final state."""
+    async def main():
+        fab, sc = _fabric_cluster()
+        await sc.start()
+        try:
+            gsize = sc.map.span // 2
+            lo, hi = gsize - 128, gsize
+            kvs = _seed_kvs(sc.map.span, lo)
+            await _seed(fab, sc, kvs, 0)
+            holder = MapHolder(sc.map)
+            mig = MigrationCoordinator(mig_submit(sc), [holder],
+                                       chunk=4, crash_at=point)
+            task = await drive(fab, mig.move_range(lo, hi, 1))
+            assert isinstance(task.exception(), MigrationKilled), \
+                task.exception()
+            # a new process re-runs the move; post-cutover it must be
+            # told the old owner to run the final drain + drop
+            re = MigrationCoordinator(mig_submit(sc), [holder],
+                                      chunk=4)
+            task = await drive(fab, re.move_range(lo, hi, 1, src=0))
+            st = task.result()
+            assert st["epoch"] == "complete", (point, st)
+            assert holder.shard_map.version == sc.map.version + 2
+            assert holder.shard_map.group_of(lo) == 1
+            await fab.run(80)
+            _assert_moved(sc, kvs, st["mid"])
+        finally:
+            await sc.stop()
+    asyncio.run(main())
+
+
+# ---- the 2PC x migration kill matrix (hunt/cases) -----------------------
+@pytest.mark.parametrize(
+    "mig_kill,tpc_kill,groups,n,seeds", SHARD_MIGRATION_CASES,
+    ids=[f"{c[0]}-{c[1]}" for c in SHARD_MIGRATION_CASES])
+def test_migration_vs_tpc_kill_matrix(mig_kill, tpc_kill, groups, n,
+                                      seeds):
+    """Both coordinators die mid-protocol on one fabric: the 2PC
+    coordinator at ``tpc_kill`` with its group-0 key INSIDE the moving
+    range, the migration coordinator at ``mig_kill``.  2PC recovery
+    and the migration run concurrently (cutover busy-waits on the
+    in-doubt stage), then a fresh migration re-run completes — and
+    the atomicity oracle must hold at every replica with the txn's
+    outcome visible on the RANGE'S NEW OWNER."""
+    async def one(seed):
+        fab, sc = _fabric_cluster(groups=groups, n=n)
+        await sc.start()
+        try:
+            span = sc.map.span
+            gsize = span // groups
+            lo, hi = gsize - 128, gsize
+            kvs = _seed_kvs(span, lo, n_keys=6)
+            k0 = sorted(kvs)[1]              # txn key inside the range
+            k1 = gsize + 300 + seed          # group 1, outside it
+            await _seed(fab, sc, kvs, 0)
+            await fput(fab, sc.leader_node(1), gsize + 7, b"g1",
+                       cid="warm1")
+            submit = tpc_submit(sc)
+            coord = ShardCoordinator(submit, lease_s=0.0)
+            parts = {0: [(k0, b"tpc-v0")], 1: [(k1, b"tpc-v1")]}
+            task = await drive(fab,
+                               coord.run_txn(parts, crash_at=tpc_kill))
+            exc = task.exception()
+            assert isinstance(exc, CoordinatorKilled), exc
+            # migration + 2PC recovery race on the same fabric
+            holder = MapHolder(sc.map)
+            mig = MigrationCoordinator(mig_submit(sc), [holder],
+                                       chunk=3, crash_at=mig_kill,
+                                       busy_wait_s=0.002)
+            rec = ShardCoordinator(submit, lease_s=0.05)
+            t_mig = asyncio.ensure_future(mig.move_range(lo, hi, 1))
+            t_rec = asyncio.ensure_future(rec.recover(exc.txid, parts))
+            for _ in range(4000):
+                if t_mig.done() and t_rec.done():
+                    break
+                await fab.run(1)
+                await asyncio.sleep(0.001)
+            assert t_mig.done() and t_rec.done(), (mig_kill, tpc_kill)
+            assert isinstance(t_mig.exception(), MigrationKilled), \
+                t_mig.exception()
+            outcome = t_rec.result()
+            want = "c" if tpc_kill in ("after_decide", "mid_commit") \
+                else "a"
+            assert outcome == want, (tpc_kill, outcome)
+            # a fresh migration run converges whatever epoch died
+            re = MigrationCoordinator(mig_submit(sc), [holder],
+                                      chunk=3, busy_wait_s=0.002)
+            task = await drive(fab, re.move_range(lo, hi, 1, src=0),
+                               max_steps=4000, tick_s=0.001)
+            st = task.result()
+            assert st["epoch"] == "complete", (mig_kill, st)
+            await fab.run(100)
+            # every-replica atomicity oracle, across the handoff: the
+            # committed value must surface at the range's NEW owner,
+            # the aborted one must not — and group 1's leg must agree
+            v0 = b"tpc-v0" if outcome == "c" else kvs[k0]
+            _assert_moved(sc, kvs, st["mid"], overrides={k0: v0})
+            for r in sc.group(1).replicas.values():
+                got = r.db.get(k1) or b""
+                assert (got == b"tpc-v1") == (outcome == "c"), \
+                    (r.id, outcome, got)
+        finally:
+            await sc.stop()
+
+    async def main():
+        for seed in seeds:
+            await one(seed)
+    asyncio.run(main())
+
+
+# ---- HTTP: double-write window linearizability through the router -------
+def _ids(cid):
+    c = itertools.count(1)
+    return lambda: {"Client-Id": cid, "Command-Id": str(next(c))}
+
+
+async def hput(conn, hdrs, k, v):
+    status, _, payload = await conn.request("PUT", f"/{k}", hdrs(), v)
+    assert status == 200, payload
+    return payload
+
+
+async def hget(conn, hdrs, k):
+    status, _, payload = await conn.request("GET", f"/{k}", hdrs(), b"")
+    assert status == 200, payload
+    return payload
+
+
+def test_double_write_window_linearizable_through_router():
+    """Writes inside an open window duplicate to both groups and stay
+    read-your-write clean THROUGH the cutover map swap: the value
+    written mid-window answers from the new owner with no stream
+    having run — the duplicated legs alone carried it."""
+    async def main():
+        sc = ShardedCluster("paxos", groups=2, n=2,
+                            base_port=19700, routers=1)
+        await sc.start()
+        conn = _Conn(sc.router_url)
+        try:
+            hdrs = _ids("dw")
+            gsize = sc.map.span // 2
+            lo, hi = gsize - 4096, gsize
+            k = hi - 100
+            await hput(conn, hdrs, k, b"w0")       # elect + warm
+            r = sc.router
+            r.install_map(r.shard_map.with_migration(lo, hi, 1))
+            d0 = r._dual_total.value
+            await hput(conn, hdrs, k, b"va")
+            assert r._dual_total.value == d0 + 1   # both legs shipped
+            assert await hget(conn, hdrs, k) == b"va"
+            await hput(conn, hdrs, k, b"vb")
+            assert await hget(conn, hdrs, k) == b"vb"
+            # dst's log really has the duplicated write
+            for _ in range(100):
+                if sc.leader_node(1).db.get(k) == b"vb":
+                    break
+                await asyncio.sleep(0.02)
+            assert sc.leader_node(1).db.get(k) == b"vb"
+            # cutover the map: reads now route to dst and must still
+            # see the last acked write
+            r.install_map(r.shard_map.complete_migration(lo, hi))
+            assert await hget(conn, hdrs, k) == b"vb"
+        finally:
+            conn.close()
+            await sc.stop()
+    asyncio.run(main())
+
+
+# ---- HTTP: full streamed move under concurrent load ---------------------
+def test_http_move_range_under_load_with_router_tier():
+    """The live handoff end to end over real HTTP with TWO routers:
+    seeded keys stream across, concurrent writers stay read-your-write
+    clean throughout, and both routers converge on the cutover map."""
+    async def main():
+        sc = ShardedCluster("paxos", groups=2, n=2,
+                            base_port=19750, routers=2)
+        await sc.start()
+        conn = _Conn(sc.router_url)
+        try:
+            hdrs = _ids("ld")
+            gsize = sc.map.span // 2
+            lo, hi = gsize - 4096, gsize
+            kvs = {hi - 256 + 8 * i: f"s{i}".encode()
+                   for i in range(20)}
+            for k, v in kvs.items():
+                await hput(conn, hdrs, k, v)
+            stop = asyncio.Event()
+            violations = []
+
+            async def writer():
+                whdrs = _ids("wrk")
+                wconn = _Conn(sc.router_urls[-1])   # the secondary
+                last = {}
+                try:
+                    i = 0
+                    while not stop.is_set():
+                        for k in list(kvs)[:4]:
+                            v = f"c{i}".encode()
+                            await hput(wconn, whdrs, k, v)
+                            last[k] = v
+                            got = await hget(wconn, whdrs, k)
+                            if got != v:
+                                violations.append((k, v, got))
+                            i += 1
+                        await asyncio.sleep(0)
+                finally:
+                    wconn.close()
+                return last
+
+            wtask = asyncio.ensure_future(writer())
+            await asyncio.sleep(0.1)
+            mig = sc.migrator(chunk=4)
+            st = await asyncio.wait_for(mig.move_range(lo, hi, 1), 60)
+            stop.set()
+            last = await asyncio.wait_for(wtask, 30)
+            assert st["epoch"] == "complete", st
+            assert st["installed"] >= len(kvs) - 4, st
+            assert violations == [], violations[:3]
+            want = dict(kvs)
+            want.update(last)
+            # both routers carry the cutover map (the secondary is in
+            # the coordinator's holder list)
+            v2 = sc.map.version + 2
+            assert sc.router.shard_map.version == v2
+            assert sc.secondaries[0][0].shard_map.version == v2
+            assert sc.router.shard_map.group_of(lo) == 1
+            # readback through BOTH router endpoints
+            for k, v in want.items():
+                assert await hget(conn, hdrs, k) == v, k
+            sconn = _Conn(sc.router_urls[-1])
+            shdrs = _ids("ld2")
+            try:
+                for k, v in list(want.items())[:6]:
+                    assert await hget(sconn, shdrs, k) == v, k
+            finally:
+                sconn.close()
+            # the data really lives at dst now, dropped from src
+            await asyncio.sleep(0.2)
+            for k, v in want.items():
+                assert sc.leader_node(1).db.get(k) == v, k
+                assert not sc.leader_node(0).db.get(k), k
+        finally:
+            conn.close()
+            await sc.stop()
+    asyncio.run(main())
+
+
+# ---- HTTP: a stale router bounces off MOVED and reroutes ----------------
+def test_stale_router_write_during_cutover_rerouted_not_lost():
+    """A router OUTSIDE the coordinator's holder list keeps the old
+    map across the cutover: its next write hits the released range,
+    bounces on the MOVED marker, pulls the primary's map via the
+    refresh hook, and lands on the new owner —
+    ``paxi_router_stale_reroutes_total`` must count the bounce and
+    the value must not be lost."""
+    async def main():
+        sc = ShardedCluster("paxos", groups=2, n=2,
+                            base_port=19800, routers=1)
+        await sc.start()
+        conn = _Conn(sc.router_url)
+        urls = [cfg.http_addrs[cfg.ids[0]] for cfg in sc.cfgs]
+        stale = ShardRouter(sc.map, urls)
+        stale._map_refresh = sc._refresh_for(stale)
+        try:
+            hdrs = _ids("st")
+            gsize = sc.map.span // 2
+            lo, hi = gsize - 4096, gsize
+            kvs = {hi - 128 + 8 * i: f"s{i}".encode()
+                   for i in range(6)}
+            for k, v in kvs.items():
+                await hput(conn, hdrs, k, v)
+            st = await sc.migrator(chunk=4).move_range(lo, hi, 1)
+            assert st["epoch"] == "complete", st
+            # the stale tier never heard: old version, old owner
+            assert stale.shard_map.version == sc.map.version
+            loop = asyncio.get_running_loop()
+            base = stale._stale_total.value
+
+            def frame(method, k, v):
+                return (f"{method} /{k} HTTP/1.1\r\n"
+                        f"Content-Length: {len(v)}\r\n"
+                        f"Client-Id: stale\r\n"
+                        f"Command-Id: {k}\r\n\r\n").encode() + v
+            k = sorted(kvs)[0]
+            slot = stale.route_kv(k, frame("PUT", k, b"late"),
+                                  loop, write=True)
+            await stale.flush()
+            resp = await asyncio.wait_for(slot, 15)
+            assert resp.startswith(b"HTTP/1.1 200"), resp[:80]
+            assert stale._stale_total.value > base
+            # the refresh hook converged the stale tier on the cutover
+            assert stale.shard_map.version == sc.map.version + 2
+            # ... and the write landed at the NEW owner, not lost
+            for _ in range(100):
+                if sc.leader_node(1).db.get(k) == b"late":
+                    break
+                await asyncio.sleep(0.02)
+            assert sc.leader_node(1).db.get(k) == b"late"
+            # reads bounce the same way: a stale read of a moved key
+            # returns the value from the new owner
+            k2 = sorted(kvs)[1]
+            stale2 = ShardRouter(sc.map, urls)
+            stale2._map_refresh = sc._refresh_for(stale2)
+            try:
+                slot = stale2.route_kv(k2, frame("GET", k2, b""), loop)
+                await stale2.flush()
+                resp = await asyncio.wait_for(slot, 15)
+                assert resp.split(b"\r\n\r\n", 1)[1] == kvs[k2]
+            finally:
+                stale2.close()
+        finally:
+            stale.close()
+            conn.close()
+            await sc.stop()
+    asyncio.run(main())

@@ -282,6 +282,105 @@ def test_kill_matrix_replay_timelines_byte_identical():
     assert ascii_timeline(a[1]) == ascii_timeline(b[1])
 
 
+def test_staged_prepare_survives_election_before_decide():
+    """The P1b aux-snapshot seam (protocols/paxos/host.py): a replica
+    that missed a prepare is elected BETWEEN prepare and decide via a
+    frontier jump — the ahead acker's snapshot carries the staged 2PC
+    ops in its ``aux`` plane, so the commit that follows through the
+    NEW leader still applies the staged writes instead of silently
+    dropping them (the pre-PR atomicity gap).  Runs on the live chan
+    transport: socket-level drop/crash is how elections are staged
+    (test_host_paxos idiom) — the fabric bypasses socket faults."""
+    async def main():
+        sc = ShardedCluster("paxos", groups=2, n=3, http=False,
+                            tag="txnel")
+        await sc.start()
+        try:
+            submit = direct_submit(sc)
+            # elect both group leaders and give 1.3 a shared baseline
+            coord = ShardCoordinator(submit, lease_s=0.0)
+            warm = fresh_parts(sc.map.span, 2, 700)
+            out = await asyncio.wait_for(coord.run_txn(warm), 10)
+            assert out.committed
+            g0 = sc.group(0)
+            r11, r12, r13 = (g0.replicas[i] for i in g0.cfg.ids)
+            # 1.3 misses everything from here: the prepare's slot will
+            # execute (and compact below the frontier) without it
+            r11.socket.drop("1.3", 60.0)
+            r12.socket.drop("1.3", 60.0)
+            txid, k0 = "tx-elect", 11
+            k1 = sc.map.span // 2 + 11
+            parts = {0: [(k0, b"elected-0")], 1: [(k1, b"elected-1")]}
+            for g, ops in parts.items():
+                ok, payload = await asyncio.wait_for(
+                    submit(g, ops[0][0], {"kind": "prepare",
+                                          "txid": txid, "ops": ops}),
+                    10)
+                assert ok and payload.startswith(b"yes:"), payload
+            # pad the log so 1.2's execute frontier is clearly ahead
+            for j in range(2):
+                ok, _ = await asyncio.wait_for(submit(
+                    0, 40 + j, {"kind": "prepare", "txid": f"pad{j}",
+                                "ops": [(40 + j, b"p")]}), 10)
+                assert ok
+                ok, _ = await asyncio.wait_for(submit(
+                    0, 40 + j, {"kind": "abort",
+                                "txid": f"pad{j}"}), 10)
+                assert ok
+            await asyncio.sleep(0.1)
+            assert txid in r11.db.staged_txns()
+            assert txid in r12.db.staged_txns()
+            assert txid not in r13.db.staged_txns()
+            assert r12.execute > r13.execute
+            # the old leader dies; the laggard wins the election — its
+            # P1b quorum is {1.3, 1.2}, and 1.2 (ahead) ships
+            # snapshot + aux with its promise
+            r11.socket.crash(60.0)
+            r11.socket.drop("1.3", 0.0)
+            r12.socket.drop("1.3", 0.0)
+            r13.run_phase1()
+            for _ in range(200):
+                if r13.is_leader():
+                    break
+                await asyncio.sleep(0.02)
+            assert r13.is_leader()
+            # THE regression: the in-doubt stage survived the election
+            assert txid in r13.db.staged_txns()
+            # decide + commit through the new leader (and group 1)
+            async def submit_new(group, key, rec):
+                value = pack_tpc(rec["kind"], rec["txid"],
+                                 ops=rec.get("ops"),
+                                 outcome=rec.get("outcome", ""))
+                fut = asyncio.get_running_loop().create_future()
+
+                def cb(rep, _fut=fut):
+                    if not _fut.done():
+                        _fut.set_result((not rep.err, rep.value
+                                         or (rep.err or "").encode()))
+                node = r13 if group == 0 else sc.leader_node(1)
+                node.handle_client_request(Request(
+                    command=Command(int(key), value), reply_to=cb))
+                return await asyncio.wait_for(fut, 10)
+            for g, ops in parts.items():
+                got = await submit_new(
+                    g, ops[0][0], {"kind": "decide", "txid": txid,
+                                   "outcome": "c"})
+                assert got == (True, b"c"), got
+            for g, ops in parts.items():
+                ok, _ = await submit_new(
+                    g, ops[0][0], {"kind": "commit", "txid": txid})
+                assert ok
+            await asyncio.sleep(0.1)
+            # the staged writes applied on every live replica
+            for r in (r12, r13):
+                assert r.db.get(k0) == b"elected-0", r.id
+            for r in sc.group(1).replicas.values():
+                assert r.db.get(k1) == b"elected-1", r.id
+        finally:
+            await sc.stop()
+    asyncio.run(main())
+
+
 def test_recovery_is_idempotent_against_live_coordinator():
     """The decide race both ways: recovery colliding with a txn that
     already finished must adopt the committed outcome and leave state
