@@ -53,6 +53,19 @@ determinism the whole replay/span/hunt story depends on):
   proof: every map read fenced, every swap monotone (the migration
   precondition)
 
+Stage 5 — read-tier and wire-schema preconditions (landed before the
+read scale-out tier for the same reason PXE15x landed before
+resharding):
+
+- ``lease-flow``           (leaseflow.py,   PXR16x) — lease/read-
+  staleness proof: local-state read serving dominated by
+  ``_lease_ok``, monotone quorum-round lease renewals, fenced
+  elections and 2PC recovery, resolved clocks only
+- ``wire-record``          (wirerecord.py,  PXV17x) — wire-record
+  schema proof over the derived ``*_MAGIC`` universe: prefix
+  disjointness, pack/unpack field round-trip, guarded interpreter
+  chain, reserved-prefix ingress rejection
+
 Entry points: ``python -m paxi_tpu lint [--rule ...] [--json]`` (cli.py;
 ``--rule`` takes family names or code prefixes like ``PXQ,PXB``) and
 :func:`run_lint` for tests/tooling.  Intentional exceptions live in
@@ -70,8 +83,9 @@ from typing import Dict, List, Optional, Sequence
 import time
 
 from paxi_tpu.analysis import astutil, asyncflow, ballots, concurrency, \
-    crossflow, determinism, epochfence, handlers, layout, measure, \
-    parity, purity, quorum, spanrule, tracemap, workload
+    crossflow, determinism, epochfence, handlers, layout, leaseflow, \
+    measure, parity, purity, quorum, spanrule, tracemap, wirerecord, \
+    workload
 from paxi_tpu.analysis.model import (LintReport, Suppression, Violation,
                                      apply_suppressions, inline_disables,
                                      load_baseline)
@@ -97,6 +111,8 @@ RULES = {
     spanrule.RULE: spanrule,
     determinism.RULE: determinism,
     epochfence.RULE: epochfence,
+    leaseflow.RULE: leaseflow,
+    wirerecord.RULE: wirerecord,
 }
 
 # violation-code prefix -> rule family, the CLI's short spelling
@@ -118,6 +134,8 @@ CODE_PREFIXES = {
     "PXO": spanrule.RULE,
     "PXD": determinism.RULE,
     "PXE": epochfence.RULE,
+    "PXR": leaseflow.RULE,
+    "PXV": wirerecord.RULE,
 }
 
 # pair-driven rules (registry-derived sim/host pairs instead of globs)

@@ -227,9 +227,7 @@ class _FnCheck:
         elif isinstance(stmt, (ast.With, ast.AsyncWith)):
             for item in stmt.items:
                 yield item.context_expr
-        elif isinstance(stmt, ast.Try):
-            return
-        else:
+        elif not isinstance(stmt, ast.Try):
             yield stmt
 
     def _check_stmt(self, stmt: ast.stmt) -> None:

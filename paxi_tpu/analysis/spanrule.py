@@ -19,11 +19,16 @@ host modules:
 - a read of the ``.spans`` attribute (or the result of any
   ``.spans.<method>()`` call in expression position) taints;
 - taint propagates through assignment to local names;
-- two forms are **sanctioned** and carry no taint:
+- three forms are **sanctioned** and carry no taint:
   a bare expression statement calling a collector method
-  (``self.spans.open(...)`` — the statement tier), and passing the
+  (``self.spans.open(...)`` — the statement tier), passing the
   collector through a ``spans=`` keyword (wiring it into a
-  BatchBuffer or sub-component).
+  BatchBuffer or sub-component), and the resolved-clock read
+  ``spans.now()`` — its value is a plain timestamp (fabric clock
+  under replay, perf_counter live), not span state, and the lease
+  machinery MUST read time through exactly this spelling (PXR165),
+  so timestamping entries or lease deadlines with it is not a span
+  leak.
 
 Checks:
 
@@ -93,6 +98,10 @@ class _Taint(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "now" \
+                and _is_spans_base(f.value):
+            return          # resolved clock: a timestamp, not a span
         self.visit(node.func)
         for a in node.args:
             self.visit(a)

@@ -388,6 +388,11 @@ class HTTPServer:
                 raise ValueError("empty transaction")
         except (ValueError, KeyError, TypeError) as e:
             return _response(400, b"", {"Err": repr(e)})
+        if any(c.value.startswith(RESERVED_PREFIXES) for c in cmds):
+            # a reserved-prefix op value would be re-dispatched by
+            # execute_transaction as a 2PC/migration record on every
+            # replica — same refusal as the KV surface
+            return _response(400, b"", {"Err": "reserved value prefix"})
         loop = self._loop
         slot: asyncio.Future = loop.create_future()
 
@@ -536,6 +541,9 @@ class HTTPServer:
                 raise ValueError("empty transaction")
         except (ValueError, KeyError, TypeError) as e:
             return _response(400, b"", {"Err": repr(e)})
+        if any(c.value.startswith(RESERVED_PREFIXES) for c in cmds):
+            # see _enqueue_txn: batch ops are client values too
+            return _response(400, b"", {"Err": "reserved value prefix"})
         cmd = Command(cmds[0].key, pack_transaction(cmds),
                       client_id=headers.get("client-id", ""),
                       command_id=int(headers.get("command-id", "0")))

@@ -24,7 +24,6 @@ command list is the NOOP filler for recovered holes.
 from __future__ import annotations
 
 import asyncio
-import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -176,6 +175,11 @@ class PaxosReplica(Node):
         #   no write can commit while a deposed leader's lease (whose
         #   last renewal round necessarily STARTED before our promises
         #   arrived) may still be serving reads.
+        # Every lease timestamp reads the RESOLVED clock
+        # (``self.spans.now()``: fabric clock under replay, monotonic
+        # perf_counter live) — a wall-clock read here would make lease
+        # expiry depend on host wall time during a virtual-clock
+        # replay, breaking byte-identical re-runs (PXR165).
         self._lease_until = 0.0
         self._fence_until = 0.0
         self._p1_start = 0.0
@@ -228,7 +232,7 @@ class PaxosReplica(Node):
     def _lease_ok(self) -> bool:
         """May barrier reads answer from local state right now?"""
         return not self._lease_enabled() \
-            or time.time() < self._lease_until
+            or self.spans.now() < self._lease_until
 
     def _renew_lease(self, round_start: float) -> None:
         """A quorum round that STARTED at ``round_start`` completed:
@@ -241,7 +245,7 @@ class PaxosReplica(Node):
 
     def run_phase1(self) -> None:
         """paxos.go P1a(): bump ballot, solicit promises."""
-        self._p1_start = time.time()
+        self._p1_start = self.spans.now()
         self.ballot = next_ballot(self.ballot, self.id)
         self.active = False
         self.p1_quorum = Quorum(self.cfg.ids)
@@ -257,6 +261,7 @@ class PaxosReplica(Node):
 
     # ---- client requests ----------------------------------------------
     def handle_request(self, req: Request) -> None:
+        self._maybe_drain_fence()
         if self.is_leader():
             # the batched path: one phase-2 round will cover every
             # request that lands in this buffer before the flush bound
@@ -313,15 +318,21 @@ class PaxosReplica(Node):
         broadcast one P2a carrying every command.  Behind the takeover
         fence (see ``_fence_until``) proposals stash and drain when a
         deposed leader's lease can no longer be live."""
-        if self._lease_enabled() and time.time() < self._fence_until:
+        self._maybe_drain_fence()
+        if self._lease_enabled() and self.spans.now() < self._fence_until:
             try:
                 loop = asyncio.get_running_loop()
             except RuntimeError:
                 loop = None   # no loop (sync caller): fence unenforceable
             if loop is not None:
                 self._fenced.append((reqs, cmds, at_slot))
-                if len(self._fenced) == 1:
-                    loop.call_later(self._fence_until - time.time(),
+                if len(self._fenced) == 1 and self.socket.fabric is None:
+                    # live: a wall timer releases the fence.  Under a
+                    # fabric there are no wall timers (the delay below
+                    # is in resolved-clock units, not seconds) — the
+                    # fence drains on the next protocol activity past
+                    # the bound instead, keeping replays byte-identical
+                    loop.call_later(self._fence_until - self.spans.now(),
                                     self._drain_fence)
                 return
         reqs = list(reqs) if reqs else []
@@ -338,7 +349,7 @@ class PaxosReplica(Node):
         q = Quorum(self.cfg.ids)
         q.ack(self.id)
         self.log[slot] = Entry(self.ballot, cmds, requests=reqs, quorum=q,
-                               timestamp=time.time())
+                               timestamp=self.spans.now())
         # quorum spans for traced requests: opened per batch member at
         # P2a broadcast, closed as one group on majority (_commit).
         # Write-only span traffic — PXO13x pins that no span value ever
@@ -349,6 +360,13 @@ class PaxosReplica(Node):
         self.socket.broadcast(self._make_p2a(slot, cmds))
         if q.majority():  # single-replica cluster
             self._commit(slot)
+
+    def _maybe_drain_fence(self) -> None:
+        """Release the fence stash once the resolved clock passes the
+        bound — the drain path that needs no wall timer (the only one
+        available under a virtual-clock fabric)."""
+        if self._fenced and self.spans.now() >= self._fence_until:
+            self._drain_fence()
 
     def _drain_fence(self) -> None:
         """The takeover fence elapsed: release the stashed proposals
@@ -430,7 +448,7 @@ class PaxosReplica(Node):
         if self._lease_enabled():
             # any prior leader's lease renewal round started before our
             # promises arrived, so it expires no later than this fence
-            self._fence_until = time.time() + self.cfg.lease_s
+            self._fence_until = self.spans.now() + self.cfg.lease_s
         # state transfer first: an acker ahead of our execute frontier
         # has executed (hence committed) everything below it; adopt its
         # snapshot + frontier so the merge never NOOPs an executed slot

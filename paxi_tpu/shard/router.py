@@ -691,6 +691,12 @@ class RouterServer:
             except (ValueError, KeyError, TypeError,
                     AttributeError) as e:
                 return _response(400, b"", {"Err": repr(e)})
+            if any(v.startswith(RESERVED_PREFIXES) for _, v in ops):
+                # a reserved-prefix op value would execute as a 2PC/
+                # migration record at every participant — refuse at
+                # the router exactly like the KV surface above
+                return _response(400, b"",
+                                 {"Err": "reserved value prefix"})
             sp = r.sample_entry("txn", ops=str(len(ops)))
             try:
                 return await r.run_transaction(

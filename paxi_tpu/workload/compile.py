@@ -154,8 +154,7 @@ def _h32(x):
     x = x * jnp.uint32(0x7FEB352D)
     x = x ^ (x >> 15)
     x = x * jnp.uint32(0x846CA68B)
-    x = x ^ (x >> 16)
-    return x
+    return x ^ (x >> 16)
 
 
 def _draw_u(wl: Workload, gid, slot, chan):

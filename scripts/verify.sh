@@ -9,6 +9,14 @@
 #                                # (paxi-lint + compileall + ruff if
 #                                # available — see README "Static
 #                                # analysis")
+#   scripts/verify.sh --lint-fast
+#                                # prepend the git-scoped lint stage:
+#                                # paxi-lint --changed (only files
+#                                # changed vs HEAD + untracked, with
+#                                # every family keeping its TARGETS
+#                                # scoping so verdicts agree with a
+#                                # full run) + the SARIF schema gate —
+#                                # the seconds-cheap pre-push loop
 #   scripts/verify.sh --metrics  # prepend the observability smoke stage
 #                                # (5 s chan bench + /metrics scrape)
 #   scripts/verify.sh --hunt     # prepend the divergence-hunt smoke
@@ -68,7 +76,8 @@ cd "$(dirname "$0")/.."
 while [ "${1:-}" = "--lint" ] || [ "${1:-}" = "--metrics" ] \
     || [ "${1:-}" = "--hunt" ] || [ "${1:-}" = "--bench" ] \
     || [ "${1:-}" = "--host-bench" ] || [ "${1:-}" = "--shard" ] \
-    || [ "${1:-}" = "--workload" ] || [ "${1:-}" = "--spans" ]; do
+    || [ "${1:-}" = "--workload" ] || [ "${1:-}" = "--spans" ] \
+    || [ "${1:-}" = "--lint-fast" ]; do
   if [ "$1" = "--spans" ]; then
     shift
     echo "== spans smoke (100%-sampled ramp, five-phase rows) =="
@@ -492,6 +501,48 @@ print(f"switchpaxos micro-campaign OK: twin {tw['reproduced']} "
       f"reproduced / {tw['witnesses']} witnesses, real protocol clean")
 PYEOF
     rm -rf "$HUNT_DIR"
+  elif [ "$1" = "--lint-fast" ]; then
+    shift
+    echo "== static analysis (paxi-lint --changed, git-scoped) =="
+    # the seconds-cheap pre-push loop: only files changed vs git HEAD
+    # (plus untracked) are linted, but every family keeps its strict
+    # TARGETS scoping — a changed file outside a family's universe is
+    # skipped by that family, so the verdict on the linted set agrees
+    # with what a whole-tree run would say about the same files
+    # (tests/test_lint.py pins exactly this agreement).  Same artifact
+    # + SARIF shape as the full --lint stage, gated the same way.
+    mkdir -p artifacts
+    if ! timeout -k 10 180 python -m paxi_tpu lint --changed \
+        --strict-unused --sarif artifacts/LINT_FAST.sarif \
+        --json > artifacts/LINT_FAST.json; then
+      timeout -k 10 180 python -m paxi_tpu lint --changed \
+        --strict-unused
+      exit 1
+    fi
+    python - <<'PYEOF' || exit $?
+import json
+with open("artifacts/LINT_FAST.json") as f:
+    r = json.load(f)
+assert r["ok"] is True, "lint exited 0 but the artifact says not ok"
+for v in r["violations"] + r["suppressed"]:
+    for k in ("rule", "code", "path", "line", "col", "message"):
+        assert k in v, (k, v)
+with open("artifacts/LINT_FAST.sarif") as f:
+    s = json.load(f)
+assert s["version"] == "2.1.0", s.get("version")
+assert s["$schema"].endswith("sarif-2.1.0.json"), s["$schema"]
+run = s["runs"][0]
+assert run["tool"]["driver"]["name"] == "paxi-lint"
+assert len(run["results"]) == len(r["violations"]) + len(r["suppressed"])
+for res in run["results"]:
+    assert res["level"] in ("error", "note"), res
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"], res
+    assert loc["region"]["startLine"] >= 1, res
+print(f"LINT_FAST OK: {r['checked_files']} changed file(s), "
+      f"{len(r['violations'])} violations, "
+      f"{len(r['suppressed'])} suppressed, SARIF schema clean")
+PYEOF
   elif [ "$1" = "--lint" ]; then
     shift
     echo "== static analysis (paxi-lint) =="
@@ -526,7 +577,7 @@ for v in r["violations"] + r["suppressed"]:
     for k in ("rule", "code", "path", "line", "col", "message"):
         assert k in v, (k, v)
 known = ("PXK", "PXH", "PXT", "PXC", "PXQ", "PXB", "PXS", "PXF", "PXA",
-         "PXM", "PXL", "PXW", "PXO", "PXD", "PXE")
+         "PXM", "PXL", "PXW", "PXO", "PXD", "PXE", "PXR", "PXV")
 for s in r["suppressed"]:
     assert s["code"].startswith(known), s["code"]
     assert s.get("suppressed_by"), s

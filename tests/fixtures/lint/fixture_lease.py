@@ -1,0 +1,128 @@
+"""Seeded lease/read-staleness violations (PXR16x).
+
+Parsed by tests/test_lint.py, never imported.  Mutants first;
+everything from ``class CleanHost`` down is the documented lease
+discipline (guarded serving, monotone quorum-round renewals, fenced
+elections, fenced 2PC recovery, resolved clocks) and must stay green.
+"""
+
+import asyncio
+import time
+
+
+class StaleReader:
+    def __init__(self, db):
+        self.db = db
+        self._lease_until = 0.0
+
+    def serve_unleased(self, reads):
+        # PXR161: local-state answer with no dominating _lease_ok()
+        for r in reads:
+            r.reply(self.db.get(r.key) or b"")
+
+
+class JumpyRenewer:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self._lease_until = 0.0
+
+    def renew_overwrite(self, round_start):
+        # PXR162: non-monotone overwrite — a reordered stale renewal
+        # could extend the lease past what its quorum round justified
+        self._lease_until = round_start + self.cfg.lease_s
+
+    def renew_from_now(self):
+        # PXR162 (+ PXR165): the round start must be a recorded
+        # quorum-round timestamp, never a clock read
+        self._renew_lease(time.time())
+
+    def _renew_lease(self, round_start):
+        self._lease_until = max(self._lease_until,
+                                round_start + self.cfg.lease_s)
+
+
+class SilentCoup:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.active = False
+        self._lease_until = 0.0
+
+    def become_leader(self):
+        # PXR163: flips to leading with no takeover fence stamped
+        self.active = True
+
+
+class HastyCoordinator:
+    def __init__(self, lease_s):
+        self.lease_s = lease_s
+
+    async def recover(self, txid):
+        # PXR164: constant fence instead of the lease bound
+        await asyncio.sleep(0.05)
+        return txid
+
+
+class WallClockLease:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.active = False
+        self._lease_until = 0.0
+
+    def _lease_ok(self):
+        # PXR165: wall-clock expiry breaks virtual-clock replay
+        return self.active and time.time() < self._lease_until
+
+
+class CleanHost:
+    def __init__(self, cfg, spans, db):
+        self.cfg = cfg
+        self.spans = spans
+        self.db = db
+        self.active = False
+        self._lease_until = 0.0
+        self._fence_until = 0.0
+        self._p1_start = 0.0
+
+    def _lease_ok(self):
+        # resolved clock: fabric under replay, perf_counter live
+        return self.active and self.spans.now() < self._lease_until
+
+    def _renew_lease(self, round_start):
+        # monotone, parameterized on the quorum-round start
+        self._lease_until = max(self._lease_until,
+                                round_start + self.cfg.lease_s)
+
+    def clean_serve(self, reads):
+        # the guarded-serving idiom: lease check dominates the reply
+        if not self._lease_ok():
+            return
+        for r in reads:
+            r.reply(self.db.get(r.key) or b"")
+
+    def clean_revoke(self):
+        self._lease_until = 0.0     # shrinking the lease is safe
+
+    def clean_become_leader(self):
+        # takeover fence stamped from the lease bound, renewal from
+        # the recorded phase-1 round start
+        self._fence_until = self.spans.now() + self.cfg.lease_s
+        self.active = True
+        self._renew_lease(self._p1_start)
+
+    def clean_propose(self):
+        # the fence is consulted before first proposals
+        if self.spans.now() < self._fence_until:
+            return False
+        return True
+
+
+class CleanRecovery:
+    def __init__(self, lease_s):
+        self.lease_s = lease_s
+
+    async def recover(self, txid):
+        # the shard/txn.py shape: alias-chased lease_s fence
+        fence = self.lease_s
+        if fence > 0:
+            await asyncio.sleep(fence)
+        return txid

@@ -1,0 +1,104 @@
+"""Seeded wire-record schema violations (PXV17x).
+
+Parsed by tests/test_lint.py, never imported.  The file is its own
+little command module (it defines a ``*_MAGIC`` universe, packs,
+unpacks, a state machine with ``execute`` and an ingress surface), so
+the rule derives everything from THIS source exactly as it does for
+``core/command.py``.  Mutants first; everything from ``OK_MAGIC``
+down is the documented codec discipline and must stay green.
+"""
+
+FXA_MAGIC = b"\x00fxa:"
+# PXV171: a byte prefix of FXA_MAGIC's namespace — startswith
+# dispatch between the two depends on check order
+FXB_MAGIC = b"\x00fxa:b"
+REC_MAGIC = b"\x00rec:"
+ORPHAN_MAGIC = b"\x00orp:"
+HOT_MAGIC = b"\x00hot:"
+
+# HOT_MAGIC deliberately missing although the state machine below
+# dispatches on it -> PXV174 at the dispatch site
+RESERVED_PREFIXES = (FXA_MAGIC, FXB_MAGIC, REC_MAGIC, ORPHAN_MAGIC,
+                     OK_MAGIC)
+
+
+def pack_rec(kind, rid):
+    import json
+    # PXV172: "seq" is always packed but no consumer ever reads it
+    doc = {"kind": kind, "rid": rid, "seq": 0}
+    return REC_MAGIC + json.dumps(doc).encode()
+
+
+def unpack_rec(value):
+    # PXV173: no startswith(REC_MAGIC) guard — foreign bytes raise
+    # at execute time instead of returning None
+    import json
+    doc = json.loads(value[len(REC_MAGIC):].decode())
+    return {"kind": doc["kind"], "rid": doc["rid"]}
+
+
+def pack_orphan(items):
+    # PXV172: a record shape with no unpack_orphan decoder
+    import json
+    return ORPHAN_MAGIC + json.dumps(list(items)).encode()
+
+
+class BadStateMachine:
+    def execute(self, cmd):
+        if cmd.value.startswith(HOT_MAGIC):
+            # PXV174: interpreted by the execute path, not reserved
+            return b"hot"
+        rec = unpack_rec(cmd.value)
+        # PXV173: unpack result used without a None-guard
+        return self._apply(rec)
+
+    def _apply(self, rec):
+        return rec["kind"].encode() + rec["rid"].encode()
+
+
+def bad_ingest(node, body):
+    # PXV174: raw client bytes forwarded with no RESERVED test
+    return Command(1, body)
+
+
+OK_MAGIC = b"\x00ok:"
+
+
+def pack_okrec(kind, oid):
+    import json
+    doc = {"kind": kind, "oid": oid}
+    if kind == "burst":
+        doc["extra"] = 1
+    return OK_MAGIC + json.dumps(doc).encode()
+
+
+def unpack_okrec(value):
+    import json
+    if not value.startswith(OK_MAGIC):
+        return None
+    try:
+        doc = json.loads(value[len(OK_MAGIC):].decode())
+        if not isinstance(doc["oid"], str):
+            return None
+        return doc
+    except (ValueError, TypeError, KeyError):
+        return None
+
+
+class CleanStateMachine:
+    def execute(self, cmd):
+        if cmd.value.startswith(OK_MAGIC):
+            rec = unpack_okrec(cmd.value)
+            if rec is not None:
+                return self._apply_ok(rec)
+        return cmd.value
+
+    def _apply_ok(self, rec):
+        if rec.get("extra"):
+            return rec["oid"].encode()
+        return rec["kind"].encode()
+
+    def clean_ingest(self, body):
+        if body.startswith(RESERVED_PREFIXES):
+            return b"reserved"
+        return Command(2, body)

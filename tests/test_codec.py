@@ -1,10 +1,18 @@
-"""Wire codec round-trip tests (the gob codec analog, SURVEY.md §2.1)."""
+"""Wire codec round-trip tests (the gob codec analog, SURVEY.md §2.1),
+plus adversarial round-trip fuzz for the record packers in
+core/command.py — the layer the PXV17x wire-record family pins
+statically is exercised dynamically here."""
 
+import random
 from dataclasses import dataclass, field
 
 import pickle
 import pytest
 
+from paxi_tpu.core.command import (
+    Command, MIG_MAGIC, RESERVED_PREFIXES, TPC_MAGIC, TXN_MAGIC,
+    pack_mig, pack_tpc, pack_transaction, pack_values,
+    unpack_mig, unpack_tpc, unpack_transaction, unpack_values)
 from paxi_tpu.host.codec import Codec, decode_from, register_message
 
 
@@ -68,3 +76,92 @@ def test_pickle_payload_cannot_smuggle_arbitrary_types():
     frame = len(body).to_bytes(4, "big") + body
     with pytest.raises(pickle.UnpicklingError, match="not a registered"):
         decode_from(c, frame)
+
+
+# ---- adversarial record-packer fuzz (the PXV17x layer, dynamically) --
+
+# every magic, embedded at every position EXCEPT the start: a payload
+# merely CONTAINING a magic mid-value is an ordinary value and must
+# survive every round trip untouched
+_HOSTILE_VALUES = [
+    b"x" + m + b"y" for m in (TXN_MAGIC, TPC_MAGIC, MIG_MAGIC)
+] + [
+    m + m for m in RESERVED_PREFIXES            # doubled magic
+] + [
+    b'{"kind": "prepare"}',                     # record-shaped, no magic
+    b'"\\u0000txn:"',                           # escaped magic in JSON
+    b"\x00",                                    # bare NUL sentinel
+]
+
+
+def _fuzz_values(seed: int, n: int = 64):
+    """Deterministic byte soup: raw 0..255 bytes, JSON metacharacters,
+    utf-8 multibyte runs, and magic fragments spliced mid-value."""
+    rng = random.Random(seed)
+    pool = (bytes(range(256)), b'"\\{}[]:,\n\r\t',
+            "κλειδί\u2028\U0001f9ea".encode(), TXN_MAGIC[1:],
+            TPC_MAGIC, MIG_MAGIC[:3])
+    out = []
+    for _ in range(n):
+        v = b"".join(rng.choice(pool)[: rng.randrange(1, 9)]
+                     for _ in range(rng.randrange(1, 6)))
+        # never let a fuzz value START with a reserved magic — that is
+        # the ingress-rejected class, tested separately below
+        while v.startswith(RESERVED_PREFIXES):
+            v = b"\xff" + v
+        out.append(v)
+    return out
+
+
+def test_transaction_roundtrip_survives_hostile_bytes():
+    for i, v in enumerate(_HOSTILE_VALUES + _fuzz_values(20)):
+        batch = [Command(i, v), Command(i + 1, b"")]
+        got = unpack_transaction(pack_transaction(batch))
+        assert [(c.key, c.value) for c in got] == \
+            [(i, v), (i + 1, b"")]
+
+
+def test_tpc_roundtrip_survives_hostile_bytes():
+    for i, v in enumerate(_fuzz_values(21)):
+        doc = unpack_tpc(pack_tpc("prepare", f"tx{i}", ops=[(i, v)]))
+        assert doc["kind"] == "prepare" and doc["txid"] == f"tx{i}"
+        assert doc["ops"] == [(i, v)]
+    out = unpack_tpc(pack_tpc("decide", "t", outcome="c"))
+    assert out["outcome"] == "c" and "ops" not in out
+
+
+def test_mig_roundtrip_hostile_items_and_empty_ranges():
+    for i, v in enumerate(_fuzz_values(22, n=16)):
+        doc = unpack_mig(pack_mig("install", "m", items=[(i, v)],
+                                  cursor=i))
+        assert doc["items"] == [(i, v)] and doc["cursor"] == i
+    # the empty-range / empty-chunk degenerate shapes stay decodable
+    # and keep their field inventory distinct from the omitted case
+    empty = unpack_mig(pack_mig("install", "m0", items=[], cursor=0))
+    assert empty["items"] == [] and empty["cursor"] == 0
+    bare = unpack_mig(pack_mig("begin", "m1"))
+    assert bare == {"kind": "begin", "mid": "m1"}
+    assert "items" not in bare and "cursor" not in bare
+    # hi=0 means "no range" by contract: lo/span must not leak through
+    norange = unpack_mig(pack_mig("start", "m2", lo=5, hi=0, span=9))
+    assert "lo" not in norange and "hi" not in norange
+
+
+def test_values_roundtrip_survives_hostile_bytes():
+    vals = _HOSTILE_VALUES + _fuzz_values(23, n=16) + [b""]
+    assert unpack_values(pack_values(vals)) == vals
+
+
+def test_magic_prefixed_garbage_decodes_to_none_not_poison():
+    """A value merely STARTING with a magic (slipped past ingress) must
+    decode to None on every replica, never raise — an uncaught decode
+    error here would be a poison command crashing the whole group."""
+    for tail in (b"", b"not json", b'{"half": ', b"[[1,", b"\xff\xfe",
+                 b'{"kind": "nope", "mid": 3}', b'{"kind": "begin"}'):
+        assert unpack_transaction(TXN_MAGIC + tail) is None
+        assert unpack_tpc(TPC_MAGIC + tail) is None
+        assert unpack_mig(MIG_MAGIC + tail) is None
+    # wrong-magic cross-decode is None too, not an exception
+    rec = pack_tpc("prepare", "t")
+    assert unpack_transaction(rec) is None
+    assert unpack_mig(rec) is None

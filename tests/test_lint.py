@@ -18,8 +18,8 @@ import pytest
 from paxi_tpu import analysis
 from paxi_tpu.analysis import (asyncflow, ballots, concurrency, crossflow,
                                determinism, epochfence, handlers, layout,
-                               measure, parity, purity, quorum, spanrule,
-                               tracemap)
+                               leaseflow, measure, parity, purity, quorum,
+                               spanrule, tracemap, wirerecord)
 from paxi_tpu.analysis.model import (Suppression, Violation,
                                      apply_suppressions, inline_disables,
                                      load_baseline)
@@ -890,8 +890,9 @@ def test_git_changed_file_listing():
 def test_changed_scoped_run_agrees_with_full_run():
     """The --changed contract: a strict-targets scoped run produces
     exactly the full run's findings filtered to those files — a
-    changed file outside a family's TARGETS (core/command.py here)
-    stays outside it instead of being force-fed to every family."""
+    changed file outside a family's TARGETS stays outside it instead
+    of being force-fed to every family (command.py is inside the
+    stage-5 wire-record targets but outside every kernel family)."""
     rel = ["paxi_tpu/host/socket.py", "paxi_tpu/shard/router.py",
            "paxi_tpu/core/command.py"]
     scoped = analysis.run_lint(paths=[ROOT / p for p in rel],
@@ -913,6 +914,150 @@ def test_report_timings_per_family():
     assert all(t >= 0.0 for t in report.timings.values())
     out = json.loads(report.to_json())
     assert set(out["timings"]) == {"epoch-fence", "trace-map"}
+
+
+# ---- lease flow (stage 5) ------------------------------------------------
+def test_leaseflow_fixture_catches_each_mutant():
+    """PXR16x: the unguarded local read, the non-monotone renewal, the
+    clock-fed renewal call, the unfenced election, the constant-bound
+    recovery fence and both wall-clock reads all fire; the
+    ``CleanHost``/``CleanRecovery`` controls (guarded serving,
+    monotone quorum-round renewal, fenced election, alias-chased
+    recovery fence, resolved clocks) stay green."""
+    vs = leaseflow.check(ROOT, files=[FIX / "fixture_lease.py"])
+    assert codes(vs) == ["PXR161", "PXR162", "PXR163", "PXR164",
+                         "PXR165"]
+    src = (FIX / "fixture_lease.py").read_text().splitlines()
+    clean_start = next(i for i, l in enumerate(src, 1)
+                       if l.startswith("class CleanHost"))
+    assert all(v.line < clean_start for v in vs), \
+        "the documented lease discipline must not flag"
+    assert len({v.line for v in vs if v.code == "PXR161"}) == 1
+    assert len({v.line for v in vs if v.code == "PXR162"}) == 2
+    assert len({v.line for v in vs if v.code == "PXR163"}) == 1
+    assert len({v.line for v in vs if v.code == "PXR164"}) == 1
+    assert len({v.line for v in vs if v.code == "PXR165"}) == 2
+
+
+def test_leaseflow_repo_tree_is_clean():
+    """The lease discipline is structurally proven — zero violations
+    AND zero baseline entries: every local-state read is lease-guarded
+    (or declared non-linearized), every renewal monotone and round-
+    derived, every election and 2PC recovery fenced, every lease
+    timestamp on the resolved clock (the ROADMAP read-tier
+    precondition)."""
+    assert leaseflow.check(ROOT) == []
+
+
+def test_leaseflow_coverage_pins():
+    """The rule is looking at the sites the docstring claims — the
+    coming follower-read/read-cache code must extend this surface,
+    not dodge it."""
+    cov = leaseflow.coverage(ROOT)
+    px = cov["paxi_tpu/protocols/paxos/host.py"]
+    # both leader-read answer paths (_flush_batch and the barrier
+    # read path) serve from db.get behind _lease_ok
+    assert px["local_read_serves"] == 2
+    assert px["lease_guarded_reads"] == 2
+    assert px["lease_checks"] == 2
+    # one monotone renewal helper, fed from _p1_start (election) and
+    # entry.timestamp (commit); one shrink-to-zero revocation
+    assert px["renewals"] == 1 and px["monotone_renewals"] == 1
+    assert px["revocations"] == 1
+    assert px["renewal_calls"] == 2
+    # the election stamps a lease_s fence and propose consults it
+    assert px["elections"] == 1 and px["fences"] == 1
+    assert px["fence_checks"] >= 1
+    assert px["lease_fns"] >= 9
+    # the switchnet subclass renews through the inherited helper
+    assert cov["paxi_tpu/protocols/switchpaxos/host.py"][
+        "renewal_calls"] == 1
+    # the 2PC coordinator's recover awaits the same lease_s bound
+    assert cov["paxi_tpu/shard/txn.py"]["recovery_fences"] == 1
+    # declared non-linearized local reads: the blockchain host's
+    # eventually-consistent answer and HTTP's raw /local probe —
+    # pinned so a future read cache cannot hide behind "no lease"
+    assert cov["paxi_tpu/protocols/blockchain/host.py"][
+        "nonlinearized_reads"] == 1
+    assert cov["paxi_tpu/host/http.py"]["nonlinearized_reads"] == 1
+
+
+# ---- wire record (stage 5) -----------------------------------------------
+def test_wirerecord_fixture_catches_each_mutant():
+    """PXV17x: the magic collision, the consumed-nowhere field, the
+    decoder-less pack, the unguarded unpack, the unguarded unpack-
+    result use, the unreserved interpreted magic and the raw ingress
+    forward all fire; the ``OK_MAGIC`` codec discipline below the
+    marker stays green."""
+    vs = wirerecord.check(ROOT, files=[FIX / "fixture_wire.py"])
+    assert codes(vs) == ["PXV171", "PXV172", "PXV173", "PXV174"]
+    src = (FIX / "fixture_wire.py").read_text().splitlines()
+    clean_start = next(i for i, l in enumerate(src, 1)
+                       if l.startswith("OK_MAGIC"))
+    assert all(v.line < clean_start for v in vs), \
+        "the documented codec discipline must not flag"
+    assert len({v.line for v in vs if v.code == "PXV171"}) == 1
+    assert len({v.line for v in vs if v.code == "PXV172"}) == 2
+    assert len({v.line for v in vs if v.code == "PXV173"}) == 2
+    assert len({v.line for v in vs if v.code == "PXV174"}) == 2
+
+
+def test_wirerecord_repo_tree_is_clean():
+    """The wire-record schema is structurally proven — zero violations
+    AND zero baseline entries: disjoint magics, round-tripping
+    pack/unpack field sets, a fully guarded interpreter chain, and
+    every client-value ingress rejecting (or server-packing past)
+    RESERVED_PREFIXES."""
+    assert wirerecord.check(ROOT) == []
+
+
+def test_wirerecord_magic_universe_matches_source():
+    """The derived universe IS the runtime taxonomy: the rule's
+    source-level view of core/command.py matches the imported
+    constants byte-for-byte, including which magics are reserved —
+    a new magic cannot land outside the proof."""
+    import ast as ast_mod
+    from paxi_tpu.core.command import (MIG_MAGIC, MOVED_MAGIC,
+                                       RESERVED_PREFIXES, TPC_MAGIC,
+                                       TXN_MAGIC)
+    path = ROOT / "paxi_tpu" / "core" / "command.py"
+    mod = wirerecord._Module("paxi_tpu/core/command.py",
+                             ast_mod.parse(path.read_text()))
+    assert mod.magic_values == {
+        "TXN_MAGIC": TXN_MAGIC, "TPC_MAGIC": TPC_MAGIC,
+        "MIG_MAGIC": MIG_MAGIC, "MOVED_MAGIC": MOVED_MAGIC}
+    assert mod.reserved == {"TXN_MAGIC", "TPC_MAGIC", "MIG_MAGIC"}
+    assert set(RESERVED_PREFIXES) == \
+        {mod.magic_values[n] for n in mod.reserved}
+
+
+def test_wirerecord_coverage_pins():
+    """The schema proof surface: all four magics derived, both
+    dict-shaped records round-trip, the execute path interprets
+    exactly the three reserved magics (MOVED_MAGIC proven
+    response-only), and every ingress function is guarded or
+    pack-sanctioned."""
+    cov = wirerecord.coverage(ROOT)
+    cm = cov["paxi_tpu/core/command.py"]
+    assert cm["magics"] == 4 and cm["reserved"] == 3
+    assert cm["packs"] == 4 and cm["unpacks"] == 4
+    assert cm["dict_packs"] == 2 and cm["roundtrips"] == 2
+    assert cm["guarded_unpacks"] == 3
+    db = cov["paxi_tpu/core/db.py"]
+    assert db["interpreted_magics"] == 3
+    assert db["response_only_magics"] == 1     # MOVED_MAGIC
+    assert db["unpack_uses"] == db["none_guarded_uses"] >= 3
+    ht = cov["paxi_tpu/host/http.py"]
+    assert ht["ingress_fns"] == 7
+    assert ht["guarded_ingress"] == 5
+    assert ht["sanctioned_ingress"] == 2       # /tpc and /mig pack
+    rt = cov["paxi_tpu/shard/router.py"]
+    assert rt["ingress_fns"] == rt["guarded_ingress"] == 2
+
+
+def test_stage5_rule_code_prefixes():
+    assert analysis.resolve_rules(["PXR,PXV"]) == \
+        ["lease-flow", "wire-record"]
 
 
 # ---- the repo-wide gate --------------------------------------------------

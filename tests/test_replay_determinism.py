@@ -176,3 +176,73 @@ def test_live_entry_stamp_is_monotonic_clock():
     sent = asyncio.run(main())
     assert len(sent) == 1
     assert sent[0].timestamp > 0.0
+
+
+def test_leader_lease_reads_replay_byte_identical():
+    """The protocols/paxos/host.py fix: every lease timestamp
+    (``_lease_ok``, ``_renew_lease``'s round starts, the takeover
+    fence, entry stamps) reads the RESOLVED clock.  Before the fix the
+    lease machinery consulted ``time.time()`` even under an attached
+    fabric, so whether a leader read was served locally or re-proposed
+    depended on host wall time mid-replay — exactly the divergence
+    this crash-armed double run would catch.  Under the fabric,
+    ``lease_s`` is in virtual-step units and the whole read path is
+    deterministic (PXR165 pins the discipline statically)."""
+    def once():
+        async def main():
+            fab = VirtualClockFabric()
+            cfg = chan_config(3, tag="lease-replay")
+            cfg.http_addrs = {}
+            cfg.leader_reads = True
+            cfg.lease_s = 5.0           # virtual steps under a fabric
+            c = Cluster("paxos", cfg=cfg, n=3, http=False, fabric=fab)
+            await c.start()
+            reads, writes = [], []
+
+            def driver(t: int) -> None:
+                if t == 0:
+                    c["1.1"].handle_client_request(Request(
+                        command=Command(7, b"v1", "c", 1),
+                        reply_to=writes.append))
+                elif t == 2:
+                    # arm the LIVE fault surface mid-replay: the
+                    # fabric owns the fault model, lease serving must
+                    # not notice
+                    for i in c.ids:
+                        c[i].socket.crash(1000.0)
+                elif t == 7:
+                    # past the takeover fence: this request drains the
+                    # fenced first write, then proposes the read
+                    c["1.1"].handle_client_request(Request(
+                        command=Command(7, b"", "c", 2),
+                        reply_to=reads.append))
+                elif t == 8:
+                    c["1.1"].handle_client_request(Request(
+                        command=Command(7, b"v2", "c", 3),
+                        reply_to=writes.append))
+                elif t == 10:
+                    # inside the lease renewed by the t=8 commit round:
+                    # served locally from the leader's db
+                    c["1.1"].handle_client_request(Request(
+                        command=Command(7, b"", "c", 4),
+                        reply_to=reads.append))
+
+            fab.on_step(driver)
+            await fab.run(16, drain=True)
+            log = list(fab.delivery_log)
+            stats = dict(fab.stats)
+            db = {str(i): c[i].db.get(7) for i in c.ids}
+            await c.stop()
+            return (log, stats, db,
+                    [(r.value, r.err) for r in reads],
+                    [r.err for r in writes])
+        return asyncio.run(main())
+
+    a = once()
+    b = once()
+    assert a == b            # two replays, one byte-identical timeline
+    log, stats, db, reads, werrs = a
+    assert werrs == [None, None]
+    assert reads == [(b"v1", None), (b"v2", None)]
+    assert db == {"1.1": b"v2", "1.2": b"v2", "1.3": b"v2"}
+    assert stats["delivered"] > 0

@@ -248,9 +248,8 @@ def test_round_trip_move_and_mid_collision():
 
             async def move(dst, src, **kw):
                 mc = MigrationCoordinator(sub, [holder], chunk=4)
-                task = await drive(fab, mc.move_range(lo, hi, dst,
+                return await drive(fab, mc.move_range(lo, hi, dst,
                                                       src=src, **kw))
-                return task
             st = (await move(1, 0)).result()
             assert st["epoch"] == "complete"
             st = (await move(0, 1)).result()        # back home
